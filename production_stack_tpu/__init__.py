@@ -1,0 +1,24 @@
+"""production-stack-tpu: a TPU-native LLM inference serving stack.
+
+A ground-up reimplementation of the capabilities of the vLLM production-stack
+(router + serving engines + KV cache offload + control plane + observability),
+designed TPU-first:
+
+- the serving engine is JAX/XLA/Pallas (paged attention in HBM, continuous
+  batching with bucketed static shapes, pjit/shard_map tensor parallelism over
+  an ICI mesh) instead of CUDA/PyTorch;
+- KV offload tiers are TPU HBM -> host RAM -> disk -> remote cache server;
+- the router is an asyncio/aiohttp service speaking the same OpenAI-compatible
+  HTTP surface and Prometheus metrics contract as the reference stack.
+
+Layout:
+  engine/    serving engine (scheduler, paged KV, runner, OpenAI server)
+  models/    model families (Llama-class) as pure-JAX functional modules
+  ops/       XLA + Pallas kernels (paged attention, norms, rope)
+  parallel/  device mesh + sharding rules (TP over ICI)
+  kv/        KV offload tiers + KV controller (LMCache-equivalent)
+  router/    request router (discovery, routing algorithms, stats, services)
+  utils/     logging, singletons, hashing
+"""
+
+__version__ = "0.1.0"

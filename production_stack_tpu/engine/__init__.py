@@ -1,0 +1,1 @@
+"""TPU-native serving engine: continuous batching over a paged KV cache."""

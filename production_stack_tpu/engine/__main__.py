@@ -1,0 +1,116 @@
+"""CLI: `python -m production_stack_tpu.engine` — serve a model.
+
+Flag names mirror `vllm serve` where the capability matches (the reference's
+helm chart builds exactly these flags, reference:
+helm/templates/deployment-vllm-multi.yaml:104-181), so existing deployment
+configs translate mechanically.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import EngineServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pst-engine", description="TPU-native LLM serving engine"
+    )
+    p.add_argument("--model", default="pst-tiny-debug",
+                   help="preset name or local HF checkpoint dir")
+    p.add_argument("--tokenizer", default=None,
+                   help="tokenizer dir, or 'byte' for the hermetic tokenizer")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kv-cache-dtype", default="bfloat16")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--block-size", type=int, default=32)
+    p.add_argument("--num-kv-blocks", type=int, default=None)
+    p.add_argument("--gpu-memory-utilization", "--hbm-utilization",
+                   dest="hbm_utilization", type=float, default=0.9)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--max-num-batched-tokens", "--max-prefill-chunk",
+                   dest="max_prefill_chunk", type=int, default=512)
+    p.add_argument("--enable-chunked-prefill", action="store_true",
+                   default=True)
+    p.add_argument("--no-enable-chunked-prefill",
+                   dest="enable_chunked_prefill", action="store_false")
+    p.add_argument("--enable-prefix-caching", action="store_true",
+                   default=True)
+    p.add_argument("--no-enable-prefix-caching",
+                   dest="enable_prefix_caching", action="store_false")
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--enable-lora", action="store_true")
+    p.add_argument("--max-loras", type=int, default=4)
+    p.add_argument("--enable-sleep-mode", action="store_true",
+                   help="advertise sleep/wake support (endpoints always on)")
+    p.add_argument("--attention-impl", default="auto",
+                   choices=["auto", "xla", "pallas"])
+    # disaggregated prefill / KV transfer
+    p.add_argument("--kv-role", default=None,
+                   choices=[None, "kv_producer", "kv_consumer"],
+                   help="disaggregated prefill role")
+    p.add_argument("--kv-transfer-listen", default=None,
+                   help="host:port to serve KV blocks on (producer)")
+    p.add_argument("--kv-peer", default=None,
+                   help="producer host:port to pull KV from (consumer)")
+    # KV offload (LMCache-equivalent)
+    p.add_argument("--cpu-offload-gb", type=float, default=0.0)
+    p.add_argument("--disk-offload-dir", default=None)
+    p.add_argument("--remote-cache-url", default=None)
+    p.add_argument("--kv-controller-url", default=None)
+    p.add_argument("--kv-instance-id", default="default-instance")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> EngineConfig:
+    role = None
+    if args.kv_role == "kv_producer":
+        role = "prefill"
+    elif args.kv_role == "kv_consumer":
+        role = "decode"
+    return EngineConfig(
+        model=args.model,
+        tokenizer=args.tokenizer,
+        dtype=args.dtype,
+        cache_dtype=args.kv_cache_dtype,
+        seed=args.seed,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        hbm_utilization=args.hbm_utilization,
+        max_model_len=args.max_model_len,
+        max_num_seqs=args.max_num_seqs,
+        max_prefill_chunk=args.max_prefill_chunk,
+        enable_chunked_prefill=args.enable_chunked_prefill,
+        enable_prefix_caching=args.enable_prefix_caching,
+        tensor_parallel_size=args.tensor_parallel_size,
+        served_model_name=args.served_model_name,
+        enable_lora=args.enable_lora,
+        max_loras=args.max_loras,
+        attention_impl=args.attention_impl,
+        kv_role=role,
+        kv_transfer_config={
+            "listen": args.kv_transfer_listen,
+            "peer": args.kv_peer,
+        },
+        cpu_offload_bytes=int(args.cpu_offload_gb * 2**30),
+        disk_offload_dir=args.disk_offload_dir,
+        remote_cache_url=args.remote_cache_url,
+        kv_controller_url=args.kv_controller_url,
+        kv_instance_id=args.kv_instance_id,
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    server = EngineServer(config_from_args(args))
+    server.run(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Async facade over LLMEngine for the HTTP server.
+
+The engine step loop (device dispatch) runs on a dedicated thread so the
+asyncio event loop stays responsive for streaming; per-request outputs are
+delivered to asyncio queues via call_soon_threadsafe. This mirrors the
+process shape of the reference's engines (uvicorn front + engine core), minus
+GPUs: on TPU the device work is already async (XLA dispatch returns before
+compute finishes), so one runner thread saturates the chip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from collections.abc import AsyncIterator
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.outputs import (
+    EngineStatsSnapshot,
+    RequestOutput,
+)
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class EngineSleepingError(RuntimeError):
+    pass
+
+
+class AsyncLLMEngine:
+    def __init__(self, config: EngineConfig, params: dict | None = None):
+        self.config = config
+        self.engine = LLMEngine(config, params=params)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._streams: dict[str, asyncio.Queue] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._step_loop, name="engine-step-loop", daemon=True
+        )
+        # sleep/wake lifecycle (reference parity: engine /sleep /wake_up,
+        # reference: src/vllm_router/service_discovery.py:414-441)
+        self.sleeping = False
+        self.sleep_level = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, loop: asyncio.AbstractEventLoop | None = None) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    # -- step loop thread --------------------------------------------------
+    def _step_loop(self) -> None:
+        logger.info("engine step loop started")
+        while not self._stopped:
+            if self.sleeping:
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+            with self._lock:
+                busy = self.engine.has_unfinished()
+                outputs = self.engine.step() if busy else []
+            if outputs and self._loop is not None:
+                self._loop.call_soon_threadsafe(self._deliver, outputs)
+            if not busy:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _deliver(self, outputs: list[RequestOutput]) -> None:
+        for out in outputs:
+            q = self._streams.get(out.request_id)
+            if q is not None:
+                q.put_nowait(out)
+
+    # -- request API -------------------------------------------------------
+    async def generate(
+        self,
+        request_id: str,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling_params: SamplingParams | None = None,
+        lora_name: str | None = None,
+    ) -> AsyncIterator[RequestOutput]:
+        if self.sleeping:
+            raise EngineSleepingError("engine is sleeping")
+        q: asyncio.Queue[RequestOutput] = asyncio.Queue()
+        self._streams[request_id] = q
+        finished = False
+        try:
+            with self._lock:
+                self.engine.add_request(
+                    request_id,
+                    prompt=prompt,
+                    prompt_token_ids=prompt_token_ids,
+                    sampling_params=sampling_params,
+                    arrival_time=time.time(),
+                    lora_name=lora_name,
+                )
+            self._wake.set()
+            while True:
+                out = await q.get()
+                finished = out.finished
+                yield out
+                if finished:
+                    break
+        finally:
+            self._streams.pop(request_id, None)
+            if not finished:
+                with self._lock:
+                    self.engine.abort_request(request_id)
+
+    async def abort(self, request_id: str) -> bool:
+        with self._lock:
+            return self.engine.abort_request(request_id)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> EngineStatsSnapshot:
+        with self._lock:
+            return self.engine.stats()
+
+    @property
+    def tokenizer(self):
+        return self.engine.tokenizer
+
+    # -- sleep / wake ------------------------------------------------------
+    def sleep(self, level: int = 1) -> None:
+        """Pause serving. Level 1 keeps weights; level 2 is a deep sleep
+        (the KV cache is dropped either way once in-flight work drains)."""
+        self.sleeping = True
+        self.sleep_level = level
+        logger.info("engine going to sleep (level %d)", level)
+
+    def wake_up(self) -> None:
+        self.sleeping = False
+        self.sleep_level = 0
+        self._wake.set()
+        logger.info("engine woke up")
+
+    def is_sleeping(self) -> bool:
+        return self.sleeping

@@ -1,0 +1,208 @@
+"""Paged KV block manager with hash-based prefix caching.
+
+TPU-native equivalent of the KV-block bookkeeping the reference stack gets
+from vLLM + LMCache (the router scrapes its effects as
+`vllm:gpu_cache_usage_perc` / `vllm:gpu_prefix_cache_hit_rate`, reference:
+src/vllm_router/stats/engine_stats.py:63-76). Pure host-side Python: the
+device only ever sees flat slot indices, so this logic never enters jit.
+
+Prefix caching: a *full* block of block_size tokens is content-addressed by
+the chain hash of all tokens up to and including that block. Blocks with
+ref_count 0 stay in an LRU "evictable" pool and can be resurrected on a hash
+hit (same design as vLLM's prefix caching / LMCache's local backend).
+
+Block 0 is reserved as the null/trash block: padded batch lanes write their
+garbage K/V there, so it is never handed to a sequence.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import xxhash
+
+NULL_BLOCK = 0
+
+
+def hash_block(prev_hash: int, token_ids: tuple[int, ...],
+               extra: tuple = ()) -> int:
+    """Chain hash for a full block given the previous block's hash."""
+    h = xxhash.xxh64()
+    h.update(prev_hash.to_bytes(8, "little", signed=False))
+    for t in token_ids:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    for e in extra:
+        h.update(str(e).encode())
+    return h.intdigest()
+
+
+class Block:
+    __slots__ = ("block_id", "ref_count", "block_hash")
+
+    def __init__(self, block_id: int):
+        self.block_id = block_id
+        self.ref_count = 0
+        self.block_hash: int | None = None
+
+
+class BlockManager:
+    """Allocator for a fixed pool of KV blocks, with prefix caching."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+    ):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the null block)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+
+        self.blocks = [Block(i) for i in range(num_blocks)]
+        # block 0 reserved as null/trash
+        self.free_blocks: list[int] = list(range(num_blocks - 1, 0, -1))
+        # hash -> block_id for cached full blocks (ref>=0)
+        self.cached_blocks: dict[int, int] = {}
+        # block_id -> None, LRU order, for ref_count==0 cached blocks
+        self.evictable: OrderedDict[int, None] = OrderedDict()
+
+        # token-level prefix-cache counters (engine /metrics contract)
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self.free_blocks) + len(self.evictable)
+
+    @property
+    def usage(self) -> float:
+        """Fraction of blocks actively referenced (the vllm:gpu_cache_usage_perc)."""
+        usable = self.num_blocks - 1
+        return (usable - self.num_free_blocks) / max(1, usable)
+
+    def can_allocate(self, num_new_blocks: int) -> bool:
+        return self.num_free_blocks >= num_new_blocks
+
+    # -- low-level alloc --------------------------------------------------
+    def _pop_free_block(self) -> int:
+        if self.free_blocks:
+            return self.free_blocks.pop()
+        if self.evictable:
+            bid, _ = self.evictable.popitem(last=False)  # LRU
+            blk = self.blocks[bid]
+            if blk.block_hash is not None:
+                self.cached_blocks.pop(blk.block_hash, None)
+                blk.block_hash = None
+            return bid
+        raise RuntimeError("out of KV blocks")
+
+    def _take(self, bid: int) -> None:
+        blk = self.blocks[bid]
+        if blk.ref_count == 0 and bid in self.evictable:
+            del self.evictable[bid]
+        blk.ref_count += 1
+
+    # -- sequence-level API ----------------------------------------------
+    def block_hashes_for(self, token_ids: list[int]) -> list[int]:
+        """Chain hashes for each *full* block of token_ids."""
+        hashes = []
+        prev = 0
+        bs = self.block_size
+        for i in range(len(token_ids) // bs):
+            prev = hash_block(prev, tuple(token_ids[i * bs : (i + 1) * bs]))
+            hashes.append(prev)
+        return hashes
+
+    def match_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
+        """Longest cached prefix: returns (block_ids, num_cached_tokens).
+
+        Does NOT take references; pairs with allocate_prompt.
+        """
+        if not self.enable_prefix_caching:
+            return [], 0
+        matched: list[int] = []
+        for h in self.block_hashes_for(token_ids):
+            bid = self.cached_blocks.get(h)
+            if bid is None:
+                break
+            matched.append(bid)
+        return matched, len(matched) * self.block_size
+
+    def allocate_prompt(
+        self, token_ids: list[int]
+    ) -> tuple[list[int], int] | None:
+        """Allocate the block table for a prompt, reusing cached prefix blocks.
+
+        Returns (block_table, num_cached_tokens) or None if out of blocks.
+        num_cached_tokens is capped at len(token_ids)-1 so at least one token
+        is computed (we need its logits to start decoding).
+        """
+        n = len(token_ids)
+        self.prefix_queries += n
+        matched, cached_tokens = self.match_prefix(token_ids)
+        cached_tokens = min(cached_tokens, n - 1)
+        num_matched_blocks = cached_tokens // self.block_size
+        matched = matched[:num_matched_blocks]
+
+        total_blocks = (n + self.block_size - 1) // self.block_size
+        need_new = total_blocks - len(matched)
+        # matched blocks sitting in the evictable pool stop being free the
+        # moment we take them, so they must not count toward need_new
+        evictable_matched = sum(1 for b in matched if b in self.evictable)
+        if self.num_free_blocks - evictable_matched < need_new:
+            self.prefix_queries -= n  # admission failed; don't skew stats
+            return None
+
+        self.prefix_hits += cached_tokens
+        table = []
+        for bid in matched:
+            self._take(bid)
+            table.append(bid)
+        for _ in range(need_new):
+            bid = self._pop_free_block()
+            self._take(bid)
+            table.append(bid)
+        return table, cached_tokens
+
+    def ensure_capacity(
+        self, num_tokens: int, block_table: list[int]
+    ) -> bool:
+        """Grow block_table (in place) until it covers num_tokens positions.
+
+        Returns False if a new block was needed but none was available.
+        """
+        while len(block_table) * self.block_size < num_tokens:
+            if self.num_free_blocks == 0:
+                return False
+            bid = self._pop_free_block()
+            self._take(bid)
+            block_table.append(bid)
+        return True
+
+    def register_block(
+        self, prev_hash: int, token_ids: tuple[int, ...], block_id: int
+    ) -> int:
+        """Incrementally content-address one full block; returns its hash."""
+        h = hash_block(prev_hash, token_ids)
+        if not self.enable_prefix_caching:
+            return h
+        blk = self.blocks[block_id]
+        if blk.block_hash is None and h not in self.cached_blocks:
+            blk.block_hash = h
+            self.cached_blocks[h] = block_id
+        return h
+
+    def free(self, block_table: list[int]) -> None:
+        """Release a sequence's references; cached blocks become evictable."""
+        for bid in block_table:
+            blk = self.blocks[bid]
+            blk.ref_count -= 1
+            assert blk.ref_count >= 0, f"double free of block {bid}"
+            if blk.ref_count == 0:
+                if blk.block_hash is not None:
+                    self.evictable[bid] = None  # keep contents, LRU-evictable
+                else:
+                    self.free_blocks.append(bid)

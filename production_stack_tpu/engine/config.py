@@ -1,0 +1,60 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from production_stack_tpu.models.config import ModelConfig, get_model_config
+
+
+@dataclass
+class EngineConfig:
+    model: str = "pst-tiny-debug"
+    tokenizer: str | None = None  # defaults to model path; "byte" for tests
+    dtype: str = "bfloat16"
+    cache_dtype: str = "bfloat16"
+    seed: int = 0
+
+    # KV cache sizing: explicit block count, or fraction of HBM after weights
+    block_size: int = 32
+    num_kv_blocks: int | None = None
+    hbm_utilization: float = 0.9
+
+    # scheduling
+    max_model_len: int | None = None  # None -> model's max
+    max_num_seqs: int = 8
+    max_prefill_chunk: int = 512
+    enable_chunked_prefill: bool = True
+    enable_prefix_caching: bool = True
+
+    # parallelism (tensor-parallel size over the ICI mesh)
+    tensor_parallel_size: int = 1
+
+    # serving
+    served_model_name: str | None = None
+    enable_lora: bool = False
+    max_loras: int = 4
+    max_lora_rank: int = 16
+
+    # attention implementation: "auto" | "xla" | "pallas"
+    attention_impl: str = "auto"
+
+    # disaggregated prefill role: None | "prefill" | "decode"
+    kv_role: str | None = None
+    kv_transfer_config: dict = field(default_factory=dict)
+
+    # KV offload (LMCache-equivalent) tiers
+    cpu_offload_bytes: int = 0
+    disk_offload_dir: str | None = None
+    remote_cache_url: str | None = None
+    kv_controller_url: str | None = None
+    kv_instance_id: str = "default-instance"
+
+    def model_config(self) -> ModelConfig:
+        return get_model_config(self.model)
+
+    def resolved_max_model_len(self) -> int:
+        mc = self.model_config()
+        if self.max_model_len is None:
+            return mc.max_model_len
+        return min(self.max_model_len, mc.max_model_len)

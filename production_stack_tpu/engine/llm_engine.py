@@ -1,0 +1,325 @@
+"""LLMEngine: ties scheduler + block manager + model runner + sampler into
+the step loop. One step == one prefill chunk OR one decode batch (static
+shapes, see model_runner.py).
+
+TPU-native equivalent of the serving engine the reference stack deploys as
+external `vllm serve` pods (reference: helm/templates/deployment-vllm-multi.yaml:104-126);
+the OpenAI/metrics HTTP surface lives in engine/server.py.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.model_runner import ModelRunner
+from production_stack_tpu.engine.outputs import (
+    EngineStatsSnapshot,
+    RequestOutput,
+)
+from production_stack_tpu.engine.sampler import (
+    apply_penalties,
+    sample_tokens,
+)
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.engine.scheduler import (
+    Scheduler,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.sequence import Sequence, SequenceStatus
+from production_stack_tpu.engine.tokenizer import get_tokenizer
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig, params: dict | None = None):
+        self.config = config
+        self.tokenizer = get_tokenizer(config.tokenizer, config.model)
+        self.runner = ModelRunner(config, params=params)
+        self.block_manager = BlockManager(
+            num_blocks=self.runner.num_blocks,
+            block_size=config.block_size,
+            enable_prefix_caching=config.enable_prefix_caching,
+        )
+        self.scheduler = Scheduler(
+            SchedulerConfig(
+                max_num_seqs=config.max_num_seqs,
+                max_prefill_chunk=config.max_prefill_chunk,
+                max_model_len=config.resolved_max_model_len(),
+                enable_chunked_prefill=config.enable_chunked_prefill,
+            ),
+            self.block_manager,
+        )
+        self._seqs: dict[str, Sequence] = {}
+        # lifetime counters for /metrics
+        self._prompt_tokens_total = 0
+        self._generation_tokens_total = 0
+        self._preemptions_total = 0
+        self._finished_total = 0
+
+    # -- request lifecycle ------------------------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling_params: SamplingParams | None = None,
+        arrival_time: float | None = None,
+        lora_name: str | None = None,
+    ) -> None:
+        if request_id in self._seqs:
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        sp = sampling_params or SamplingParams()
+        seq = Sequence(
+            request_id=request_id,
+            prompt_token_ids=prompt_token_ids,
+            sampling_params=sp,
+            eos_token_id=self.tokenizer.eos_token_id,
+            arrival_time=arrival_time,
+            lora_name=lora_name,
+        )
+        self._seqs[request_id] = seq
+        self.scheduler.add_seq(seq)
+
+    def abort_request(self, request_id: str) -> bool:
+        seq = self._seqs.pop(request_id, None)
+        if seq is None:
+            return False
+        return self.scheduler.abort(request_id)
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    # -- the step loop ----------------------------------------------------
+    def step(self) -> list[RequestOutput]:
+        sched_out = self.scheduler.schedule()
+        self._preemptions_total += len(sched_out.preempted)
+        if sched_out.is_empty:
+            return []
+
+        outputs: list[RequestOutput] = []
+        for seq in sched_out.aborted:
+            seq.metrics.finished_time = time.time()
+            self._finished_total += 1
+            outputs.append(self._make_output(seq))
+            self._seqs.pop(seq.request_id, None)
+
+        stepped: list[Sequence] = []
+        if sched_out.prefill is not None:
+            w = sched_out.prefill
+            seq = w.seq
+            if seq.metrics.first_scheduled_time is None:
+                seq.metrics.first_scheduled_time = time.time()
+            chunk = seq.prompt_token_ids[
+                w.chunk_start : w.chunk_start + w.chunk_len
+            ]
+            logits = self.runner.prefill(
+                chunk,
+                start_pos=w.chunk_start,
+                block_table=seq.block_table,
+                total_len=w.chunk_start + w.chunk_len,
+            )
+            seq.num_computed_tokens += w.chunk_len
+            self._prompt_tokens_total += w.chunk_len
+            if w.is_last_chunk:
+                token = self._sample([seq], logits[None, :])[0]
+                self._append_token(seq, token)
+                stepped.append(seq)
+        elif sched_out.decode is not None:
+            seqs = sched_out.decode.seqs
+            tokens = [s.all_token_ids[-1] for s in seqs]
+            positions = [s.num_tokens - 1 for s in seqs]
+            tables = [s.block_table for s in seqs]
+            ctx_lens = [s.num_tokens for s in seqs]
+            logits = self.runner.decode(tokens, positions, tables, ctx_lens)
+            sampled = self._sample(seqs, logits[: len(seqs)])
+            for seq, token in zip(seqs, sampled):
+                seq.num_computed_tokens = seq.num_tokens
+                self._append_token(seq, int(token))
+                stepped.append(seq)
+
+        for seq in stepped:
+            self._register_full_blocks(seq)
+            out = self._make_output(seq)
+            outputs.append(out)
+            if seq.finished:
+                seq.metrics.finished_time = time.time()
+                self._finished_total += 1
+                self.scheduler.free_finished(seq)
+                self._seqs.pop(seq.request_id, None)
+        return outputs
+
+    # -- internals ---------------------------------------------------------
+    def _sample(self, seqs: list[Sequence], logits) -> np.ndarray:
+        b = logits.shape[0]
+        temps = np.zeros((b,), np.float32)
+        top_ps = np.ones((b,), np.float32)
+        top_ks = np.full((b,), -1, np.int32)
+        keys = np.zeros((b, 2), np.uint32)
+        needs_penalties = False
+        for i, s in enumerate(seqs):
+            sp = s.sampling_params
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_ks[i] = sp.top_k
+            if (
+                sp.presence_penalty != 0.0
+                or sp.frequency_penalty != 0.0
+                or sp.repetition_penalty != 1.0
+            ):
+                needs_penalties = True
+            seed = (
+                sp.seed
+                if sp.seed is not None
+                else (self.config.seed ^ (hash(s.request_id) & 0x7FFFFFFF))
+            )
+            keys[i] = (
+                np.uint32(seed & 0xFFFFFFFF),
+                np.uint32(len(s.generated_token_ids)),
+            )
+        if needs_penalties:
+            logits = self._apply_penalties(seqs, np.asarray(logits))
+        out = sample_tokens(logits, temps, top_ps, top_ks, keys)
+        return np.asarray(out)[: len(seqs)]
+
+    def _apply_penalties(
+        self, seqs: list[Sequence], logits: np.ndarray
+    ) -> np.ndarray:
+        vocab = logits.shape[-1]
+        b = logits.shape[0]
+        counts = np.zeros((b, vocab), np.float32)
+        presence = np.zeros((b,), np.float32)
+        frequency = np.zeros((b,), np.float32)
+        repetition = np.ones((b,), np.float32)
+        for i, s in enumerate(seqs):
+            sp = s.sampling_params
+            presence[i] = sp.presence_penalty
+            frequency[i] = sp.frequency_penalty
+            repetition[i] = sp.repetition_penalty
+            gen = s.generated_token_ids
+            if gen:
+                counts[i] = np.bincount(
+                    np.asarray(gen) % vocab, minlength=vocab
+                ).astype(np.float32)
+        return np.asarray(
+            apply_penalties(
+                logits, counts > 0, counts, presence, frequency, repetition
+            )
+        )
+
+    def _append_token(self, seq: Sequence, token: int) -> None:
+        if seq.metrics.first_token_time is None:
+            seq.metrics.first_token_time = time.time()
+        seq.append_token(int(token))
+        self._generation_tokens_total += 1
+        new_text = self.tokenizer.decode(seq.generated_token_ids)
+        prev_len = len(seq.output_text)
+        seq.output_text = new_text
+        seq._last_delta = new_text[prev_len:]  # type: ignore[attr-defined]
+        seq.check_stop(new_text)
+        # hard cap: the KV layout cannot hold more than max_model_len
+        # positions, so stop at the context limit regardless of max_tokens
+        if (
+            not seq.finished
+            and seq.num_tokens >= self.scheduler.config.max_model_len
+        ):
+            seq.status = SequenceStatus.FINISHED_LENGTH
+
+    def _register_full_blocks(self, seq: Sequence) -> None:
+        bs = self.block_manager.block_size
+        all_ids = seq.all_token_ids
+        while (len(seq.block_hashes) + 1) * bs <= seq.num_computed_tokens:
+            i = len(seq.block_hashes)
+            if i >= len(seq.block_table):
+                break
+            prev = seq.block_hashes[-1] if seq.block_hashes else 0
+            h = self.block_manager.register_block(
+                prev, tuple(all_ids[i * bs : (i + 1) * bs]),
+                seq.block_table[i],
+            )
+            seq.block_hashes.append(h)
+
+    def _make_output(self, seq: Sequence) -> RequestOutput:
+        new_ids = seq.output_token_ids[-1:] if seq.output_token_ids else []
+        return RequestOutput(
+            request_id=seq.request_id,
+            prompt_token_ids=seq.prompt_token_ids[: seq.orig_prompt_len],
+            token_ids=list(seq.generated_token_ids),
+            new_token_ids=list(new_ids),
+            text=seq.output_text,
+            delta_text=getattr(seq, "_last_delta", ""),
+            finished=seq.finished,
+            finish_reason=seq.finish_reason,
+            metrics=seq.metrics,
+            num_cached_tokens=seq.metrics.num_cached_prompt_tokens,
+        )
+
+    # -- LoRA hot-load (full adapter math lands with the LoRA runner) -------
+    def load_lora(self, name: str, path: str) -> None:
+        if not hasattr(self, "_loras"):
+            self._loras: dict[str, str] = {}
+        if len(self._loras) >= self.config.max_loras and (
+            name not in self._loras
+        ):
+            raise RuntimeError(
+                f"max_loras={self.config.max_loras} adapters already loaded"
+            )
+        self._loras[name] = path
+
+    def unload_lora(self, name: str) -> None:
+        if hasattr(self, "_loras"):
+            self._loras.pop(name, None)
+
+    def list_loras(self) -> list[str]:
+        return sorted(getattr(self, "_loras", {}))
+
+    # -- stats for /metrics -------------------------------------------------
+    def stats(self) -> EngineStatsSnapshot:
+        return EngineStatsSnapshot(
+            num_running=self.scheduler.num_running,
+            num_waiting=self.scheduler.num_waiting,
+            kv_usage=self.block_manager.usage,
+            prefix_cache_queries=self.block_manager.prefix_queries,
+            prefix_cache_hits=self.block_manager.prefix_hits,
+            prompt_tokens_total=self._prompt_tokens_total,
+            generation_tokens_total=self._generation_tokens_total,
+            num_preemptions_total=self._preemptions_total,
+            requests_finished_total=self._finished_total,
+        )
+
+    # -- offline convenience (tests, benchmarks) ---------------------------
+    def generate(
+        self,
+        prompts: list[str] | list[list[int]],
+        sampling_params: SamplingParams | list[SamplingParams] | None = None,
+    ) -> list[RequestOutput]:
+        """Synchronous batch generation; returns final outputs in order."""
+        finals: dict[str, RequestOutput] = {}
+        for i, p in enumerate(prompts):
+            sp = (
+                sampling_params[i]
+                if isinstance(sampling_params, list)
+                else sampling_params
+            )
+            kwargs = (
+                {"prompt_token_ids": p}
+                if isinstance(p, list)
+                else {"prompt": p}
+            )
+            self.add_request(f"gen-{i}", sampling_params=sp, **kwargs)
+        while self.has_unfinished():
+            for out in self.step():
+                if out.finished:
+                    finals[out.request_id] = out
+        return [finals[f"gen-{i}"] for i in range(len(prompts))]

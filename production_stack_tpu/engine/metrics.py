@@ -1,0 +1,135 @@
+"""Engine Prometheus metrics.
+
+Gauge names follow the exact contract the reference router scrapes
+(reference: src/vllm_router/stats/engine_stats.py:63-76 parses
+`vllm:num_requests_running`, `vllm:num_requests_waiting`,
+`vllm:gpu_cache_usage_perc`, `vllm:gpu_prefix_cache_hit_rate`,
+`vllm:gpu_prefix_cache_{hits,queries}_total`), so any router/dashboard built
+for vLLM engines scrapes ours unchanged. On TPU the "gpu_" prefix is kept for
+drop-in compatibility; tpu:* aliases are exported alongside.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    REGISTRY,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+from production_stack_tpu.engine.outputs import EngineStatsSnapshot
+
+_LATENCY_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.5, 3.0, 6.0, 12.0, 30.0, 60.0,
+)
+
+
+class EngineMetrics:
+    def __init__(
+        self,
+        model_name: str,
+        registry: CollectorRegistry | None = None,
+    ):
+        self.model_name = model_name
+        reg = registry or REGISTRY
+        label = ["model_name"]
+
+        def gauge(name, doc):
+            return Gauge(name, doc, label, registry=reg)
+
+        self.num_running = gauge(
+            "vllm:num_requests_running", "Requests currently being decoded"
+        )
+        self.num_waiting = gauge(
+            "vllm:num_requests_waiting", "Requests waiting to be scheduled"
+        )
+        self.cache_usage = gauge(
+            "vllm:gpu_cache_usage_perc", "KV-cache usage (1 = full)"
+        )
+        self.prefix_hit_rate = gauge(
+            "vllm:gpu_prefix_cache_hit_rate",
+            "Prefix-cache hit rate over engine lifetime",
+        )
+        self.prefix_hits = gauge(
+            "vllm:gpu_prefix_cache_hits_total",
+            "Prefix-cache token hits (total)",
+        )
+        self.prefix_queries = gauge(
+            "vllm:gpu_prefix_cache_queries_total",
+            "Prefix-cache token queries (total)",
+        )
+        # TPU-native aliases (the Grafana dashboard panels use either)
+        self.tpu_cache_usage = gauge(
+            "tpu:hbm_kv_cache_usage_perc", "KV-cache usage in TPU HBM"
+        )
+        self.prompt_tokens = Counter(
+            "vllm:prompt_tokens", "Prefill tokens processed",
+            label, registry=reg,
+        )
+        self.generation_tokens = Counter(
+            "vllm:generation_tokens", "Tokens generated",
+            label, registry=reg,
+        )
+        self.preemptions = Counter(
+            "vllm:num_preemptions", "Sequence preemptions",
+            label, registry=reg,
+        )
+        self.request_success = Counter(
+            "vllm:request_success", "Finished requests",
+            ["model_name", "finished_reason"], registry=reg,
+        )
+        self.ttft = Histogram(
+            "vllm:time_to_first_token_seconds", "TTFT",
+            label, buckets=_LATENCY_BUCKETS, registry=reg,
+        )
+        self.tpot = Histogram(
+            "vllm:time_per_output_token_seconds", "Inter-token latency",
+            label, buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16,
+                            0.32, 0.64, 1.28), registry=reg,
+        )
+        self.e2e_latency = Histogram(
+            "vllm:e2e_request_latency_seconds", "End-to-end request latency",
+            label, buckets=_LATENCY_BUCKETS, registry=reg,
+        )
+        self._counter_state = EngineStatsSnapshot()
+
+    def update_from_snapshot(self, s: EngineStatsSnapshot) -> None:
+        m = self.model_name
+        self.num_running.labels(m).set(s.num_running)
+        self.num_waiting.labels(m).set(s.num_waiting)
+        self.cache_usage.labels(m).set(s.kv_usage)
+        self.tpu_cache_usage.labels(m).set(s.kv_usage)
+        self.prefix_hit_rate.labels(m).set(s.prefix_cache_hit_rate)
+        self.prefix_hits.labels(m).set(s.prefix_cache_hits)
+        self.prefix_queries.labels(m).set(s.prefix_cache_queries)
+        prev = self._counter_state
+        self.prompt_tokens.labels(m).inc(
+            max(0, s.prompt_tokens_total - prev.prompt_tokens_total)
+        )
+        self.generation_tokens.labels(m).inc(
+            max(0, s.generation_tokens_total - prev.generation_tokens_total)
+        )
+        self.preemptions.labels(m).inc(
+            max(0, s.num_preemptions_total - prev.num_preemptions_total)
+        )
+        self._counter_state = s
+
+    def observe_request(
+        self,
+        finish_reason: str,
+        ttft_s: float | None,
+        e2e_s: float | None,
+        n_output_tokens: int,
+    ) -> None:
+        m = self.model_name
+        self.request_success.labels(m, finish_reason).inc()
+        if ttft_s is not None:
+            self.ttft.labels(m).observe(ttft_s)
+        if e2e_s is not None:
+            self.e2e_latency.labels(m).observe(e2e_s)
+            if ttft_s is not None and n_output_tokens > 1:
+                self.tpot.labels(m).observe(
+                    (e2e_s - ttft_s) / (n_output_tokens - 1)
+                )

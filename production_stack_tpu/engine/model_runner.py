@@ -1,0 +1,335 @@
+"""Model runner: owns params + KV cache on device, dispatches jitted steps.
+
+XLA-first batching contract (the piece that makes continuous batching work on
+TPU without per-step recompilation):
+
+- every device program has a **static shape**, selected from a small set of
+  buckets; jit traces each bucket once and the compile cache does the rest;
+- prefill runs one sequence per call with the chunk length padded to a
+  power-of-two bucket and the context padded to a whole-block bucket;
+- decode runs a fixed number of lanes (max_num_seqs) with the context padded
+  to the max bucket needed this step; idle lanes point at the null block and
+  their writes land in the reserved trash slot 0;
+- KV caches are donated into every step, so XLA performs scatter updates
+  in place in HBM (no cache copies).
+
+The attention inner op is chosen at construction: the XLA gather path
+(ops/attention.py) everywhere, or the Pallas kernel on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.models import llama
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops import attention as xla_attn
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: dict | None = None,
+        mesh: jax.sharding.Mesh | None = None,
+    ):
+        self.config = config
+        self.model_config: ModelConfig = config.model_config()
+        self.dtype = jnp.dtype(config.dtype)
+        self.cache_dtype = jnp.dtype(config.cache_dtype)
+        self.mesh = mesh
+        self.max_model_len = config.resolved_max_model_len()
+
+        mc = self.model_config
+        if params is None:
+            logger.info(
+                "initializing random %s params (%.2fB params, %s)",
+                mc.name, mc.num_params() / 1e9, config.dtype,
+            )
+            params = llama.init_params(
+                mc, jax.random.key(config.seed), self.dtype
+            )
+        self.params = params
+
+        self.num_blocks = self._resolve_num_blocks()
+        self.block_size = config.block_size
+        num_slots = self.num_blocks * self.block_size
+        cache_shape = (
+            mc.num_layers, num_slots, mc.num_kv_heads, mc.head_dim
+        )
+        logger.info(
+            "allocating KV cache: %d blocks x %d slots (%.2f GiB)",
+            self.num_blocks, self.block_size,
+            2 * math.prod(cache_shape) * self.cache_dtype.itemsize / 2**30,
+        )
+        self.k_cache = jnp.zeros(cache_shape, self.cache_dtype)
+        self.v_cache = jnp.zeros(cache_shape, self.cache_dtype)
+
+        self._scale = mc.head_dim**-0.5
+        # jit caches keyed by bucket tuple
+        self._prefill_fns: dict[tuple[int, int], object] = {}
+        self._decode_fns: dict[tuple[int, int], object] = {}
+
+        self.max_ctx_bucket = self._ctx_bucket(self.max_model_len)
+
+    # -- sizing -----------------------------------------------------------
+    def _resolve_num_blocks(self) -> int:
+        cfg, mc = self.config, self.model_config
+        if cfg.num_kv_blocks is not None:
+            return cfg.num_kv_blocks
+        bytes_per_block = (
+            2
+            * mc.num_layers
+            * cfg.block_size
+            * mc.num_kv_heads
+            * mc.head_dim
+            * self.cache_dtype.itemsize
+        )
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit", 16 * 2**30)
+            in_use = stats.get("bytes_in_use", 0)
+        except Exception:
+            limit, in_use = 16 * 2**30, 0
+        param_bytes = mc.num_params() * self.dtype.itemsize
+        budget = int(limit * cfg.hbm_utilization) - in_use - param_bytes
+        num = max(2, budget // bytes_per_block)
+        # cap: no point holding more than max_model_len * max_num_seqs * 2
+        cap = (
+            2
+            * (self.max_model_len // cfg.block_size + 1)
+            * max(1, cfg.max_num_seqs)
+        )
+        return int(min(num, max(cap, 2)))
+
+    # -- buckets ----------------------------------------------------------
+    def _ctx_bucket(self, num_tokens: int) -> int:
+        """Context bucket in tokens: whole blocks, pow2 block count."""
+        blocks = max(1, -(-num_tokens // self.block_size))
+        blocks = next_pow2(blocks)
+        max_blocks = -(-self.max_model_len // self.block_size)
+        return min(blocks, next_pow2(max_blocks)) * self.block_size
+
+    def _prefill_bucket(self, chunk_len: int) -> int:
+        return min(
+            next_pow2(max(chunk_len, 8)),
+            next_pow2(self.config.max_prefill_chunk),
+        )
+
+    # -- jitted step builders ---------------------------------------------
+    def _build_prefill(self, t_pad: int, c_pad: int):
+        mc = self.model_config
+        scale = self._scale
+
+        def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
+            k_ctx = kc[l, gather_slots]  # (c, nkv, d)
+            v_ctx = vc[l, gather_slots]
+            return xla_attn.context_attention_prefill(
+                q, k_ctx, v_ctx, q_positions, total_len, scale
+            )
+
+        def step(params, kc, vc, tokens, positions, write_slots,
+                 gather_slots, total_len, last_row):
+            attn_fn = functools.partial(
+                attn,
+                gather_slots=gather_slots,
+                q_positions=positions,
+                total_len=total_len,
+            )
+            logits, kc, vc = llama.forward(
+                mc, params, tokens, positions, kc, vc, write_slots,
+                lambda q, l, k, v: attn_fn(q, l, k, v),
+                logits_rows=last_row[None],
+            )
+            return logits[0], kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_decode(self, b: int, c_pad: int):
+        mc = self.model_config
+        scale = self._scale
+
+        def attn(q, l, kc, vc, gather_slots, context_lens):
+            k_ctx = kc[l, gather_slots]  # (b, c, nkv, d)
+            v_ctx = vc[l, gather_slots]
+            return xla_attn.context_attention_decode(
+                q, k_ctx, v_ctx, context_lens, scale
+            )
+
+        def step(params, kc, vc, tokens, positions, write_slots,
+                 gather_slots, context_lens):
+            attn_fn = functools.partial(
+                attn, gather_slots=gather_slots, context_lens=context_lens
+            )
+            logits, kc, vc = llama.forward(
+                mc, params, tokens, positions, kc, vc, write_slots,
+                lambda q, l, k, v: attn_fn(q, l, k, v),
+                logits_rows=jnp.arange(b),
+            )
+            return logits, kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    # -- host-side helpers -------------------------------------------------
+    def _slots_for_positions(
+        self, block_table: list[int], positions: np.ndarray
+    ) -> np.ndarray:
+        """Cache slots for absolute positions; positions beyond the table
+        map to the trash slot 0."""
+        bt = np.asarray(block_table, dtype=np.int32)
+        max_pos = len(bt) * self.block_size
+        safe = np.clip(positions, 0, max_pos - 1) if len(bt) else positions * 0
+        slots = (
+            bt[safe // self.block_size] * self.block_size
+            + safe % self.block_size
+        ).astype(np.int32)
+        slots[positions >= max_pos] = 0
+        slots[positions < 0] = 0
+        return slots
+
+    def _gather_slots_for_table(
+        self, block_table: list[int], c_pad: int
+    ) -> np.ndarray:
+        nb = c_pad // self.block_size
+        bt = np.zeros((nb,), dtype=np.int32)
+        use = min(len(block_table), nb)
+        if use:
+            bt[:use] = np.asarray(block_table[:use], dtype=np.int32)
+        offs = np.arange(self.block_size, dtype=np.int32)
+        return (bt[:, None] * self.block_size + offs).reshape(-1)
+
+    # -- public API --------------------------------------------------------
+    def prefill(
+        self,
+        token_ids: list[int],
+        start_pos: int,
+        block_table: list[int],
+        total_len: int,
+    ) -> jax.Array:
+        """Run one prefill chunk; returns fp32 logits (vocab,) for the chunk's
+        last *actual* token. K/V for the chunk is written into the cache."""
+        t = len(token_ids)
+        t_pad = self._prefill_bucket(t)
+        c_pad = self._ctx_bucket(total_len)
+
+        tokens = np.zeros((t_pad,), dtype=np.int32)
+        tokens[:t] = token_ids
+        positions = np.full((t_pad,), -1, dtype=np.int32)
+        positions[:t] = np.arange(start_pos, start_pos + t)
+        write_slots = self._slots_for_positions(block_table, positions)
+        # padded rows: position -1 -> rope of position 0, write to trash
+        positions_dev = np.where(positions < 0, 0, positions).astype(np.int32)
+        gather_slots = self._gather_slots_for_table(block_table, c_pad)
+
+        key = (t_pad, c_pad)
+        if key not in self._prefill_fns:
+            logger.info("compiling prefill step t=%d ctx=%d", t_pad, c_pad)
+            self._prefill_fns[key] = self._build_prefill(t_pad, c_pad)
+        fn = self._prefill_fns[key]
+        logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions_dev),
+            jnp.asarray(write_slots),
+            jnp.asarray(gather_slots),
+            jnp.int32(total_len),
+            jnp.int32(t - 1),
+        )
+        return logits
+
+    def decode(
+        self,
+        token_ids: list[int],
+        positions: list[int],
+        block_tables: list[list[int]],
+        context_lens: list[int],
+    ) -> jax.Array:
+        """One decode step for a batch; returns fp32 logits (b, vocab) where
+        rows beyond len(token_ids) are padded lanes."""
+        b_actual = len(token_ids)
+        b = self.config.max_num_seqs
+        c_pad = self._ctx_bucket(max(context_lens))
+
+        tokens = np.zeros((b,), dtype=np.int32)
+        tokens[:b_actual] = token_ids
+        pos = np.zeros((b,), dtype=np.int32)
+        pos[:b_actual] = positions
+        ctx = np.ones((b,), dtype=np.int32)
+        ctx[:b_actual] = context_lens
+
+        write_slots = np.zeros((b,), dtype=np.int32)
+        gather = np.zeros((b, c_pad), dtype=np.int32)
+        for i in range(b_actual):
+            write_slots[i] = self._slots_for_positions(
+                block_tables[i], np.asarray([positions[i]])
+            )[0]
+            gather[i] = self._gather_slots_for_table(block_tables[i], c_pad)
+
+        key = (b, c_pad)
+        if key not in self._decode_fns:
+            logger.info("compiling decode step b=%d ctx=%d", b, c_pad)
+            self._decode_fns[key] = self._build_decode(b, c_pad)
+        fn = self._decode_fns[key]
+        logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(pos),
+            jnp.asarray(write_slots),
+            jnp.asarray(gather),
+            jnp.asarray(ctx),
+        )
+        return logits
+
+    # -- cache import/export (KV offload + PD transfer tiers) -------------
+    def export_blocks(self, block_ids: list[int]) -> np.ndarray:
+        """Device->host copy of whole KV blocks.
+
+        Returns (2, num_layers, len(block_ids), block_size, nkv, d)."""
+        idx = jnp.asarray(
+            xla_attn.block_table_slots(
+                jnp.asarray(block_ids, jnp.int32), self.block_size
+            )
+        )
+        k = self.k_cache[:, idx]  # (L, n*bs, nkv, d)
+        v = self.v_cache[:, idx]
+        n = len(block_ids)
+        shape = (
+            self.model_config.num_layers, n, self.block_size,
+            self.model_config.num_kv_heads, self.model_config.head_dim,
+        )
+        return np.stack(
+            [np.asarray(k).reshape(shape), np.asarray(v).reshape(shape)]
+        )
+
+    def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
+        """Host->device restore of whole KV blocks (inverse of export)."""
+        idx = jnp.asarray(
+            xla_attn.block_table_slots(
+                jnp.asarray(block_ids, jnp.int32), self.block_size
+            )
+        )
+        L = self.model_config.num_layers
+        flat = data.reshape(2, L, -1, *data.shape[-2:])
+        self.k_cache = self.k_cache.at[:, idx].set(
+            jnp.asarray(flat[0], self.cache_dtype)
+        )
+        self.v_cache = self.v_cache.at[:, idx].set(
+            jnp.asarray(flat[1], self.cache_dtype)
+        )

@@ -1,0 +1,43 @@
+"""Engine step outputs returned to the serving layer."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from production_stack_tpu.engine.sequence import RequestMetrics
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt_token_ids: list[int]
+    token_ids: list[int]  # all output tokens so far
+    new_token_ids: list[int]  # tokens produced this step
+    text: str  # full output text so far
+    delta_text: str  # text produced this step
+    finished: bool
+    finish_reason: str | None
+    metrics: RequestMetrics
+    num_cached_tokens: int = 0
+
+
+@dataclass
+class EngineStatsSnapshot:
+    """Feeds the Prometheus /metrics contract the router scrapes
+    (reference: src/vllm_router/stats/engine_stats.py:63-76)."""
+
+    num_running: int = 0
+    num_waiting: int = 0
+    kv_usage: float = 0.0  # -> vllm:gpu_cache_usage_perc
+    prefix_cache_queries: int = 0  # -> vllm:gpu_prefix_cache_queries_total
+    prefix_cache_hits: int = 0  # -> vllm:gpu_prefix_cache_hits_total
+    prompt_tokens_total: int = 0
+    generation_tokens_total: int = 0
+    num_preemptions_total: int = 0
+    requests_finished_total: int = 0
+
+    @property
+    def prefix_cache_hit_rate(self) -> float:
+        if self.prefix_cache_queries == 0:
+            return 0.0
+        return self.prefix_cache_hits / self.prefix_cache_queries

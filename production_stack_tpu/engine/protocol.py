@@ -1,0 +1,158 @@
+"""OpenAI-compatible request parsing and response building.
+
+Tolerant dict-based parsing (the reference uses extra-field-tolerant pydantic
+models, reference: src/vllm_router/protocols.py:11) — unknown fields are
+ignored, so clients written for OpenAI/vLLM work unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from production_stack_tpu.engine.sampling_params import SamplingParams
+
+
+class ProtocolError(ValueError):
+    pass
+
+
+def make_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def sampling_params_from_request(body: dict) -> SamplingParams:
+    try:
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        max_tokens = body.get("max_tokens", body.get("max_completion_tokens"))
+        if max_tokens is None:
+            max_tokens = 128
+        if int(body.get("n", 1)) != 1:
+            raise ProtocolError(
+                "n>1 (parallel sampling) is not supported yet"
+            )
+        return SamplingParams(
+            max_tokens=int(max_tokens),
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", -1)),
+            n=int(body.get("n", 1)),
+            stop=stop,
+            stop_token_ids=list(body.get("stop_token_ids", [])),
+            ignore_eos=bool(body.get("ignore_eos", False)),
+            seed=body.get("seed"),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+            min_tokens=int(body.get("min_tokens", 0)),
+        )
+    except (TypeError, ValueError) as e:
+        raise ProtocolError(str(e)) from e
+
+
+def error_json(message: str, err_type: str = "invalid_request_error",
+               code: int = 400) -> dict:
+    return {
+        "error": {"message": message, "type": err_type, "param": None,
+                  "code": code}
+    }
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+# -- completions -----------------------------------------------------------
+def completion_response(
+    request_id: str, model: str, text: str, finish_reason: str | None,
+    prompt_tokens: int, completion_tokens: int,
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "text": text,
+                "logprobs": None,
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage_dict(prompt_tokens, completion_tokens),
+    }
+
+
+def completion_chunk(
+    request_id: str, model: str, text: str, finish_reason: str | None
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "text": text,
+                "logprobs": None,
+                "finish_reason": finish_reason,
+            }
+        ],
+    }
+
+
+# -- chat completions ------------------------------------------------------
+def chat_response(
+    request_id: str, model: str, text: str, finish_reason: str | None,
+    prompt_tokens: int, completion_tokens: int,
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "logprobs": None,
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage_dict(prompt_tokens, completion_tokens),
+    }
+
+
+def chat_chunk(
+    request_id: str, model: str, delta: dict, finish_reason: str | None
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {"index": 0, "delta": delta, "finish_reason": finish_reason}
+        ],
+    }
+
+
+def model_card(name: str, root: str | None = None) -> dict:
+    return {
+        "id": name,
+        "object": "model",
+        "created": int(time.time()),
+        "owned_by": "production-stack-tpu",
+        "root": root or name,
+        "parent": None,
+        "max_model_len": None,
+        "permission": [],
+    }

@@ -1,0 +1,163 @@
+"""Request/sequence state tracked by the scheduler."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+
+from production_stack_tpu.engine.sampling_params import SamplingParams
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED_STOPPED = "stop"
+    FINISHED_LENGTH = "length"
+    FINISHED_ABORTED = "abort"
+
+    @property
+    def finished(self) -> bool:
+        return self in (
+            SequenceStatus.FINISHED_STOPPED,
+            SequenceStatus.FINISHED_LENGTH,
+            SequenceStatus.FINISHED_ABORTED,
+        )
+
+
+@dataclass
+class RequestMetrics:
+    arrival_time: float = field(default_factory=time.time)
+    first_scheduled_time: float | None = None
+    first_token_time: float | None = None
+    finished_time: float | None = None
+    num_cached_prompt_tokens: int = 0
+    num_preemptions: int = 0
+
+
+class Sequence:
+    """One request's sequence (n=1; parallel sampling fans out to n Sequences)."""
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt_token_ids: list[int],
+        sampling_params: SamplingParams,
+        eos_token_id: int | None,
+        arrival_time: float | None = None,
+        lora_name: str | None = None,
+    ):
+        self.request_id = request_id
+        self.prompt_token_ids = list(prompt_token_ids)
+        # preemption-by-recompute folds generated tokens into the prompt;
+        # orig_prompt_len keeps the user-visible prompt/output boundary
+        self.orig_prompt_len = len(self.prompt_token_ids)
+        self.output_token_ids: list[int] = []
+        self.sampling_params = sampling_params
+        self.eos_token_id = eos_token_id
+        self.lora_name = lora_name
+        self.status = SequenceStatus.WAITING
+        self.metrics = RequestMetrics()
+        if arrival_time is not None:
+            self.metrics.arrival_time = arrival_time
+
+        # paged-KV state (owned by the block manager)
+        self.block_table: list[int] = []
+        # tokens whose K/V are already in the cache (prefix-cache hits count)
+        self.num_computed_tokens = 0
+
+        # incremental prefix-cache hashing state (chain hashes of the
+        # sequence's full blocks registered so far)
+        self.block_hashes: list[int] = []
+
+        # detokenization state
+        self.output_text = ""
+        self._stopped_by: str | None = None
+
+    # -- lengths ----------------------------------------------------------
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def generated_token_ids(self) -> list[int]:
+        """All tokens generated for this request, including any folded into
+        the prompt by preemption-recompute."""
+        return self.prompt_token_ids[self.orig_prompt_len :] + (
+            self.output_token_ids
+        )
+
+    @property
+    def prefill_done(self) -> bool:
+        """All prompt tokens have K/V in cache and first logits were produced."""
+        return self.num_computed_tokens >= self.num_prompt_tokens
+
+    @property
+    def num_uncomputed_prompt_tokens(self) -> int:
+        return max(0, self.num_prompt_tokens - self.num_computed_tokens)
+
+    @property
+    def finished(self) -> bool:
+        return self.status.finished
+
+    @property
+    def finish_reason(self) -> str | None:
+        if not self.status.finished:
+            return None
+        return self.status.value
+
+    def append_token(self, token_id: int) -> None:
+        """Append a sampled token. Its K/V is computed by the decode step
+        that later consumes it, so num_computed_tokens is NOT advanced here
+        (invariant during decode: num_computed_tokens == num_tokens - 1)."""
+        self.output_token_ids.append(token_id)
+
+    def check_stop(self, new_text: str | None = None) -> None:
+        """Update status if a stop condition fired on the latest token."""
+        sp = self.sampling_params
+        n_generated = len(self.generated_token_ids)
+        if n_generated >= sp.max_tokens:
+            self.status = SequenceStatus.FINISHED_LENGTH
+            return
+        if n_generated < sp.min_tokens:
+            return
+        last = self.output_token_ids[-1]
+        if not sp.ignore_eos and self.eos_token_id is not None:
+            if last == self.eos_token_id:
+                self.status = SequenceStatus.FINISHED_STOPPED
+                return
+        if last in sp.stop_token_ids:
+            self.status = SequenceStatus.FINISHED_STOPPED
+            return
+        if sp.stop and new_text is not None:
+            for s in sp.stop:
+                idx = self.output_text.find(s)
+                if idx != -1:
+                    self.output_text = self.output_text[:idx]
+                    self._stopped_by = s
+                    self.status = SequenceStatus.FINISHED_STOPPED
+                    return
+
+    def reset_for_recompute(self) -> None:
+        """Preemption by recomputation: drop cache state, keep tokens.
+
+        Generated tokens are folded into the prompt so the whole sequence is
+        re-prefilled on resumption (same trick vLLM uses for recompute).
+        """
+        self.prompt_token_ids = self.all_token_ids
+        self.output_token_ids = []
+        # keep output_text; new tokens will continue appending
+        self.num_computed_tokens = 0
+        self.block_table = []
+        self.block_hashes = []
+        self.status = SequenceStatus.PREEMPTED
+        self.metrics.num_preemptions += 1

@@ -1,0 +1,404 @@
+"""OpenAI-compatible HTTP server for the TPU engine (aiohttp.web).
+
+API surface parity with the vLLM engine pods the reference deploys
+(reference: helm/templates/deployment-vllm-multi.yaml:104-126 runs
+`vllm serve`; the router proxies these endpoints, reference:
+src/vllm_router/routers/main_router.py:45-231):
+
+  POST /v1/completions            streaming + blocking
+  POST /v1/chat/completions       streaming + blocking
+  GET  /v1/models
+  POST /tokenize /detokenize
+  GET  /health /version /metrics
+  POST /sleep /wake_up  GET /is_sleeping
+  POST /v1/load_lora_adapter /v1/unload_lora_adapter
+
+The Prometheus /metrics endpoint exports the exact vllm:* gauge names the
+router's stats scraper parses (see engine/metrics.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+from prometheus_client import CollectorRegistry, generate_latest
+
+import production_stack_tpu
+from production_stack_tpu.engine.async_engine import (
+    AsyncLLMEngine,
+    EngineSleepingError,
+)
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.metrics import EngineMetrics
+from production_stack_tpu.engine import protocol as proto
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+STATS_UPDATE_INTERVAL_S = 1.0
+
+
+class EngineServer:
+    def __init__(self, config: EngineConfig, params: dict | None = None):
+        self.config = config
+        self.model_name = config.served_model_name or config.model
+        self.engine = AsyncLLMEngine(config, params=params)
+        self.registry = CollectorRegistry()
+        self.metrics = EngineMetrics(self.model_name, registry=self.registry)
+        self.lora_adapters: dict[str, str] = {}  # name -> path
+        self._stats_task: asyncio.Task | None = None
+        self.app = self._build_app()
+
+    # -- app wiring --------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 2**20)
+        r = app.router
+        r.add_post("/v1/completions", self.handle_completions)
+        r.add_post("/v1/chat/completions", self.handle_chat)
+        r.add_get("/v1/models", self.handle_models)
+        r.add_post("/tokenize", self.handle_tokenize)
+        r.add_post("/detokenize", self.handle_detokenize)
+        r.add_get("/health", self.handle_health)
+        r.add_get("/version", self.handle_version)
+        r.add_get("/metrics", self.handle_metrics)
+        r.add_post("/sleep", self.handle_sleep)
+        r.add_post("/wake_up", self.handle_wake)
+        r.add_get("/is_sleeping", self.handle_is_sleeping)
+        r.add_post("/v1/load_lora_adapter", self.handle_load_lora)
+        r.add_post("/v1/unload_lora_adapter", self.handle_unload_lora)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        self.engine.start(asyncio.get_running_loop())
+        self._stats_task = asyncio.create_task(self._stats_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._stats_task:
+            self._stats_task.cancel()
+        self.engine.shutdown()
+
+    async def _stats_loop(self) -> None:
+        while True:
+            try:
+                self.metrics.update_from_snapshot(self.engine.stats())
+            except Exception:  # pragma: no cover
+                logger.exception("stats update failed")
+            await asyncio.sleep(STATS_UPDATE_INTERVAL_S)
+
+    # -- helpers -----------------------------------------------------------
+    def _check_model(self, body: dict) -> web.Response | None:
+        model = body.get("model")
+        if model and model not in (self.model_name, self.config.model) and (
+            model not in self.lora_adapters
+        ):
+            return web.json_response(
+                proto.error_json(f"model {model!r} not found", code=404),
+                status=404,
+            )
+        return None
+
+    def _observe_finish(self, out, arrival: float) -> None:
+        m = out.metrics
+        ttft = (
+            m.first_token_time - arrival
+            if m.first_token_time is not None
+            else None
+        )
+        e2e = time.time() - arrival
+        self.metrics.observe_request(
+            out.finish_reason or "stop", ttft, e2e, len(out.token_ids)
+        )
+
+    # -- completions -------------------------------------------------------
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                proto.error_json("invalid JSON"), status=400
+            )
+        if err := self._check_model(body):
+            return err
+        prompt = body.get("prompt")
+        if prompt is None:
+            return web.json_response(
+                proto.error_json("missing 'prompt'"), status=400
+            )
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+            prompt = prompt[0]  # single-prompt shortcut; batch via router
+        try:
+            sp = proto.sampling_params_from_request(body)
+        except proto.ProtocolError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+
+        request_id = proto.make_id("cmpl")
+        kwargs: dict = {}
+        if isinstance(prompt, list):
+            kwargs["prompt_token_ids"] = prompt
+        else:
+            kwargs["prompt"] = prompt
+        lora_name = body.get("model") if (
+            body.get("model") in self.lora_adapters) else None
+
+        if body.get("stream"):
+            return await self._stream_completion(
+                request, request_id, sp, kwargs, lora_name, chat=False
+            )
+        return await self._blocking_completion(
+            request_id, sp, kwargs, lora_name, chat=False,
+            model=body.get("model") or self.model_name,
+        )
+
+    # -- chat --------------------------------------------------------------
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                proto.error_json("invalid JSON"), status=400
+            )
+        if err := self._check_model(body):
+            return err
+        messages = body.get("messages")
+        if not messages:
+            return web.json_response(
+                proto.error_json("missing 'messages'"), status=400
+            )
+        try:
+            prompt = self.engine.tokenizer.apply_chat_template(messages)
+            sp = proto.sampling_params_from_request(body)
+        except proto.ProtocolError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+        except Exception as e:
+            return web.json_response(
+                proto.error_json(f"chat template error: {e}"), status=400
+            )
+
+        request_id = proto.make_id("chatcmpl")
+        lora_name = body.get("model") if (
+            body.get("model") in self.lora_adapters) else None
+        if body.get("stream"):
+            return await self._stream_completion(
+                request, request_id, sp, {"prompt": prompt}, lora_name,
+                chat=True,
+            )
+        return await self._blocking_completion(
+            request_id, sp, {"prompt": prompt}, lora_name, chat=True,
+            model=body.get("model") or self.model_name,
+        )
+
+    # -- shared generation paths ------------------------------------------
+    async def _blocking_completion(
+        self, request_id: str, sp: SamplingParams, kwargs: dict,
+        lora_name: str | None, chat: bool, model: str,
+    ) -> web.Response:
+        arrival = time.time()
+        final = None
+        try:
+            async for out in self.engine.generate(
+                request_id, sampling_params=sp, lora_name=lora_name, **kwargs
+            ):
+                final = out
+        except EngineSleepingError:
+            return web.json_response(
+                proto.error_json("engine is sleeping", "service_unavailable",
+                                 503),
+                status=503,
+            )
+        except ValueError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+        assert final is not None
+        self._observe_finish(final, arrival)
+        build = proto.chat_response if chat else proto.completion_response
+        return web.json_response(
+            build(
+                request_id, model, final.text, final.finish_reason,
+                len(final.prompt_token_ids), len(final.token_ids),
+            )
+        )
+
+    async def _stream_completion(
+        self, request: web.Request, request_id: str, sp: SamplingParams,
+        kwargs: dict, lora_name: str | None, chat: bool,
+    ) -> web.StreamResponse:
+        arrival = time.time()
+        model = self.model_name
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+
+        async def send(data: dict) -> None:
+            await resp.write(
+                b"data: " + json.dumps(data).encode() + b"\n\n"
+            )
+
+        try:
+            if chat:
+                await send(
+                    proto.chat_chunk(
+                        request_id, model, {"role": "assistant"}, None
+                    )
+                )
+            final = None
+            async for out in self.engine.generate(
+                request_id, sampling_params=sp, lora_name=lora_name, **kwargs
+            ):
+                final = out
+                if out.delta_text:
+                    if chat:
+                        await send(
+                            proto.chat_chunk(
+                                request_id, model,
+                                {"content": out.delta_text}, None,
+                            )
+                        )
+                    else:
+                        await send(
+                            proto.completion_chunk(
+                                request_id, model, out.delta_text, None
+                            )
+                        )
+            if final is not None:
+                self._observe_finish(final, arrival)
+                if chat:
+                    await send(
+                        proto.chat_chunk(
+                            request_id, model, {}, final.finish_reason
+                        )
+                    )
+                else:
+                    await send(
+                        proto.completion_chunk(
+                            request_id, model, "", final.finish_reason
+                        )
+                    )
+            await resp.write(b"data: [DONE]\n\n")
+        except EngineSleepingError:
+            await resp.write(
+                b"data: "
+                + json.dumps(proto.error_json("engine is sleeping")).encode()
+                + b"\n\n"
+            )
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("client disconnected from %s", request_id)
+        await resp.write_eof()
+        return resp
+
+    # -- misc endpoints ----------------------------------------------------
+    async def handle_models(self, request: web.Request) -> web.Response:
+        cards = [proto.model_card(self.model_name)]
+        cards += [
+            proto.model_card(name, root=path)
+            for name, path in self.lora_adapters.items()
+        ]
+        return web.json_response({"object": "list", "data": cards})
+
+    async def handle_tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if "prompt" in body:
+            text = body["prompt"]
+        elif "messages" in body:
+            text = self.engine.tokenizer.apply_chat_template(body["messages"])
+        else:
+            return web.json_response(
+                proto.error_json("missing 'prompt' or 'messages'"), status=400
+            )
+        ids = self.engine.tokenizer.encode(text)
+        return web.json_response(
+            {"tokens": ids, "count": len(ids),
+             "max_model_len": self.config.resolved_max_model_len()}
+        )
+
+    async def handle_detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        tokens = body.get("tokens")
+        if tokens is None:
+            return web.json_response(
+                proto.error_json("missing 'tokens'"), status=400
+            )
+        return web.json_response(
+            {"prompt": self.engine.tokenizer.decode(tokens)}
+        )
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"version": production_stack_tpu.__version__}
+        )
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        self.metrics.update_from_snapshot(self.engine.stats())
+        return web.Response(
+            body=generate_latest(self.registry),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    # -- sleep/wake (reference: service_discovery.py:414-441 probes these) -
+    async def handle_sleep(self, request: web.Request) -> web.Response:
+        level = int(request.query.get("level", "1"))
+        self.engine.sleep(level)
+        return web.json_response({"status": "sleeping", "level": level})
+
+    async def handle_wake(self, request: web.Request) -> web.Response:
+        self.engine.wake_up()
+        return web.json_response({"status": "awake"})
+
+    async def handle_is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.engine.is_sleeping()})
+
+    # -- LoRA hot-load (reference: loraadapter_controller.go:582-598 POSTs) -
+    async def handle_load_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        path = body.get("lora_path")
+        if not name or not path:
+            return web.json_response(
+                proto.error_json("need lora_name and lora_path"), status=400
+            )
+        try:
+            with self.engine._lock:
+                self.engine.engine.load_lora(name, path)
+        except Exception as e:
+            return web.json_response(
+                proto.error_json(f"failed to load adapter: {e}", code=500),
+                status=500,
+            )
+        self.lora_adapters[name] = path
+        logger.info("loaded LoRA adapter %s from %s", name, path)
+        return web.json_response({"status": "success"})
+
+    async def handle_unload_lora(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("lora_name")
+        if name not in self.lora_adapters:
+            return web.json_response(
+                proto.error_json(f"adapter {name!r} not loaded", code=404),
+                status=404,
+            )
+        with self.engine._lock:
+            self.engine.engine.unload_lora(name)
+        del self.lora_adapters[name]
+        return web.json_response({"status": "success"})
+
+    # -- run ---------------------------------------------------------------
+    def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
+        logger.info(
+            "engine server for %s listening on %s:%d",
+            self.model_name, host, port,
+        )
+        web.run_app(self.app, host=host, port=port, print=None)

@@ -1,0 +1,5 @@
+"""Model families as pure-JAX functional modules (params are pytrees)."""
+
+from production_stack_tpu.models.config import ModelConfig, get_model_config
+
+__all__ = ["ModelConfig", "get_model_config"]

@@ -1,0 +1,153 @@
+"""Llama-class decoder as a pure-JAX functional module over a paged KV cache.
+
+Design (TPU-first, not a torch translation):
+- params are a pytree with layer weights **stacked on a leading layer axis**;
+  the forward pass is a single `lax.scan` over layers, so XLA traces one layer
+  and the compiled program is O(1) in depth (fast compiles, uniform MXU tiling)
+- the KV cache for all layers is carried through the scan and updated with
+  scatter writes (donated at the jit boundary -> in-place in HBM)
+- attention is injected as a callback so the same forward serves prefill and
+  decode (the model runner chooses gather pattern + masking), and so the
+  Pallas kernel can be swapped in without touching model code
+- everything is shape-static; bucketing happens in the model runner
+
+Covers Llama 2/3/3.x, Mistral, Qwen2 (qkv_bias), TinyLlama.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.layers import (
+    apply_rope,
+    rms_norm,
+    rope_cos_sin,
+    swiglu,
+)
+
+# attn_fn(q_rope, layer_idx, k_cache, v_cache) -> attn_out
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> dict:
+    """Random-init parameters (scaled normal), layer weights stacked on axis 0."""
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    L = cfg.num_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        scale = fan_in**-0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            dtype
+        )
+
+    layers = {
+        "attn_norm": jnp.ones((L, h), dtype),
+        "mlp_norm": jnp.ones((L, h), dtype),
+        "wq": w(next(keys), (L, h, cfg.q_size), h),
+        "wk": w(next(keys), (L, h, cfg.kv_size), h),
+        "wv": w(next(keys), (L, h, cfg.kv_size), h),
+        "wo": w(next(keys), (L, cfg.q_size, h), cfg.q_size),
+        "w_gate": w(next(keys), (L, h, i), h),
+        "w_up": w(next(keys), (L, h, i), h),
+        "w_down": w(next(keys), (L, i, h), i),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_size), dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_size), dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_size), dtype)
+
+    params = {
+        "embed": w(next(keys), (v, h), h),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (h, v), h)
+    return params
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict,
+    token_ids: jax.Array,  # (n,) int32
+    positions: jax.Array,  # (n,) int32 absolute positions
+    k_cache: jax.Array,  # (L, num_slots, nkv, d)
+    v_cache: jax.Array,
+    write_slots: jax.Array,  # (n,) int32 cache rows for the new tokens
+    attn_fn: AttnFn,
+    logits_rows: jax.Array,  # (r,) int32 rows of h to project to logits
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run the decoder over n tokens; returns (logits[r, V] fp32, k_cache, v_cache).
+
+    The caller is responsible for the attention gather pattern via attn_fn;
+    this function writes the new tokens' K/V into the cache *before* calling
+    attn_fn, so attention sees them.
+    """
+    n = token_ids.shape[0]
+    dtype = params["embed"].dtype
+    cache_dtype = k_cache.dtype
+    scale = cfg.head_dim**-0.5
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    h = params["embed"][token_ids].astype(dtype)
+
+    def layer(carry, xs):
+        h, kc, vc = carry
+        lp, l = xs
+
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(x, lp["wq"], preferred_element_type=jnp.float32)
+        k = jnp.dot(x, lp["wk"], preferred_element_type=jnp.float32)
+        v = jnp.dot(x, lp["wv"], preferred_element_type=jnp.float32)
+        if cfg.qkv_bias:
+            q = q + lp["bq"].astype(jnp.float32)
+            k = k + lp["bk"].astype(jnp.float32)
+            v = v + lp["bv"].astype(jnp.float32)
+        q = q.astype(dtype).reshape(n, cfg.num_heads, cfg.head_dim)
+        k = k.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
+        v = v.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, k, cos, sin)
+
+        kc = kc.at[l, write_slots].set(k.astype(cache_dtype))
+        vc = vc.at[l, write_slots].set(v.astype(cache_dtype))
+
+        attn_out = attn_fn(q, l, kc, vc)  # (n, nq, d)
+        h = h + jnp.dot(
+            attn_out.reshape(n, cfg.q_size).astype(dtype),
+            lp["wo"],
+            preferred_element_type=jnp.float32,
+        ).astype(dtype)
+
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (h, kc, vc), None
+
+    (h, k_cache, v_cache), _ = jax.lax.scan(
+        layer,
+        (h, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers)),
+    )
+
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h_sel = h[logits_rows]  # (r, hidden)
+    lm_head = (
+        params["embed"].T
+        if cfg.tie_word_embeddings
+        else params["lm_head"]
+    )
+    logits = jnp.dot(
+        h_sel, lm_head, preferred_element_type=jnp.float32
+    )
+    return logits, k_cache, v_cache
+
+
+# `scale` for attn_fn implementations; re-exported for the runner.
+def attention_scale(cfg: ModelConfig) -> float:
+    return cfg.head_dim**-0.5

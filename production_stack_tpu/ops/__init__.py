@@ -1,0 +1,1 @@
+"""Compute ops: XLA-expressed layers + Pallas TPU kernels for the hot paths."""

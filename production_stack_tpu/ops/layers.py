@@ -1,0 +1,76 @@
+"""Elementwise / normalization / rotary ops, expressed for XLA fusion.
+
+These are deliberately plain jnp: XLA fuses RMSNorm and RoPE into the
+surrounding matmuls on TPU, so Pallas is reserved for the one op XLA cannot
+schedule well (paged attention over a block table, see ops/paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with float32 accumulation, cast back to input dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_cos_sin(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions. Returns (N, head_dim) each.
+
+    HF-Llama convention: frequencies over the first half of the head dim,
+    duplicated across halves (rotate-half formulation).
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta
+        ** (jnp.arange(0, half, dtype=jnp.float32) * (2.0 / head_dim))
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)], axis=-1)
+    sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)], axis=-1)
+    return cos, sin
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    q: jax.Array,
+    k: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Apply rotary embeddings.
+
+    q: (N, num_heads, head_dim), k: (N, num_kv_heads, head_dim),
+    cos/sin: (N, head_dim).
+    """
+    cos = cos[:, None, :].astype(jnp.float32)
+    sin = sin[:, None, :].astype(jnp.float32)
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        return (xf * cos + _rotate_half(xf) * sin).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: (silu(x @ w_gate) * (x @ w_up)) @ w_down."""
+    gate = jax.nn.silu(jnp.dot(x, w_gate, preferred_element_type=jnp.float32))
+    up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
+    return jnp.dot(
+        (gate * up).astype(x.dtype), w_down,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)

@@ -1,0 +1,8 @@
+"""Request router: the L5/L6 layer of the stack.
+
+Async aiohttp service that discovers serving-engine endpoints, scrapes their
+stats, routes OpenAI-compatible requests with pluggable algorithms, and
+proxies/streams responses. Capability parity with the reference router
+(reference: src/vllm_router/) — same HTTP surface, same routing algorithms,
+same Prometheus metrics names — built natively on asyncio/aiohttp.
+"""

@@ -1,0 +1,98 @@
+"""Router-side data models (endpoint info, request abstraction, OpenAI cards).
+
+Parity: reference src/vllm_router/protocols.py + the EndpointInfo/ModelInfo
+dataclasses in src/vllm_router/service_discovery.py:42-105.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ModelInfo:
+    id: str
+    object: str = "model"
+    created: int = field(default_factory=lambda: int(time.time()))
+    owned_by: str = "production-stack-tpu"
+    root: str | None = None
+    parent: str | None = None
+    is_adapter: bool = False
+
+    @staticmethod
+    def from_dict(d: dict) -> "ModelInfo":
+        return ModelInfo(
+            id=d.get("id", "unknown"),
+            created=d.get("created", int(time.time())),
+            owned_by=d.get("owned_by", "unknown"),
+            root=d.get("root"),
+            parent=d.get("parent"),
+            is_adapter=d.get("parent") is not None,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": self.object,
+            "created": self.created,
+            "owned_by": self.owned_by,
+            "root": self.root,
+            "parent": self.parent,
+        }
+
+
+@dataclass
+class EndpointInfo:
+    """One serving-engine endpoint known to the router."""
+
+    url: str
+    model_names: list[str] = field(default_factory=list)
+    model_info: dict[str, ModelInfo] = field(default_factory=dict)
+    model_label: str | None = None  # helm modelSpec label (PD roles use it)
+    added_timestamp: float = field(default_factory=time.time)
+    sleep: bool = False
+    pod_name: str | None = None
+    namespace: str | None = None
+    # model aliases: alias -> canonical model name
+    aliases: dict[str, str] = field(default_factory=dict)
+
+    def serves_model(self, model: str) -> bool:
+        return model in self.model_names or model in self.aliases
+
+
+@dataclass
+class RouterRequest:
+    """Minimal request view the routing algorithms need."""
+
+    headers: dict[str, str]
+    body: dict[str, Any]
+    endpoint: str  # HTTP path, e.g. /v1/chat/completions
+
+    @property
+    def model(self) -> str | None:
+        return self.body.get("model")
+
+    def session_id(self, session_key: str | None) -> str | None:
+        if not session_key:
+            return None
+        return self.headers.get(session_key) or self.body.get(session_key)
+
+    def request_text(self) -> str:
+        """Flatten the prompt/messages for prefix matching."""
+        body = self.body
+        if "prompt" in body:
+            p = body["prompt"]
+            return p if isinstance(p, str) else str(p)
+        if "messages" in body:
+            parts = []
+            for m in body["messages"]:
+                c = m.get("content", "")
+                if isinstance(c, list):
+                    c = " ".join(
+                        x.get("text", "") for x in c if isinstance(x, dict)
+                    )
+                parts.append(f"{m.get('role')}: {c}")
+            return "\n".join(parts)
+        return ""

@@ -1,0 +1,391 @@
+"""Request routing algorithms.
+
+Parity with the reference's six algorithms behind one interface (reference:
+src/vllm_router/routers/routing_logic.py — RoutingLogic enum:77-84,
+RoundRobinRouter:155, SessionRouter:198, KvawareRouter:250,
+PrefixAwareRouter:379, DisaggregatedPrefillRouter:432, TtftRouter:475), with
+the KV-aware path speaking to OUR KV controller (production_stack_tpu.kv) —
+the TPU-native stand-in for the LMCache controller the reference imports.
+
+All algorithms are async; route_request returns the chosen engine URL.
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+
+from production_stack_tpu.router.hashring import HashRing
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.protocols import EndpointInfo, RouterRequest
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.request_stats import RequestStats
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class RoutingLogic(str, enum.Enum):
+    ROUND_ROBIN = "roundrobin"
+    SESSION_BASED = "session"
+    KVAWARE = "kvaware"
+    PREFIXAWARE = "prefixaware"
+    DISAGGREGATED_PREFILL = "disaggregated_prefill"
+    TTFT = "ttft"
+
+
+class RoutingInterface(abc.ABC):
+    @abc.abstractmethod
+    async def route_request(
+        self,
+        endpoints: list[EndpointInfo],
+        engine_stats: dict[str, EngineStats],
+        request_stats: dict[str, RequestStats],
+        request: RouterRequest,
+    ) -> str:
+        """Pick the engine URL to serve this request."""
+
+    async def start(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    def on_endpoint_removed(self, url: str) -> None:
+        pass
+
+    # -- shared helper: least-QPS endpoint (reference: routing_logic.py:88)
+    @staticmethod
+    def _qps_routing(
+        endpoints: list[EndpointInfo],
+        request_stats: dict[str, RequestStats],
+    ) -> str:
+        best_url, best_qps = None, float("inf")
+        for ep in endpoints:
+            qps = (
+                request_stats[ep.url].qps
+                if ep.url in request_stats
+                else 0.0
+            )
+            if qps < best_qps:
+                best_url, best_qps = ep.url, qps
+        assert best_url is not None
+        return best_url
+
+
+class RoundRobinRouter(RoutingInterface):
+    """reference: routing_logic.py:155"""
+
+    def __init__(self, **kwargs):
+        self._counter = 0
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        ordered = sorted(endpoints, key=lambda e: e.url)
+        url = ordered[self._counter % len(ordered)].url
+        self._counter += 1
+        return url
+
+
+class SessionRouter(RoutingInterface):
+    """Session-sticky via consistent hash ring with least-QPS fallback
+    (reference: routing_logic.py:198)."""
+
+    def __init__(self, session_key: str | None = "x-user-id", **kwargs):
+        self.session_key = session_key
+        self.ring = HashRing()
+
+    def _update_ring(self, endpoints: list[EndpointInfo]) -> None:
+        self.ring.set_nodes([e.url for e in endpoints])
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        session_id = request.session_id(self.session_key)
+        if session_id is None:
+            return self._qps_routing(endpoints, request_stats)
+        self._update_ring(endpoints)
+        url = self.ring.get_node(str(session_id))
+        assert url is not None
+        return url
+
+
+class KvawareRouter(RoutingInterface):
+    """Route to the engine already holding the longest KV prefix, via the KV
+    controller (reference: routing_logic.py:250 asks the LMCache controller;
+    ours asks production_stack_tpu.kv.controller)."""
+
+    def __init__(
+        self,
+        kv_controller_url: str = "127.0.0.1:9000",
+        session_key: str | None = "x-user-id",
+        kv_min_match_tokens: int = 1,
+        tokenizer=None,
+        **kwargs,
+    ):
+        self.controller_url = kv_controller_url
+        self.min_match = kv_min_match_tokens
+        self.fallback = SessionRouter(session_key)
+        self.tokenizer = tokenizer
+        self._client = None
+
+    async def start(self) -> None:
+        from production_stack_tpu.kv.controller import KVControllerClient
+
+        host, _, port = self.controller_url.rpartition(":")
+        self._client = KVControllerClient(host or "127.0.0.1", int(port))
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+    def _tokenize(self, text: str) -> list[int]:
+        if self.tokenizer is not None:
+            return self.tokenizer.encode(text)
+        # fallback: utf-8 bytes as token ids (matches engines running the
+        # hermetic byte tokenizer; real deployments pass a tokenizer)
+        return list(text.encode("utf-8"))
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        text = request.request_text()
+        if self._client is None or not text:
+            return await self.fallback.route_request(
+                endpoints, engine_stats, request_stats, request
+            )
+        try:
+            tokens = self._tokenize(text)
+            matches = await self._client.lookup(tokens)
+        except Exception as e:
+            logger.warning("kv controller lookup failed: %s", e)
+            return await self.fallback.route_request(
+                endpoints, engine_stats, request_stats, request
+            )
+        by_instance = {
+            inst: n for inst, n in matches.items() if n >= self.min_match
+        }
+        if by_instance:
+            # map instance ids -> endpoint urls (instance id is the engine's
+            # kv_instance_id; by convention it equals its url host:port or is
+            # advertised via /v1/models metadata)
+            urls = {e.url: e for e in endpoints}
+            best = sorted(
+                by_instance.items(), key=lambda kv: -kv[1]
+            )
+            for inst, _ in best:
+                for url in urls:
+                    if inst in url or inst == url:
+                        return url
+                if inst in urls:
+                    return inst
+        return await self.fallback.route_request(
+            endpoints, engine_stats, request_stats, request
+        )
+
+
+class PrefixAwareRouter(RoutingInterface):
+    """HashTrie longest-prefix-match routing (reference: routing_logic.py:379)."""
+
+    def __init__(self, prefix_chunk_size: int = 128, **kwargs):
+        self.trie = HashTrie(chunk_size=prefix_chunk_size)
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        text = request.request_text()
+        available = {e.url for e in endpoints}
+        matched_chars, candidates = await self.trie.longest_prefix_match(
+            text, available
+        )
+        if candidates and matched_chars > 0:
+            cand_eps = [e for e in endpoints if e.url in candidates]
+            url = self._qps_routing(cand_eps, request_stats)
+        else:
+            url = self._qps_routing(endpoints, request_stats)
+        await self.trie.insert(text, url)
+        return url
+
+    def on_endpoint_removed(self, url: str) -> None:
+        self.trie.remove_endpoint(url)
+
+
+class DisaggregatedPrefillRouter(RoutingInterface):
+    """Pick (prefiller, decoder) pair among labeled endpoints (reference:
+    routing_logic.py:432; the two-phase request flow lives in
+    services/request_service.py like the reference's request.py:349)."""
+
+    def __init__(self, **kwargs):
+        self._prefill_counter = 0
+        self._decode_counter = 0
+
+    def _select(self, endpoints: list[EndpointInfo], role: str,
+                counter: int) -> EndpointInfo:
+        labeled = [
+            e for e in endpoints
+            if (e.model_label or "").startswith(role)
+        ]
+        if not labeled:
+            raise RuntimeError(f"no {role} endpoints available")
+        return sorted(labeled, key=lambda e: e.url)[counter % len(labeled)]
+
+    async def route_prefill_decode(
+        self, endpoints: list[EndpointInfo]
+    ) -> tuple[str, str]:
+        p = self._select(endpoints, "prefill", self._prefill_counter)
+        d = self._select(endpoints, "decode", self._decode_counter)
+        self._prefill_counter += 1
+        self._decode_counter += 1
+        return p.url, d.url
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        # non-PD-aware callers get the decode endpoint
+        _, decode = await self.route_prefill_decode(endpoints)
+        return decode
+
+
+class TtftRouter(RoutingInterface):
+    """Estimate time-to-first-token per engine and pick the minimum
+    (reference: routing_logic.py:475, _estimate_ttft:612, transfer-time
+    correction:649). Estimate = queue_drain + uncomputed_tokens/prefill_tps,
+    where uncomputed tokens subtract the engine's prefix-cache hit rate."""
+
+    def __init__(
+        self,
+        kv_controller_url: str | None = None,
+        tokenizer=None,
+        **kwargs,
+    ):
+        self.tokenizer = tokenizer
+        self.kv_controller_url = kv_controller_url
+        self._kv_client = None
+        self.default_prefill_tps = 8000.0
+
+    async def start(self) -> None:
+        if self.kv_controller_url:
+            try:
+                from production_stack_tpu.kv.controller import (
+                    KVControllerClient,
+                )
+
+                host, _, port = self.kv_controller_url.rpartition(":")
+                self._kv_client = KVControllerClient(
+                    host or "127.0.0.1", int(port)
+                )
+            except Exception:  # pragma: no cover
+                self._kv_client = None
+
+    async def close(self) -> None:
+        if self._kv_client is not None:
+            await self._kv_client.close()
+
+    def _count_tokens(self, text: str) -> int:
+        if self.tokenizer is not None:
+            return len(self.tokenizer.encode(text))
+        return max(1, len(text) // 4)  # ~4 chars/token heuristic
+
+    async def _estimate_ttft(
+        self,
+        ep: EndpointInfo,
+        n_tokens: int,
+        matched_tokens: int,
+        engine_stats: dict[str, EngineStats],
+        request_stats: dict[str, RequestStats],
+    ) -> float:
+        rs = request_stats.get(ep.url)
+        es = engine_stats.get(ep.url)
+        tps = (
+            rs.prefill_tps
+            if rs and rs.prefill_tps > 0
+            else self.default_prefill_tps
+        )
+        backlog = rs.uncomputed_prefix_tokens if rs else 0
+        queued = es.num_queuing_requests if es else 0
+        new_tokens = max(1, n_tokens - matched_tokens)
+        # queued requests assumed to cost their average prompt; approximate
+        # with the backlog signal + a per-request constant
+        return (backlog + new_tokens) / tps + 0.05 * queued
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        text = request.request_text()
+        n_tokens = self._count_tokens(text)
+        matches: dict[str, int] = {}
+        if self._kv_client is not None and text:
+            try:
+                tokens = (
+                    self.tokenizer.encode(text)
+                    if self.tokenizer
+                    else list(text.encode("utf-8"))
+                )
+                raw = await self._kv_client.lookup(tokens)
+                for inst, n in raw.items():
+                    for ep in endpoints:
+                        if inst in ep.url or inst == ep.url:
+                            matches[ep.url] = n
+            except Exception:
+                pass
+        best_url, best_ttft = None, float("inf")
+        for ep in endpoints:
+            est = await self._estimate_ttft(
+                ep, n_tokens, matches.get(ep.url, 0),
+                engine_stats, request_stats,
+            )
+            if est < best_ttft:
+                best_url, best_ttft = ep.url, est
+        assert best_url is not None
+        return best_url
+
+
+# -- singleton lifecycle (reference: routing_logic.py:680-749) --------------
+_router: RoutingInterface | None = None
+
+_ROUTERS = {
+    RoutingLogic.ROUND_ROBIN: RoundRobinRouter,
+    RoutingLogic.SESSION_BASED: SessionRouter,
+    RoutingLogic.KVAWARE: KvawareRouter,
+    RoutingLogic.PREFIXAWARE: PrefixAwareRouter,
+    RoutingLogic.DISAGGREGATED_PREFILL: DisaggregatedPrefillRouter,
+    RoutingLogic.TTFT: TtftRouter,
+}
+
+
+def initialize_routing_logic(
+    routing_logic: RoutingLogic | str, **kwargs
+) -> RoutingInterface:
+    global _router
+    logic = RoutingLogic(routing_logic)
+    _router = _ROUTERS[logic](**kwargs)
+    logger.info("initialized routing logic: %s", logic.value)
+    return _router
+
+
+async def reconfigure_routing_logic(
+    routing_logic: RoutingLogic | str, **kwargs
+) -> RoutingInterface:
+    global _router
+    old = _router
+    new = initialize_routing_logic(routing_logic, **kwargs)
+    await new.start()
+    if old is not None:
+        await old.close()
+    return new
+
+
+def get_routing_logic() -> RoutingInterface:
+    if _router is None:
+        raise RuntimeError("routing logic not initialized")
+    return _router
+
+
+def _reset_routing_logic() -> None:
+    global _router
+    _router = None

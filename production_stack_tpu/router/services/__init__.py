@@ -1,0 +1,2 @@
+"""Router services: request proxying, rewriting, callbacks, metrics, batch,
+files."""

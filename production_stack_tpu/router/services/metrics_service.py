@@ -1,0 +1,49 @@
+"""Router-level Prometheus gauges.
+
+Parity: reference src/vllm_router/services/metrics_service/__init__.py:5-47 —
+the same `vllm:*` gauge names, labeled by server (engine URL), so the
+reference's Grafana dashboard panels read ours unchanged.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Gauge
+
+ROUTER_REGISTRY = CollectorRegistry()
+
+
+def _g(name: str, doc: str) -> Gauge:
+    return Gauge(name, doc, ["server"], registry=ROUTER_REGISTRY)
+
+
+num_requests_running = _g(
+    "vllm:num_requests_running", "Requests running on each engine"
+)
+num_requests_waiting = _g(
+    "vllm:num_requests_waiting", "Requests queued on each engine"
+)
+current_qps = _g("vllm:current_qps", "QPS routed to each engine")
+avg_decoding_length = _g(
+    "vllm:avg_decoding_length", "Average decode length per engine"
+)
+num_prefill_requests = _g(
+    "vllm:num_prefill_requests", "Requests currently in prefill"
+)
+num_decoding_requests = _g(
+    "vllm:num_decoding_requests", "Requests currently decoding"
+)
+avg_latency = _g("vllm:avg_latency", "Average end-to-end latency")
+avg_itl = _g("vllm:avg_itl", "Average inter-token latency")
+num_requests_swapped = _g(
+    "vllm:num_requests_swapped", "Requests swapped/preempted"
+)
+gpu_cache_usage_perc = _g(
+    "vllm:gpu_cache_usage_perc", "Engine KV cache usage"
+)
+gpu_prefix_cache_hit_rate = _g(
+    "vllm:gpu_prefix_cache_hit_rate", "Engine prefix-cache hit rate"
+)
+healthy_pods_total = _g(
+    "vllm:healthy_pods_total", "Healthy serving engines"
+)
+avg_ttft = _g("vllm:avg_ttft", "Average time to first token")

@@ -1,0 +1,385 @@
+"""Core proxy: pick a backend, stream the response, feed the stats monitor.
+
+Parity: reference src/vllm_router/services/request_service/request.py —
+route_general_request:141 (alias resolution, model filter, sleep filter,
+routing, streaming), process_request:55 (per-chunk hot loop + stats), and the
+disaggregated-prefill two-phase flow route_disaggregated_prefill_request:349
+(prefill with max_tokens=1, then stream from the decoder while it pulls KV).
+
+Implementation is aiohttp end to end: one shared upstream ClientSession with
+unbounded pool (reference: aiohttp_client.py:21), chunked pass-through so
+first-token latency is preserved.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.protocols import EndpointInfo, RouterRequest
+from production_stack_tpu.router.routing_logic import (
+    DisaggregatedPrefillRouter,
+    get_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    get_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    get_request_stats_monitor,
+)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_HOP_HEADERS = {
+    "host", "content-length", "connection", "keep-alive", "te", "trailers",
+    "transfer-encoding", "upgrade", "proxy-authenticate",
+    "proxy-authorization",
+}
+
+
+def _forward_headers(request: web.Request) -> dict[str, str]:
+    return {
+        k: v
+        for k, v in request.headers.items()
+        if k.lower() not in _HOP_HEADERS
+    }
+
+
+class RequestService:
+    """Owns the upstream HTTP session + the request hot path."""
+
+    def __init__(
+        self,
+        session_key: str | None = None,
+        callbacks=None,
+        rewriter=None,
+        semantic_cache=None,
+        request_timeout_s: float = 600.0,
+    ):
+        self.session_key = session_key
+        self.callbacks = callbacks
+        self.rewriter = rewriter
+        self.semantic_cache = semantic_cache
+        self.timeout = aiohttp.ClientTimeout(
+            total=request_timeout_s, sock_connect=10
+        )
+        self._session: aiohttp.ClientSession | None = None
+        self.in_flight = 0
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=self.timeout,
+            connector=aiohttp.TCPConnector(limit=0),  # unbounded pool
+        )
+
+    async def close(self) -> None:
+        if self._session:
+            await self._session.close()
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        assert self._session is not None, "RequestService not started"
+        return self._session
+
+    # -- endpoint filtering (reference: request.py:211-237) ----------------
+    @staticmethod
+    def _filter_endpoints(
+        endpoints: list[EndpointInfo], model: str | None
+    ) -> tuple[list[EndpointInfo], str | None]:
+        """Filter by requested model (resolving aliases), drop sleeping pods.
+
+        Returns (endpoints, resolved_model)."""
+        awake = [e for e in endpoints if not e.sleep]
+        if not model:
+            return awake, model
+        resolved = model
+        serving = []
+        for e in awake:
+            if model in e.model_names:
+                serving.append(e)
+            elif model in e.aliases:
+                resolved = e.aliases[model]
+                serving.append(e)
+        return serving, resolved
+
+    # -- main entry (reference: request.py:141) ----------------------------
+    async def route_general_request(
+        self, request: web.Request, endpoint_path: str
+    ) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON", "type":
+                           "invalid_request_error"}},
+                status=400,
+            )
+
+        request_id = request.headers.get(
+            "x-request-id", uuid.uuid4().hex
+        )
+
+        # PD branch (reference: request.py:159-163)
+        router = get_routing_logic()
+        if isinstance(router, DisaggregatedPrefillRouter):
+            return await self.route_disaggregated_prefill_request(
+                request, endpoint_path, body, request_id
+            )
+
+        # pre-request callback (reference: request.py:175-181)
+        if self.callbacks is not None:
+            maybe = self.callbacks.pre_request(request, body, request_id)
+            if maybe is not None:
+                body = maybe
+
+        # request rewriter (reference: request.py:192-206)
+        if self.rewriter is not None:
+            body = self.rewriter.rewrite_request(
+                body, endpoint_path, request_id
+            )
+
+        endpoints = get_service_discovery().get_endpoint_info()
+        model = body.get("model")
+        candidates, resolved_model = self._filter_endpoints(endpoints, model)
+        if resolved_model != model and resolved_model is not None:
+            body["model"] = resolved_model
+        if not candidates:
+            return web.json_response(
+                {"error": {"message": f"no endpoint serving model {model!r}",
+                           "type": "service_unavailable"}},
+                status=503,
+            )
+
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = get_request_stats_monitor().get_request_stats()
+        rr = RouterRequest(
+            headers=dict(request.headers), body=body, endpoint=endpoint_path
+        )
+        try:
+            url = await router.route_request(
+                candidates, engine_stats, request_stats, rr
+            )
+        except RuntimeError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type":
+                           "service_unavailable"}},
+                status=503,
+            )
+        logger.info(
+            "Routing request %s to %s at endpoint %s",
+            request_id, url, endpoint_path,
+        )
+        return await self.process_request(
+            request, body, url, endpoint_path, request_id
+        )
+
+    # -- proxy + streaming (reference: request.py:55-138) ------------------
+    async def process_request(
+        self,
+        request: web.Request,
+        body: dict,
+        backend_url: str,
+        endpoint_path: str,
+        request_id: str,
+        stats_url: str | None = None,
+    ) -> web.StreamResponse:
+        monitor = get_request_stats_monitor()
+        stats_url = stats_url or backend_url
+        prompt_tokens = _estimate_prompt_tokens(body)
+        monitor.on_new_request(
+            stats_url, request_id, time.time(), prompt_tokens
+        )
+        self.in_flight += 1
+        first_chunk_seen = False
+        try:
+            async with self.session.post(
+                f"{backend_url}{endpoint_path}",
+                json=body,
+                headers=_forward_headers(request),
+            ) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={
+                        k: v
+                        for k, v in upstream.headers.items()
+                        if k.lower() not in _HOP_HEADERS
+                    },
+                )
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    if not first_chunk_seen:
+                        first_chunk_seen = True
+                        monitor.on_request_response(
+                            stats_url, request_id, time.time()
+                        )
+                    else:
+                        monitor.on_token(stats_url, request_id)
+                    await resp.write(chunk)
+                await resp.write_eof()
+                monitor.on_request_complete(
+                    stats_url, request_id, time.time()
+                )
+                if self.callbacks is not None:
+                    self.callbacks.post_request(request_id, body)
+                return resp
+        except (aiohttp.ClientError, ConnectionResetError) as e:
+            monitor.on_request_complete(stats_url, request_id, time.time())
+            logger.warning(
+                "backend %s failed for request %s: %s",
+                backend_url, request_id, e,
+            )
+            return web.json_response(
+                {"error": {"message": f"backend error: {e}",
+                           "type": "bad_gateway"}},
+                status=502,
+            )
+        finally:
+            self.in_flight -= 1
+
+    # -- disaggregated prefill (reference: request.py:349-441) -------------
+    async def route_disaggregated_prefill_request(
+        self,
+        request: web.Request,
+        endpoint_path: str,
+        body: dict,
+        request_id: str,
+    ) -> web.StreamResponse:
+        router = get_routing_logic()
+        assert isinstance(router, DisaggregatedPrefillRouter)
+        endpoints = get_service_discovery().get_endpoint_info()
+        endpoints = [e for e in endpoints if not e.sleep]
+        try:
+            prefill_url, decode_url = await router.route_prefill_decode(
+                endpoints
+            )
+        except RuntimeError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "service_unavailable"}},
+                status=503,
+            )
+
+        monitor = get_request_stats_monitor()
+        headers = _forward_headers(request)
+        headers["x-request-id"] = request_id
+
+        # Phase 1: prefill with max_tokens=1, KV lands in the transfer tier
+        prefill_body = dict(body)
+        orig_max_tokens = body.get("max_tokens", 128)
+        prefill_body["max_tokens"] = 1
+        prefill_body["stream"] = False
+        prefill_body.setdefault("kv_transfer_params", {})["role"] = (
+            "producer"
+        )
+        t0 = time.time()
+        monitor.on_new_request(
+            prefill_url, f"{request_id}-prefill", t0,
+            _estimate_prompt_tokens(body),
+        )
+        try:
+            async with self.session.post(
+                f"{prefill_url}{endpoint_path}",
+                json=prefill_body, headers=headers,
+            ) as pr:
+                if pr.status != 200:
+                    detail = await pr.text()
+                    monitor.on_request_complete(
+                        prefill_url, f"{request_id}-prefill", time.time()
+                    )
+                    return web.json_response(
+                        {"error": {"message":
+                                   f"prefiller error: {detail[:500]}",
+                                   "type": "bad_gateway"}},
+                        status=502,
+                    )
+                await pr.read()
+        except aiohttp.ClientError as e:
+            monitor.on_request_complete(
+                prefill_url, f"{request_id}-prefill", time.time()
+            )
+            return web.json_response(
+                {"error": {"message": f"prefiller unreachable: {e}",
+                           "type": "bad_gateway"}},
+                status=502,
+            )
+        monitor.on_request_response(
+            prefill_url, f"{request_id}-prefill", time.time()
+        )
+        monitor.on_request_complete(
+            prefill_url, f"{request_id}-prefill", time.time()
+        )
+        logger.info(
+            "PD request %s: prefill on %s took %.3fs; decoding on %s",
+            request_id, prefill_url, time.time() - t0, decode_url,
+        )
+
+        # Phase 2: decode streams to the client, pulling KV from prefiller
+        decode_body = dict(body)
+        decode_body["max_tokens"] = orig_max_tokens
+        decode_body.setdefault("kv_transfer_params", {})["role"] = (
+            "consumer"
+        )
+        return await self.process_request(
+            request, decode_body, decode_url, endpoint_path, request_id,
+            stats_url=decode_url,
+        )
+
+    # -- sleep/wake passthrough (reference: request.py:444-520) ------------
+    async def route_sleep_wakeup_request(
+        self, request: web.Request, path: str
+    ) -> web.Response:
+        url = request.query.get("url") or request.headers.get("x-engine-url")
+        endpoints = get_service_discovery().get_endpoint_info()
+        targets = (
+            [e for e in endpoints if e.url == url]
+            if url
+            else endpoints
+        )
+        if not targets:
+            return web.json_response(
+                {"error": {"message": "no matching engine",
+                           "type": "not_found"}},
+                status=404,
+            )
+        results = {}
+        for ep in targets:
+            try:
+                if path == "/is_sleeping":
+                    async with self.session.get(
+                        f"{ep.url}{path}"
+                    ) as r:
+                        results[ep.url] = await r.json()
+                else:
+                    async with self.session.post(
+                        f"{ep.url}{path}",
+                        params=dict(request.query),
+                    ) as r:
+                        results[ep.url] = await r.json()
+            except aiohttp.ClientError as e:
+                results[ep.url] = {"error": str(e)}
+        if url:
+            return web.json_response(results[url])
+        return web.json_response(results)
+
+
+def _estimate_prompt_tokens(body: dict) -> int:
+    """Cheap prompt-size signal for the stats monitor (~4 chars/token)."""
+    if "prompt" in body:
+        p = body["prompt"]
+        if isinstance(p, list):
+            return len(p)
+        return max(1, len(str(p)) // 4)
+    if "messages" in body:
+        total = sum(
+            len(str(m.get("content", ""))) for m in body["messages"]
+        )
+        return max(1, total // 4)
+    return 1

@@ -1,0 +1,1 @@
+"""Router-side stats: engine /metrics scraping + request-level monitoring."""

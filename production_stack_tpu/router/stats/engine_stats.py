@@ -1,0 +1,133 @@
+"""Engine stats scraper: polls every engine's Prometheus /metrics.
+
+Parity: reference src/vllm_router/stats/engine_stats.py (EngineStats:29,
+EngineStatsScraper:88). Parses the vllm:* gauge families our engines (and
+stock vLLM engines) export, so the router works against either. Runs as an
+asyncio task instead of the reference's daemon thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+import aiohttp
+from prometheus_client.parser import text_string_to_metric_families
+
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class EngineStats:
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hits_total: int = 0
+    gpu_prefix_cache_queries_total: int = 0
+
+    @staticmethod
+    def from_prometheus_text(text: str) -> "EngineStats":
+        s = EngineStats()
+        hits = queries = None
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                name, value = sample.name, sample.value
+                if name == "vllm:num_requests_running":
+                    s.num_running_requests = int(value)
+                elif name == "vllm:num_requests_waiting":
+                    s.num_queuing_requests = int(value)
+                elif name == "vllm:gpu_cache_usage_perc":
+                    s.gpu_cache_usage_perc = float(value)
+                elif name == "vllm:gpu_prefix_cache_hit_rate":
+                    s.gpu_prefix_cache_hit_rate = float(value)
+                elif name == "vllm:gpu_prefix_cache_hits_total":
+                    hits = float(value)
+                elif name == "vllm:gpu_prefix_cache_queries_total":
+                    queries = float(value)
+        if hits is not None and queries:
+            s.gpu_prefix_cache_hits_total = int(hits)
+            s.gpu_prefix_cache_queries_total = int(queries)
+            s.gpu_prefix_cache_hit_rate = hits / queries
+        return s
+
+
+class EngineStatsScraper:
+    def __init__(self, scrape_interval_s: float = 10.0):
+        self.scrape_interval_s = scrape_interval_s
+        self._stats: dict[str, EngineStats] = {}
+        self._task: asyncio.Task | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.scrape_interval_s)
+        )
+        self._task = asyncio.create_task(self._scrape_loop())
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._session:
+            await self._session.close()
+
+    async def _scrape_loop(self) -> None:
+        while True:
+            try:
+                await self._scrape_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("engine stats scrape failed")
+            await asyncio.sleep(self.scrape_interval_s)
+
+    async def _scrape_all(self) -> None:
+        try:
+            endpoints = get_service_discovery().get_endpoint_info()
+        except RuntimeError:
+            return
+        results = await asyncio.gather(
+            *(self._scrape_one(ep.url) for ep in endpoints),
+            return_exceptions=True,
+        )
+        fresh: dict[str, EngineStats] = {}
+        for ep, res in zip(endpoints, results):
+            if isinstance(res, EngineStats):
+                fresh[ep.url] = res
+        self._stats = fresh
+
+    async def _scrape_one(self, url: str) -> EngineStats | None:
+        assert self._session is not None
+        async with self._session.get(f"{url}/metrics") as r:
+            if r.status != 200:
+                return None
+            text = await r.text()
+        return EngineStats.from_prometheus_text(text)
+
+    def get_engine_stats(self) -> dict[str, EngineStats]:
+        return dict(self._stats)
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+
+_scraper: EngineStatsScraper | None = None
+
+
+def initialize_engine_stats_scraper(
+    scrape_interval_s: float = 10.0,
+) -> EngineStatsScraper:
+    global _scraper
+    _scraper = EngineStatsScraper(scrape_interval_s)
+    return _scraper
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    if _scraper is None:
+        raise RuntimeError("engine stats scraper not initialized")
+    return _scraper

@@ -1,0 +1,6 @@
+"""Shared utilities: logging, singletons, consistent hashing, misc helpers."""
+
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.singleton import SingletonABCMeta, SingletonMeta
+
+__all__ = ["init_logger", "SingletonMeta", "SingletonABCMeta"]

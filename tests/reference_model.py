@@ -1,0 +1,80 @@
+"""Naive dense-attention reference implementation used to validate the paged
+engine. Deliberately independent of the engine's attention/caching machinery:
+full-sequence forward, dense causal mask, no paging, no chunking."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.models.config import ModelConfig
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    # x: (t, heads, d)
+    d = x.shape[-1]
+    half = d // 2
+    inv = 1.0 / (theta ** (np.arange(half) * 2.0 / d))
+    freqs = np.asarray(positions)[:, None] * inv[None, :]
+    cos = jnp.asarray(np.cos(freqs), jnp.float32)[:, None, :]
+    sin = jnp.asarray(np.sin(freqs), jnp.float32)[:, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], -1).astype(x.dtype)
+
+
+def dense_forward(cfg: ModelConfig, params: dict, token_ids: list[int]):
+    """Full forward over the whole sequence; returns fp32 logits (t, vocab)."""
+    t = len(token_ids)
+    pos = np.arange(t)
+    h = params["embed"][jnp.asarray(token_ids)]
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    mask = np.tril(np.ones((t, t), bool))
+
+    for l in range(cfg.num_layers):
+        lp = {k: v[l] for k, v in params["layers"].items()}
+        x = _rms(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(t, nq, d)
+        k = (x @ lp["wk"]).reshape(t, nkv, d)
+        v = (x @ lp["wv"]).reshape(t, nkv, d)
+        if cfg.qkv_bias:
+            q = q + lp["bq"].reshape(nq, d)
+            k = k + lp["bk"].reshape(nkv, d)
+            v = v + lp["bv"].reshape(nkv, d)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        g = nq // nkv
+        qg = q.reshape(t, nkv, g, d).astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        scores = jnp.einsum("tkgd,skd->tkgs", qg, kf) * (d**-0.5)
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, -1)
+        o = jnp.einsum("tkgs,skd->tkgd", p, v.astype(jnp.float32))
+        h = h + (o.reshape(t, nq * d).astype(h.dtype) @ lp["wo"])
+        x = _rms(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        act = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+        h = h + (act @ lp["w_down"]).astype(h.dtype)
+
+    h = _rms(h, params["final_norm"], cfg.rms_norm_eps)
+    lm = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (h @ lm).astype(jnp.float32)
+
+
+def dense_greedy_generate(
+    cfg: ModelConfig, params: dict, prompt: list[int], num_tokens: int
+) -> list[int]:
+    """Greedy decoding by full recompute each step (slow, obviously correct)."""
+    ids = list(prompt)
+    for _ in range(num_tokens):
+        logits = dense_forward(cfg, params, ids)
+        ids.append(int(jnp.argmax(logits[-1])))
+    return ids[len(prompt) :]

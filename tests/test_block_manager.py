@@ -1,0 +1,104 @@
+"""Unit tests for the paged-KV block manager + prefix caching."""
+
+from production_stack_tpu.engine.block_manager import BlockManager
+
+
+def make_mgr(num_blocks=10, block_size=4, prefix=True):
+    return BlockManager(num_blocks, block_size, enable_prefix_caching=prefix)
+
+
+def test_allocate_and_free():
+    m = make_mgr()
+    table, cached = m.allocate_prompt(list(range(10)))  # 3 blocks
+    assert len(table) == 3
+    assert cached == 0
+    assert 0 not in table  # null block never allocated
+    assert m.num_free_blocks == 9 - 3
+    m.free(table)
+    assert m.num_free_blocks == 9
+
+
+def test_out_of_blocks():
+    m = make_mgr(num_blocks=3)  # 2 usable
+    assert m.allocate_prompt(list(range(12))) is None  # needs 3
+    table, _ = m.allocate_prompt(list(range(8)))
+    assert len(table) == 2
+    assert m.allocate_prompt([1, 2, 3, 4]) is None
+
+
+def test_prefix_cache_hit_and_refcount():
+    m = make_mgr(num_blocks=20)
+    prompt = list(range(12))  # 3 full blocks
+    t1, cached1 = m.allocate_prompt(prompt)
+    assert cached1 == 0
+    # register as the engine would after prefill
+    prev = 0
+    for i in range(3):
+        prev = m.register_block(prev, tuple(prompt[i * 4 : (i + 1) * 4]), t1[i])
+
+    t2, cached2 = m.allocate_prompt(prompt + [99, 100])
+    # full 3 blocks cached
+    assert cached2 == 12
+    assert t2[:3] == t1[:3]
+    assert m.blocks[t1[0]].ref_count == 2
+    m.free(t1)
+    assert m.blocks[t1[0]].ref_count == 1
+    m.free(t2)
+    # cached blocks become evictable, not free-listed
+    assert len(m.evictable) == 3
+
+
+def test_prefix_cache_caps_at_len_minus_one():
+    """A fully cached prompt must still compute >=1 token for logits."""
+    m = make_mgr(num_blocks=20)
+    prompt = list(range(8))  # exactly 2 blocks
+    t1, _ = m.allocate_prompt(prompt)
+    prev = 0
+    for i in range(2):
+        prev = m.register_block(prev, tuple(prompt[i * 4 : (i + 1) * 4]), t1[i])
+    t2, cached = m.allocate_prompt(prompt)
+    assert cached == 7  # capped at len-1 -> only 1 full block reused
+    assert t2[0] == t1[0]
+    assert t2[1] != t1[1]
+
+
+def test_eviction_reuses_lru():
+    m = make_mgr(num_blocks=4)  # 3 usable
+    t1, _ = m.allocate_prompt(list(range(4)))
+    m.register_block(0, tuple(range(4)), t1[0])
+    m.free(t1)
+    assert m.num_free_blocks == 3
+    # hit still possible before eviction
+    t2, cached = m.allocate_prompt(list(range(4)) + [9])
+    assert cached == 4
+    m.free(t2)
+    # now exhaust the pool so the cached block must be evicted
+    t3, _ = m.allocate_prompt(list(range(100, 112)))  # 3 blocks
+    assert len(t3) == 3
+    # cached mapping was dropped on eviction
+    t4 = m.allocate_prompt(list(range(4)) + [9])
+    assert t4 is None  # no blocks left at all
+
+
+def test_ensure_capacity_grows_table():
+    m = make_mgr()
+    table, _ = m.allocate_prompt(list(range(4)))
+    assert len(table) == 1
+    assert m.ensure_capacity(5, table)
+    assert len(table) == 2
+    assert m.ensure_capacity(8, table)
+    assert len(table) == 2
+    assert m.ensure_capacity(9, table)
+    assert len(table) == 3
+
+
+def test_hit_counters():
+    m = make_mgr(num_blocks=20)
+    p = list(range(8))
+    t1, _ = m.allocate_prompt(p)
+    prev = 0
+    for i in range(2):
+        prev = m.register_block(prev, tuple(p[i * 4 : (i + 1) * 4]), t1[i])
+    m.allocate_prompt(p + [1, 2, 3, 4])
+    assert m.prefix_queries == 8 + 12
+    assert m.prefix_hits == 8

@@ -1,0 +1,151 @@
+"""End-to-end correctness: the paged, chunked, continuously-batched engine
+must reproduce the naive dense-attention reference exactly (greedy)."""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.models.config import get_model_config
+
+from reference_model import dense_forward, dense_greedy_generate
+
+
+def tiny_engine(**overrides) -> LLMEngine:
+    kwargs = dict(
+        model="pst-tiny-debug",
+        tokenizer="byte",
+        dtype="float32",
+        cache_dtype="float32",
+        block_size=4,
+        num_kv_blocks=128,
+        max_num_seqs=4,
+        max_prefill_chunk=16,
+        seed=0,
+    )
+    kwargs.update(overrides)
+    return LLMEngine(EngineConfig(**kwargs))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return tiny_engine()
+
+
+def greedy(n):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def test_single_request_matches_dense(engine):
+    cfg = get_model_config("pst-tiny-debug")
+    prompt = [1, 5, 9, 200, 33, 7, 77, 120, 3, 250, 14]
+    [out] = engine.generate([prompt], greedy(8))
+    expected = dense_greedy_generate(
+        cfg, engine.runner.params, prompt, 8
+    )
+    assert out.token_ids == expected
+
+
+def test_chunked_prefill_matches_dense(engine):
+    """Prompt longer than max_prefill_chunk forces multiple chunks."""
+    cfg = get_model_config("pst-tiny-debug")
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 384, size=45).tolist()  # 3 chunks of <=16
+    [out] = engine.generate([prompt], greedy(5))
+    expected = dense_greedy_generate(cfg, engine.runner.params, prompt, 5)
+    assert out.token_ids == expected
+
+
+def test_batched_requests_match_dense(engine):
+    """Continuous batching: different prompt lengths, decoded together."""
+    cfg = get_model_config("pst-tiny-debug")
+    rng = np.random.RandomState(1)
+    prompts = [
+        rng.randint(0, 384, size=n).tolist() for n in (5, 17, 29, 8)
+    ]
+    outs = engine.generate(prompts, greedy(6))
+    for p, o in zip(prompts, outs):
+        expected = dense_greedy_generate(cfg, engine.runner.params, p, 6)
+        assert o.token_ids == expected, f"mismatch for prompt len {len(p)}"
+
+
+def test_prefill_logits_close_to_dense(engine):
+    cfg = get_model_config("pst-tiny-debug")
+    prompt = list(range(10, 31))
+    engine.add_request("logit-test", prompt_token_ids=prompt,
+                       sampling_params=greedy(1))
+    outs = []
+    while engine.has_unfinished():
+        outs.extend(engine.step())
+    dense = np.asarray(dense_forward(cfg, engine.runner.params, prompt))
+    # engine's first sampled token comes from the last prompt position
+    assert outs[-1].token_ids[0] == int(dense[-1].argmax())
+
+
+def test_prefix_cache_reuse_preserves_output():
+    engine = tiny_engine()
+    cfg = get_model_config("pst-tiny-debug")
+    shared = list(range(40, 60))  # 5 full blocks of shared prefix
+    p1 = shared + [7, 8, 9]
+    p2 = shared + [100, 101, 102]
+    [o1] = engine.generate([p1], greedy(4))
+    stats_before = engine.stats()
+    [o2] = engine.generate([p2], greedy(4))
+    stats_after = engine.stats()
+    assert stats_after.prefix_cache_hits > stats_before.prefix_cache_hits
+    expected = dense_greedy_generate(cfg, engine.runner.params, p2, 4)
+    assert o2.token_ids == expected
+
+
+def test_preemption_recovers_correct_output():
+    """Tiny block pool forces preemption mid-decode; outputs must still be
+    correct after recompute."""
+    engine = tiny_engine(num_kv_blocks=18, enable_prefix_caching=False,
+                         max_num_seqs=2)
+    cfg = get_model_config("pst-tiny-debug")
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 384, size=24).tolist() for _ in range(2)]
+    outs = engine.generate(prompts, greedy(10))
+    for p, o in zip(prompts, outs):
+        expected = dense_greedy_generate(cfg, engine.runner.params, p, 10)
+        assert o.token_ids == expected
+
+
+def test_stop_conditions():
+    engine = tiny_engine()
+    prompt = list(range(5))
+    # max_tokens
+    [o] = engine.generate([prompt], SamplingParams(max_tokens=3,
+                                                   temperature=0.0,
+                                                   ignore_eos=True))
+    assert len(o.token_ids) == 3 and o.finish_reason == "length"
+    # stop_token_ids: find what greedy produces first, then stop on it
+    [probe] = engine.generate([prompt], greedy(2))
+    stop_tok = probe.token_ids[0]
+    [o] = engine.generate(
+        [prompt],
+        SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True,
+                       stop_token_ids=[stop_tok]),
+    )
+    assert o.token_ids[-1] == stop_tok and o.finish_reason == "stop"
+    assert len(o.token_ids) == 1
+
+
+def test_text_prompt_roundtrip():
+    engine = tiny_engine()
+    [o] = engine.generate(["hello world"], greedy(4))
+    assert len(o.token_ids) == 4
+    assert isinstance(o.text, str)
+
+
+def test_stats_snapshot():
+    engine = tiny_engine()
+    s0 = engine.stats()
+    assert s0.num_running == 0 and s0.kv_usage == 0.0
+    engine.generate([[1, 2, 3, 4, 5]], greedy(2))
+    s1 = engine.stats()
+    assert s1.generation_tokens_total == 2
+    assert s1.prompt_tokens_total == 5
+    assert s1.requests_finished_total == 1
+    assert s1.kv_usage == 0.0  # everything freed

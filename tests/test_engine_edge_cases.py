@@ -1,0 +1,136 @@
+"""Regression tests for scheduler/engine edge cases found in review."""
+
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+
+
+def tiny_engine(**overrides) -> LLMEngine:
+    kwargs = dict(
+        model="pst-tiny-debug",
+        tokenizer="byte",
+        dtype="float32",
+        cache_dtype="float32",
+        block_size=4,
+        num_kv_blocks=64,
+        max_num_seqs=4,
+        max_prefill_chunk=16,
+        seed=0,
+    )
+    kwargs.update(overrides)
+    return LLMEngine(EngineConfig(**kwargs))
+
+
+def test_too_long_prompt_emits_aborted_output():
+    """A rejected prompt must produce a final output (clients would hang)."""
+    engine = tiny_engine(max_model_len=16)
+    engine.add_request(
+        "too-long", prompt_token_ids=list(range(20)),
+        sampling_params=SamplingParams(max_tokens=2),
+    )
+    outs = engine.step()
+    assert len(outs) == 1
+    assert outs[0].request_id == "too-long"
+    assert outs[0].finished
+    assert outs[0].finish_reason == "abort"
+    assert not engine.has_unfinished()
+    assert "too-long" not in engine._seqs
+
+
+def test_generation_stops_at_max_model_len():
+    """max_tokens beyond the context window must not corrupt attention."""
+    engine = tiny_engine(max_model_len=16)
+    [out] = engine.generate(
+        [list(range(10))],
+        SamplingParams(max_tokens=100, temperature=0.0, ignore_eos=True),
+    )
+    assert out.finished
+    assert out.finish_reason == "length"
+    # 10 prompt + 6 generated == 16 == max_model_len
+    assert len(out.token_ids) == 6
+
+
+def test_evictable_matched_blocks_not_double_counted():
+    """allocate_prompt must not count matched evictable blocks as free
+    capacity for the new blocks it still needs."""
+    from production_stack_tpu.engine.block_manager import BlockManager
+
+    bm = BlockManager(num_blocks=7, block_size=4)  # 6 usable
+    # running seq holds 2 blocks
+    held, _ = bm.allocate_prompt(list(range(100, 108)))
+    # finished seq: 4 blocks, registered, then freed -> 4 evictable
+    p1 = list(range(16))
+    t1, _ = bm.allocate_prompt(p1)
+    prev = 0
+    for i in range(4):
+        prev = bm.register_block(prev, tuple(p1[i * 4 : (i + 1) * 4]), t1[i])
+    bm.free(t1)
+    assert len(bm.evictable) == 4 and not bm.free_blocks
+    # p2 matches 3 evictable blocks and needs 2 fresh ones, but only 1
+    # non-matched evictable block exists -> allocation must refuse cleanly
+    p2 = p1[:12] + [99] * 8  # 5 blocks: 3 matched + 2 new
+    assert bm.allocate_prompt(p2) is None
+    # pool state must be untouched by the failed attempt
+    assert len(bm.evictable) == 4
+    assert bm.blocks[t1[0]].ref_count == 0
+
+
+def test_lone_request_outgrowing_pool_is_aborted():
+    """A single sequence that outgrows the whole pool must be aborted,
+    not deadlock or kill the step loop."""
+    engine = tiny_engine(num_kv_blocks=7, max_num_seqs=1)
+    engine.add_request(
+        "grower", prompt_token_ids=list(range(22)),  # 6 blocks when decoding
+        sampling_params=SamplingParams(max_tokens=50, temperature=0.0,
+                                       ignore_eos=True),
+    )
+    final = None
+    for _ in range(200):
+        for out in engine.step():
+            final = out
+        if not engine.has_unfinished():
+            break
+    assert final is not None and final.finished
+    assert final.finish_reason == "abort"
+    assert len(final.token_ids) >= 2  # generated until the pool ran out
+    assert engine.block_manager.usage == 0.0
+
+
+def test_repetition_and_presence_penalties_change_sampling():
+    engine = tiny_engine()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    [base] = engine.generate(
+        [prompt],
+        SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True),
+    )
+    [pen] = engine.generate(
+        [prompt],
+        SamplingParams(
+            max_tokens=12, temperature=0.0, ignore_eos=True,
+            repetition_penalty=5.0, presence_penalty=10.0,
+        ),
+    )
+    # greedy with harsh penalties must avoid repeating tokens the
+    # unpenalized run repeats (tiny random model repeats heavily)
+    def repeats(ids):
+        return len(ids) - len(set(ids))
+
+    assert repeats(pen.token_ids) <= repeats(base.token_ids)
+    assert pen.token_ids != base.token_ids or repeats(base.token_ids) == 0
+
+
+def test_greedy_unaffected_by_noop_penalties():
+    engine = tiny_engine()
+    prompt = [10, 20, 30]
+    [a] = engine.generate(
+        [prompt], SamplingParams(max_tokens=5, temperature=0.0,
+                                 ignore_eos=True),
+    )
+    [b] = engine.generate(
+        [prompt],
+        SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True,
+                       presence_penalty=0.0, repetition_penalty=1.0),
+    )
+    assert a.token_ids == b.token_ids

@@ -1,0 +1,120 @@
+"""Unit tests for the continuous-batching scheduler."""
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.engine.scheduler import Scheduler, SchedulerConfig
+from production_stack_tpu.engine.sequence import Sequence
+
+
+def make_sched(num_blocks=64, block_size=4, max_num_seqs=4,
+               max_prefill_chunk=8, max_model_len=128):
+    bm = BlockManager(num_blocks, block_size)
+    cfg = SchedulerConfig(
+        max_num_seqs=max_num_seqs,
+        max_prefill_chunk=max_prefill_chunk,
+        max_model_len=max_model_len,
+    )
+    return Scheduler(cfg, bm), bm
+
+
+def seq(rid, n_prompt, **kw):
+    return Sequence(rid, list(range(n_prompt)), SamplingParams(**kw), None)
+
+
+def run_prefill(sched, work):
+    """Simulate the engine executing a prefill chunk."""
+    work.seq.num_computed_tokens += work.chunk_len
+
+
+def test_prefill_priority_and_chunking():
+    sched, _ = make_sched(max_prefill_chunk=8)
+    s = seq("a", 20)
+    sched.add_seq(s)
+    # 20-token prompt with chunk 8: expect chunks 8, 8, 4
+    lens = []
+    for _ in range(3):
+        out = sched.schedule()
+        assert out.prefill is not None and out.decode is None
+        lens.append(out.prefill.chunk_len)
+        run_prefill(sched, out.prefill)
+    assert lens == [8, 8, 4]
+    assert out.prefill.is_last_chunk
+    s.append_token(7)
+    out = sched.schedule()
+    assert out.prefill is None and out.decode is not None
+    assert out.decode.seqs == [s]
+
+
+def test_admission_cap():
+    sched, _ = make_sched(max_num_seqs=2)
+    for i in range(4):
+        sched.add_seq(seq(f"s{i}", 4))
+    out = sched.schedule()
+    assert sched.num_running == 2
+    assert sched.num_waiting == 2
+    assert out.prefill is not None
+
+
+def test_decode_batches_all_running():
+    sched, _ = make_sched()
+    seqs = [seq(f"s{i}", 4) for i in range(3)]
+    for s in seqs:
+        sched.add_seq(s)
+    # drain all prefills
+    for _ in range(3):
+        out = sched.schedule()
+        run_prefill(sched, out.prefill)
+        out.prefill.seq.append_token(1)
+    out = sched.schedule()
+    assert out.decode is not None
+    assert set(s.request_id for s in out.decode.seqs) == {"s0", "s1", "s2"}
+
+
+def test_preemption_on_block_exhaustion():
+    # 2 usable... give 9 blocks (8 usable), block_size 4
+    sched, bm = make_sched(num_blocks=9, block_size=4, max_num_seqs=2)
+    a, b = seq("a", 14), seq("b", 14)  # 4 blocks each, 8 total: full pool
+    sched.add_seq(a)
+    sched.add_seq(b)
+    for _ in range(4):
+        out = sched.schedule()
+        if out.prefill:
+            run_prefill(sched, out.prefill)
+            if out.prefill.is_last_chunk:
+                out.prefill.seq.append_token(1)
+    assert sched.num_running == 2
+    # grow a to 17 tokens: needs a 5th block; pool is empty -> preempt b
+    a.append_token(2)  # 16 tokens (14 prompt + 2 output): still fits
+    a.append_token(3)  # 17 tokens: crosses the block boundary
+    out = sched.schedule()
+    assert len(out.preempted) == 1
+    assert out.preempted[0] is b
+    assert b in list(sched.waiting)
+    assert b.num_computed_tokens == 0  # recompute semantics
+    assert out.decode is not None and out.decode.seqs == [a]
+
+
+def test_too_long_prompt_aborted():
+    sched, _ = make_sched(max_model_len=16)
+    s = seq("big", 17)
+    sched.add_seq(s)
+    out = sched.schedule()
+    assert out.prefill is None and out.decode is None
+    assert out.aborted == [s]
+    assert s.finished
+    assert sched.num_waiting == 0
+
+
+def test_abort_waiting_and_running():
+    sched, bm = make_sched()
+    a = seq("a", 4)
+    sched.add_seq(a)
+    assert sched.abort("a")
+    assert a.finished
+    b = seq("b", 4)
+    sched.add_seq(b)
+    out = sched.schedule()
+    run_prefill(sched, out.prefill)
+    assert sched.abort("b")
+    assert sched.num_running == 0
+    assert bm.num_free_blocks == 63  # all returned
