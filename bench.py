@@ -1,0 +1,142 @@
+"""Benchmark: serving throughput + TTFT on the real TPU chip.
+
+Workload shape follows the reference's multi-round-qa definition scaled to
+one chip (reference: benchmarks/multi-round-qa/run.sh — shared system
+prompt + long per-user history + ~100-token answers): concurrent sessions
+with a shared prefix exercise chunked prefill, prefix caching, continuous
+batching, and paged decode together.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is the fraction of the HBM-bandwidth decode roofline achieved
+(roofline tok/s = batch * HBM_BW / model_bytes — every decode step must
+stream the weights once; the reference repo commits no absolute numbers to
+compare against, see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("PST_LOG_LEVEL", "WARNING")  # keep stdout JSON-only
+
+import numpy as np  # noqa: E402
+
+MODEL = os.environ.get("PST_BENCH_MODEL", "llama-3.2-1b")
+NUM_USERS = int(os.environ.get("PST_BENCH_USERS", "16"))
+SYSTEM_PROMPT_TOK = int(os.environ.get("PST_BENCH_SYS_TOK", "512"))
+HISTORY_TOK = int(os.environ.get("PST_BENCH_HISTORY_TOK", "1024"))
+ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
+HBM_BW_GBPS = float(os.environ.get("PST_BENCH_HBM_BW", "819"))  # v5e
+
+
+def main() -> None:
+    import jax
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    t_setup = time.time()
+    config = EngineConfig(
+        model=MODEL,
+        tokenizer="byte",
+        dtype="bfloat16",
+        cache_dtype="bfloat16",
+        block_size=32,
+        hbm_utilization=0.85,
+        max_model_len=4096,
+        max_num_seqs=NUM_USERS,
+        max_prefill_chunk=512,
+        seed=0,
+    )
+    engine = LLMEngine(config)
+    mc = engine.runner.model_config
+    print(
+        f"# engine up in {time.time() - t_setup:.1f}s on "
+        f"{jax.devices()[0].platform}, {engine.runner.num_blocks} KV blocks",
+        file=sys.stderr,
+    )
+
+    rng = np.random.RandomState(0)
+    vocab = mc.vocab_size
+    shared_prefix = rng.randint(0, vocab, SYSTEM_PROMPT_TOK).tolist()
+    prompts = [
+        shared_prefix + rng.randint(0, vocab, HISTORY_TOK).tolist()
+        for _ in range(NUM_USERS)
+    ]
+    sp = SamplingParams(
+        max_tokens=ANSWER_TOK, temperature=0.0, ignore_eos=True
+    )
+
+    # -- warmup: compile all buckets on a short run ------------------------
+    t0 = time.time()
+    engine.generate(
+        [p[: SYSTEM_PROMPT_TOK + 64] for p in prompts[:2]],
+        SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+    )
+    print(f"# warmup/compile {time.time() - t0:.1f}s", file=sys.stderr)
+
+    # -- timed run ---------------------------------------------------------
+    ttfts: dict[str, float] = {}
+    t_start = time.time()
+    for i, p in enumerate(prompts):
+        engine.add_request(f"u{i}", prompt_token_ids=p, sampling_params=sp)
+    submit_t = {f"u{i}": t_start for i in range(NUM_USERS)}
+
+    gen_tokens = 0
+    decode_time = 0.0
+    while engine.has_unfinished():
+        st = time.time()
+        outs = engine.step()
+        dt = time.time() - st
+        now = time.time()
+        for out in outs:
+            if out.request_id not in ttfts and out.token_ids:
+                ttfts[out.request_id] = now - submit_t[out.request_id]
+        if engine.last_step_kind == "decode":
+            gen_tokens += sum(len(o.new_token_ids) for o in outs)
+            decode_time += dt
+    total_time = time.time() - t_start
+
+    all_gen = NUM_USERS * ANSWER_TOK
+    decode_tps = gen_tokens / decode_time if decode_time > 0 else 0.0
+    overall_tps = all_gen / total_time
+    ttft_arr = np.asarray(sorted(ttfts.values()))
+    p50_ttft = float(np.percentile(ttft_arr, 50)) if len(ttft_arr) else -1
+
+    model_bytes = mc.num_params() * 2  # bf16
+    roofline_tps = NUM_USERS * HBM_BW_GBPS * 1e9 / model_bytes
+
+    result = {
+        "metric": (
+            f"multi-round-qa-style serving throughput "
+            f"({mc.name}, {NUM_USERS} users, "
+            f"{SYSTEM_PROMPT_TOK}+{HISTORY_TOK} tok prompts, "
+            f"{ANSWER_TOK} tok answers, 1 chip)"
+        ),
+        "value": round(overall_tps, 1),
+        "unit": "gen_tokens/s/chip",
+        "vs_baseline": round(decode_tps / roofline_tps, 3),
+        "detail": {
+            "decode_tokens_per_s": round(decode_tps, 1),
+            "p50_ttft_s": round(p50_ttft, 3),
+            "mean_ttft_s": round(float(ttft_arr.mean()), 3)
+            if len(ttft_arr)
+            else -1,
+            "total_wall_s": round(total_time, 1),
+            "roofline_decode_tokens_per_s": round(roofline_tps, 1),
+            "prefix_cache_hit_rate": round(
+                engine.stats().prefix_cache_hit_rate, 3
+            ),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

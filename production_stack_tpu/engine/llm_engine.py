@@ -56,6 +56,7 @@ class LLMEngine:
             self.block_manager,
         )
         self._seqs: dict[str, Sequence] = {}
+        self.last_step_kind = "idle"  # "prefill" | "decode" | "idle"
         # lifetime counters for /metrics
         self._prompt_tokens_total = 0
         self._generation_tokens_total = 0
@@ -105,6 +106,13 @@ class LLMEngine:
     def step(self) -> list[RequestOutput]:
         sched_out = self.scheduler.schedule()
         self._preemptions_total += len(sched_out.preempted)
+        self.last_step_kind = (
+            "prefill"
+            if sched_out.prefill is not None
+            else "decode"
+            if sched_out.decode is not None
+            else "idle"
+        )
         if sched_out.is_empty:
             return []
 

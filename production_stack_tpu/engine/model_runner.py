@@ -30,6 +30,7 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.models import llama
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.ops import attention as xla_attn
+from production_stack_tpu.parallel import sharding as sharding_rules
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -50,18 +51,35 @@ class ModelRunner:
         self.model_config: ModelConfig = config.model_config()
         self.dtype = jnp.dtype(config.dtype)
         self.cache_dtype = jnp.dtype(config.cache_dtype)
-        self.mesh = mesh
         self.max_model_len = config.resolved_max_model_len()
 
         mc = self.model_config
+        tp = config.tensor_parallel_size
+        if mesh is None and tp > 1:
+            mesh = sharding_rules.make_mesh(tp)
+        self.mesh = mesh
+        if self.mesh is not None:
+            sharding_rules.validate_tp(mc, self.mesh.size)
+
         if params is None:
             logger.info(
-                "initializing random %s params (%.2fB params, %s)",
+                "initializing random %s params (%.2fB params, %s, tp=%d)",
                 mc.name, mc.num_params() / 1e9, config.dtype,
+                self.mesh.size if self.mesh else 1,
             )
-            params = llama.init_params(
-                mc, jax.random.key(config.seed), self.dtype
-            )
+            init_fn = lambda key: llama.init_params(mc, key, self.dtype)
+            if self.mesh is not None:
+                # init directly into the TP layout: no transient replicated
+                # copy of the full weights on any single chip
+                init_fn = jax.jit(
+                    init_fn,
+                    out_shardings=sharding_rules.param_shardings(
+                        self.mesh, mc
+                    ),
+                )
+            params = init_fn(jax.random.key(config.seed))
+        elif self.mesh is not None:
+            params = sharding_rules.shard_params(params, self.mesh, mc)
         self.params = params
 
         self.num_blocks = self._resolve_num_blocks()
@@ -75,8 +93,14 @@ class ModelRunner:
             self.num_blocks, self.block_size,
             2 * math.prod(cache_shape) * self.cache_dtype.itemsize / 2**30,
         )
-        self.k_cache = jnp.zeros(cache_shape, self.cache_dtype)
-        self.v_cache = jnp.zeros(cache_shape, self.cache_dtype)
+        zeros = lambda: jnp.zeros(cache_shape, self.cache_dtype)
+        if self.mesh is not None:
+            zeros = jax.jit(
+                zeros,
+                out_shardings=sharding_rules.cache_sharding(self.mesh),
+            )
+        self.k_cache = zeros()
+        self.v_cache = zeros()
 
         self._scale = mc.head_dim**-0.5
         # jit caches keyed by bucket tuple
@@ -98,15 +122,22 @@ class ModelRunner:
             * mc.head_dim
             * self.cache_dtype.itemsize
         )
+        tp = self.mesh.size if self.mesh is not None else 1
+        # per-chip view: weights and KV blocks are both split ~1/tp.
+        # params are already on device at this point, so live memory_stats
+        # include them; only the no-stats fallback estimates them.
         try:
             stats = jax.devices()[0].memory_stats() or {}
-            limit = stats.get("bytes_limit", 16 * 2**30)
-            in_use = stats.get("bytes_in_use", 0)
         except Exception:
-            limit, in_use = 16 * 2**30, 0
-        param_bytes = mc.num_params() * self.dtype.itemsize
-        budget = int(limit * cfg.hbm_utilization) - in_use - param_bytes
-        num = max(2, budget // bytes_per_block)
+            stats = {}
+        if "bytes_limit" in stats:
+            limit = stats["bytes_limit"]
+            reserved = stats.get("bytes_in_use", 0)
+        else:
+            limit = 16 * 2**30
+            reserved = mc.num_params() * self.dtype.itemsize // tp
+        budget = int(limit * cfg.hbm_utilization) - reserved
+        num = max(2, budget // (bytes_per_block // tp))
         # cap: no point holding more than max_model_len * max_num_seqs * 2
         cap = (
             2

@@ -1,0 +1,15 @@
+"""Device mesh + sharding rules: tensor parallelism over the ICI mesh."""
+
+from production_stack_tpu.parallel.sharding import (
+    cache_sharding,
+    make_mesh,
+    param_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "param_shardings",
+    "cache_sharding",
+    "shard_params",
+]
