@@ -124,18 +124,20 @@ class ModelRunner:
         )
         tp = self.mesh.size if self.mesh is not None else 1
         # per-chip view: weights and KV blocks are both split ~1/tp.
-        # params are already on device at this point, so live memory_stats
-        # include them; only the no-stats fallback estimates them.
+        param_bytes = mc.num_params() * self.dtype.itemsize // tp
         try:
             stats = jax.devices()[0].memory_stats() or {}
         except Exception:
             stats = {}
         if "bytes_limit" in stats:
             limit = stats["bytes_limit"]
-            reserved = stats.get("bytes_in_use", 0)
+            # caller-supplied params may still be host arrays at this point
+            # (server.py passes numpy); bytes_in_use then misses them, so
+            # reserve at least the weight estimate either way.
+            reserved = max(stats.get("bytes_in_use", 0), param_bytes)
         else:
             limit = 16 * 2**30
-            reserved = mc.num_params() * self.dtype.itemsize // tp
+            reserved = param_bytes
         budget = int(limit * cfg.hbm_utilization) - reserved
         num = max(2, budget // (bytes_per_block // tp))
         # cap: no point holding more than max_model_len * max_num_seqs * 2

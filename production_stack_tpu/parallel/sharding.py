@@ -12,8 +12,9 @@ Layout (Megatron-style, hidden activations replicated):
 - MLP: w_gate/w_up column-parallel, w_down row-parallel -> one psum;
 - KV cache: sharded over the kv-head axis, so paged attention is fully
   local to each chip (q heads and kv heads split congruently for GQA);
-- lm_head column-parallel over vocab; sampling's top_k runs over the
-  sharded vocab axis with an XLA-inserted all-gather of the top slice.
+- lm_head column-parallel over vocab for untied models; tied-embedding
+  models (e.g. Llama-3.2-1B) keep the embedding/vocab projection
+  replicated, since the same table serves token lookup.
 
 num_kv_heads and num_heads must be divisible by the tp size (true for the
 Llama/Mistral/Qwen2 family at tp in {1,2,4,8}).

@@ -1,0 +1,8 @@
+"""`python -m production_stack_tpu.router` — router CLI entry.
+
+Parity: reference pyproject.toml:32 `vllm-router` console script → app.main.
+"""
+
+from production_stack_tpu.router.app import main
+
+main()

@@ -1,0 +1,391 @@
+"""Router app bootstrap: wire singletons, build the aiohttp app, serve.
+
+Parity: reference src/vllm_router/app.py (initialize_all:127, lifespan:83,
+main:302) + the HTTP surface of routers/main_router.py:45-231 and
+routers/metrics_router.py:57-123. One aiohttp application instead of
+FastAPI+uvicorn — same endpoints, same Prometheus names, fewer moving parts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from production_stack_tpu import __version__
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.dynamic_config import (
+    initialize_dynamic_config_watcher,
+)
+from production_stack_tpu.router.feature_gates import (
+    get_feature_gates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.routing_logic import (
+    get_routing_logic,
+    initialize_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    get_service_discovery,
+    initialize_service_discovery,
+)
+from production_stack_tpu.router.services.callbacks_service import (
+    configure_custom_callbacks,
+)
+from production_stack_tpu.router.services.request_service import (
+    RequestService,
+)
+from production_stack_tpu.router.services.rewriter import (
+    get_request_rewriter,
+)
+from production_stack_tpu.router.stats.engine_stats import (
+    get_engine_stats_scraper,
+    initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.log_stats import (
+    update_prometheus_and_render,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    get_request_stats_monitor,
+    initialize_request_stats_monitor,
+)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class RouterApp:
+    """Holds the wired subsystems + the aiohttp Application."""
+
+    def __init__(self, args):
+        self.args = args
+        self.request_service: RequestService | None = None
+        self.file_storage = None
+        self.batch_processor = None
+        self.semantic_cache = None
+        self.pii_middleware = None
+        self.app = web.Application(middlewares=[self._error_middleware])
+        self._log_stats_task: asyncio.Task | None = None
+        self._initialize_all()
+        self._add_routes()
+
+    # -- wiring (reference: app.py:127-290) --------------------------------
+    def _initialize_all(self) -> None:
+        args = self.args
+        initialize_feature_gates(args.feature_gates)
+
+        if args.service_discovery == "static":
+            initialize_service_discovery(
+                "static",
+                urls=parsers.parse_comma_list(args.static_backends) or [],
+                model_names=parsers.parse_static_models(args.static_models),
+                aliases=parsers.parse_static_aliases(args.static_aliases),
+                model_labels=parsers.parse_comma_list(
+                    args.static_model_labels),
+                model_types=parsers.parse_comma_list(
+                    args.static_model_types),
+                static_backend_health_checks=(
+                    args.static_backend_health_checks),
+                health_check_interval_s=(
+                    args.backend_health_check_timeout_seconds),
+                prefill_model_labels=parsers.parse_comma_list(
+                    args.prefill_model_labels),
+                decode_model_labels=parsers.parse_comma_list(
+                    args.decode_model_labels),
+            )
+        else:
+            discovery_type = (
+                "k8s_service_name"
+                if (args.service_discovery == "k8s_service_name"
+                    or args.k8s_service_discovery_type == "service-name")
+                else "k8s"
+            )
+            initialize_service_discovery(
+                discovery_type,
+                namespace=args.k8s_namespace,
+                port=args.k8s_port,
+                label_selector=args.k8s_label_selector,
+            )
+
+        initialize_engine_stats_scraper(args.engine_stats_interval)
+        initialize_request_stats_monitor(args.request_stats_window)
+
+        tokenizer = None
+        if args.tokenizer:
+            from production_stack_tpu.engine.tokenizer import get_tokenizer
+
+            tokenizer = get_tokenizer(args.tokenizer, args.tokenizer)
+        initialize_routing_logic(
+            args.routing_logic,
+            session_key=args.session_key,
+            kv_controller_url=args.kv_controller_url,
+            kv_min_match_tokens=args.kv_aware_threshold,
+            tokenizer=tokenizer,
+        )
+
+        callbacks = configure_custom_callbacks(args.callbacks)
+        rewriter = (
+            get_request_rewriter(args.request_rewriter)
+            if args.request_rewriter else None
+        )
+
+        gates = get_feature_gates()
+        if gates.enabled("SemanticCache"):
+            from production_stack_tpu.router.experimental.semantic_cache import (  # noqa: E501
+                SemanticCache,
+            )
+
+            self.semantic_cache = SemanticCache(
+                model_name=args.semantic_cache_model,
+                cache_dir=args.semantic_cache_dir,
+                threshold=args.semantic_cache_threshold,
+            )
+        if gates.enabled("PIIDetection"):
+            from production_stack_tpu.router.experimental.pii import (
+                PIIMiddleware,
+            )
+
+            self.pii_middleware = PIIMiddleware(
+                analyzer=args.pii_analyzer, action=args.pii_action
+            )
+
+        self.request_service = RequestService(
+            session_key=args.session_key,
+            callbacks=callbacks,
+            rewriter=rewriter,
+            semantic_cache=self.semantic_cache,
+            request_timeout_s=args.request_timeout_seconds,
+        )
+
+        if args.enable_batch_api:
+            from production_stack_tpu.router.services.batch_service import (
+                LocalBatchProcessor,
+            )
+            from production_stack_tpu.router.services.files_service import (
+                FileStorage,
+            )
+
+            self.file_storage = FileStorage(args.file_storage_path)
+            self.batch_processor = LocalBatchProcessor(
+                args.file_storage_path, self.file_storage,
+                self.request_service,
+            )
+
+        if args.dynamic_config_yaml or args.dynamic_config_json:
+            initialize_dynamic_config_watcher(
+                args.dynamic_config_yaml or args.dynamic_config_json,
+                request_service=self.request_service,
+            )
+
+    # -- routes ------------------------------------------------------------
+    def _add_routes(self) -> None:
+        r = self.app.router
+        proxy = self._proxy_handler
+        for path in ("/v1/chat/completions", "/v1/completions",
+                     "/v1/embeddings", "/v1/rerank", "/v1/score",
+                     "/tokenize", "/detokenize"):
+            r.add_post(path, proxy)
+        r.add_get("/v1/models", self.handle_models)
+        r.add_get("/version", self.handle_version)
+        r.add_get("/health", self.handle_health)
+        r.add_get("/metrics", self.handle_metrics)
+        r.add_get("/engines", self.handle_engines)
+        r.add_post("/sleep", self._sleep_wake_handler)
+        r.add_post("/wake_up", self._sleep_wake_handler)
+        r.add_get("/is_sleeping", self._sleep_wake_handler)
+        if self.file_storage is not None:
+            from production_stack_tpu.router.services.files_service import (
+                add_file_routes,
+            )
+
+            add_file_routes(r, self.file_storage)
+        if self.batch_processor is not None:
+            from production_stack_tpu.router.services.batch_service import (
+                add_batch_routes,
+            )
+
+            add_batch_routes(r, self.batch_processor)
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+
+    @web.middleware
+    async def _error_middleware(self, request, handler):
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 — router must not die per-req
+            logger.exception("unhandled error on %s", request.path)
+            return web.json_response(
+                {"error": {"message": str(e), "type": "internal_error"}},
+                status=500,
+            )
+
+    # -- lifecycle (reference: app.py:83-124) ------------------------------
+    async def _on_startup(self, app: web.Application) -> None:
+        await self.request_service.start()
+        await get_service_discovery().start()
+        await get_engine_stats_scraper().start()
+        router = get_routing_logic()
+        if hasattr(router, "start"):
+            await router.start()
+        if self.batch_processor is not None:
+            await self.batch_processor.start()
+        watcher = _get_watcher()
+        if watcher is not None:
+            await watcher.start()
+        if self.args.log_stats:
+            self._log_stats_task = asyncio.create_task(
+                self._log_stats_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._log_stats_task:
+            self._log_stats_task.cancel()
+        if self.batch_processor is not None:
+            await self.batch_processor.close()
+        router = get_routing_logic()
+        if hasattr(router, "close"):
+            await router.close()
+        await get_engine_stats_scraper().close()
+        await get_service_discovery().close()
+        await self.request_service.close()
+
+    async def _log_stats_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.args.log_stats_interval)
+            try:
+                logger.info(update_prometheus_and_render())
+            except Exception as e:  # noqa: BLE001
+                logger.warning("log_stats failed: %s", e)
+
+    # -- handlers ----------------------------------------------------------
+    async def _proxy_handler(self, request: web.Request):
+        if self.pii_middleware is not None:
+            blocked = await self.pii_middleware.check(request)
+            if blocked is not None:
+                return blocked
+        if self.semantic_cache is not None and request.path.endswith(
+                "chat/completions"):
+            hit = await self.semantic_cache.check(request)
+            if hit is not None:
+                return hit
+        return await self.request_service.route_general_request(
+            request, request.path
+        )
+
+    async def _sleep_wake_handler(self, request: web.Request):
+        return await self.request_service.route_sleep_wakeup_request(
+            request, request.path
+        )
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        cards, seen = [], set()
+        for ep in get_service_discovery().get_endpoint_info():
+            for name in ep.model_names:
+                if name not in seen:
+                    seen.add(name)
+                    info = ep.model_info.get(name)
+                    cards.append(
+                        info.to_dict() if info else
+                        {"id": name, "object": "model",
+                         "created": int(ep.added_timestamp),
+                         "owned_by": "production-stack-tpu"}
+                    )
+            for alias in ep.aliases:
+                if alias not in seen:
+                    seen.add(alias)
+                    cards.append({"id": alias, "object": "model",
+                                  "created": int(ep.added_timestamp),
+                                  "owned_by": "production-stack-tpu"})
+        return web.json_response({"object": "list", "data": cards})
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """Aggregate subsystem liveness (reference: main_router.py:196)."""
+        problems = []
+        try:
+            get_service_discovery()
+        except RuntimeError:
+            problems.append("service discovery not initialized")
+        try:
+            get_routing_logic()
+        except RuntimeError:
+            problems.append("routing logic not initialized")
+        scraper = get_engine_stats_scraper()
+        if not scraper.get_health():
+            problems.append("engine stats scraper stalled")
+        if problems:
+            return web.json_response(
+                {"status": "unhealthy", "problems": problems}, status=503
+            )
+        return web.json_response({"status": "healthy"})
+
+    async def handle_engines(self, request: web.Request) -> web.Response:
+        import dataclasses
+
+        endpoints = get_service_discovery().get_endpoint_info()
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        request_stats = get_request_stats_monitor().get_request_stats()
+        out = []
+        for ep in endpoints:
+            es = engine_stats.get(ep.url)
+            rs = request_stats.get(ep.url)
+            out.append({
+                "url": ep.url,
+                "models": ep.model_names,
+                "model_label": ep.model_label,
+                "sleep": ep.sleep,
+                "engine_stats": dataclasses.asdict(es) if es else None,
+                "request_stats": dataclasses.asdict(rs) if rs else None,
+            })
+        return web.json_response({"engines": out})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus exposition: router gauges + psutil host stats
+        (reference: metrics_router.py:57-123)."""
+        try:
+            update_prometheus_and_render()
+        except RuntimeError:
+            pass
+        from production_stack_tpu.router.services import metrics_service
+
+        text = metrics_service.render_prometheus()
+        return web.Response(
+            text=text, content_type="text/plain", charset="utf-8"
+        )
+
+
+def _get_watcher():
+    from production_stack_tpu.router.dynamic_config import (
+        get_dynamic_config_watcher,
+    )
+
+    return get_dynamic_config_watcher()
+
+
+def build_app(args) -> RouterApp:
+    return RouterApp(args)
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = parsers.parse_args(argv)
+    import logging
+
+    logging.getLogger("production_stack_tpu").setLevel(
+        args.log_level.upper() if args.log_level != "trace" else "DEBUG"
+    )
+    router_app = build_app(args)
+    logger.info(
+        "starting tpu-router v%s on %s:%d (routing=%s discovery=%s)",
+        __version__, args.host, args.port, args.routing_logic,
+        args.service_discovery,
+    )
+    web.run_app(
+        router_app.app, host=args.host, port=args.port, print=None
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import abc
 import enum
+import random
 
 from production_stack_tpu.router.hashring import HashRing
 from production_stack_tpu.router.hashtrie import HashTrie
@@ -60,17 +61,14 @@ class RoutingInterface(abc.ABC):
         endpoints: list[EndpointInfo],
         request_stats: dict[str, RequestStats],
     ) -> str:
-        best_url, best_qps = None, float("inf")
-        for ep in endpoints:
-            qps = (
-                request_stats[ep.url].qps
-                if ep.url in request_stats
-                else 0.0
-            )
-            if qps < best_qps:
-                best_url, best_qps = ep.url, qps
-        assert best_url is not None
-        return best_url
+        qps_of = lambda ep: (
+            request_stats[ep.url].qps if ep.url in request_stats else 0.0
+        )
+        best = min(qps_of(ep) for ep in endpoints)
+        # ties (cold start: every engine at 0 QPS) spread randomly instead
+        # of herding onto the first endpoint
+        tied = [ep.url for ep in endpoints if qps_of(ep) == best]
+        return random.choice(tied)
 
 
 class RoundRobinRouter(RoutingInterface):

@@ -47,3 +47,40 @@ healthy_pods_total = _g(
     "vllm:healthy_pods_total", "Healthy serving engines"
 )
 avg_ttft = _g("vllm:avg_ttft", "Average time to first token")
+
+# router-host resource gauges (reference: routers/metrics_router.py:42-53)
+_router_g = lambda name, doc: Gauge(name, doc, registry=ROUTER_REGISTRY)
+router_cpu_percent = _router_g(
+    "router:cpu_usage_percent", "Router host CPU usage"
+)
+router_mem_percent = _router_g(
+    "router:memory_usage_percent", "Router host memory usage"
+)
+router_disk_percent = _router_g(
+    "router:disk_usage_percent", "Router host disk usage"
+)
+
+
+# prime the per-process CPU sample so the first scrape isn't a false 0.0
+try:
+    import psutil as _psutil
+
+    _psutil.cpu_percent()
+except ImportError:
+    pass
+
+
+def render_prometheus() -> str:
+    """Prometheus exposition text for the /metrics endpoint, including the
+    psutil host gauges (reference: metrics_router.py:77-86)."""
+    try:
+        import psutil
+
+        router_cpu_percent.set(psutil.cpu_percent())
+        router_mem_percent.set(psutil.virtual_memory().percent)
+        router_disk_percent.set(psutil.disk_usage("/").percent)
+    except ImportError:
+        pass
+    from prometheus_client import generate_latest
+
+    return generate_latest(ROUTER_REGISTRY).decode()

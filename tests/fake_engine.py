@@ -1,0 +1,176 @@
+"""Fake serving-engine server for router tests.
+
+The single most load-bearing test fixture (reference pattern:
+src/tests/perftest/fake-openai-server.py — a mock OpenAI server streaming
+tokens at a configurable rate, plus the vllm:* /metrics surface the router
+scrapes, contract at src/vllm_router/stats/engine_stats.py:63-76).
+
+Runs in-process on aiohttp; tests start several on different ports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+
+class FakeEngine:
+    def __init__(
+        self,
+        model: str = "fake-model",
+        tokens_per_sec: float = 1000.0,
+        ttft_s: float = 0.0,
+        num_tokens: int = 8,
+        model_label: str | None = None,
+    ):
+        self.model = model
+        self.tokens_per_sec = tokens_per_sec
+        self.ttft_s = ttft_s
+        self.num_tokens = num_tokens
+        self.model_label = model_label
+        self.requests_seen: list[dict] = []
+        self.running = 0
+        self.sleeping = False
+        self.app = web.Application()
+        r = self.app.router
+        r.add_post("/v1/completions", self.completions)
+        r.add_post("/v1/chat/completions", self.chat)
+        r.add_get("/v1/models", self.models)
+        r.add_get("/metrics", self.metrics)
+        r.add_get("/health", self.health)
+        r.add_post("/tokenize", self.tokenize)
+        r.add_post("/sleep", self.sleep)
+        r.add_post("/wake_up", self.wake_up)
+        r.add_get("/is_sleeping", self.is_sleeping)
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, port: int = 0) -> str:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.url
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+    async def completions(self, request: web.Request):
+        return await self._generate(request, chat=False)
+
+    async def chat(self, request: web.Request):
+        return await self._generate(request, chat=True)
+
+    async def _generate(self, request: web.Request, chat: bool):
+        body = await request.json()
+        self.requests_seen.append(body)
+        self.running += 1
+        try:
+            n = int(body.get("max_tokens", self.num_tokens))
+            rid = f"cmpl-{uuid.uuid4().hex}"
+            if self.ttft_s:
+                await asyncio.sleep(self.ttft_s)
+            interval = 1.0 / self.tokens_per_sec
+            if body.get("stream"):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"}
+                )
+                await resp.prepare(request)
+                for i in range(n):
+                    if chat:
+                        delta = {"choices": [{"index": 0, "delta":
+                                              {"content": f"tok{i} "}}],
+                                 "id": rid, "model": self.model,
+                                 "object": "chat.completion.chunk"}
+                    else:
+                        delta = {"choices": [{"index": 0,
+                                              "text": f"tok{i} "}],
+                                 "id": rid, "model": self.model,
+                                 "object": "text_completion"}
+                    await resp.write(
+                        f"data: {json.dumps(delta)}\n\n".encode()
+                    )
+                    await asyncio.sleep(interval)
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            await asyncio.sleep(n * interval)
+            text = " ".join(f"tok{i}" for i in range(n))
+            if chat:
+                payload = {
+                    "id": rid, "object": "chat.completion",
+                    "model": self.model, "created": int(time.time()),
+                    "choices": [{"index": 0, "message":
+                                 {"role": "assistant", "content": text},
+                                 "finish_reason": "length"}],
+                    "usage": {"prompt_tokens": 10, "completion_tokens": n,
+                              "total_tokens": 10 + n},
+                }
+            else:
+                payload = {
+                    "id": rid, "object": "text_completion",
+                    "model": self.model, "created": int(time.time()),
+                    "choices": [{"index": 0, "text": text,
+                                 "finish_reason": "length"}],
+                    "usage": {"prompt_tokens": 10, "completion_tokens": n,
+                              "total_tokens": 10 + n},
+                }
+            return web.json_response(payload)
+        finally:
+            self.running -= 1
+
+    async def models(self, request: web.Request):
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model, "object": "model",
+                      "created": int(time.time()),
+                      "owned_by": "fake-engine"}],
+        })
+
+    async def metrics(self, request: web.Request):
+        lines = [
+            "# TYPE vllm:num_requests_running gauge",
+            f'vllm:num_requests_running{{model_name="{self.model}"}} '
+            f"{self.running}",
+            "# TYPE vllm:num_requests_waiting gauge",
+            f'vllm:num_requests_waiting{{model_name="{self.model}"}} 0',
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            f'vllm:gpu_cache_usage_perc{{model_name="{self.model}"}} 0.25',
+            "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
+            f'vllm:gpu_prefix_cache_hit_rate{{model_name="{self.model}"}} '
+            "0.5",
+        ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def health(self, request: web.Request):
+        return web.json_response({"status": "ok"})
+
+    async def tokenize(self, request: web.Request):
+        body = await request.json()
+        text = body.get("prompt", "")
+        tokens = list(text.encode())
+        return web.json_response({"tokens": tokens, "count": len(tokens)})
+
+    async def sleep(self, request: web.Request):
+        self.sleeping = True
+        return web.json_response({"status": "sleeping"})
+
+    async def wake_up(self, request: web.Request):
+        self.sleeping = False
+        return web.json_response({"status": "awake"})
+
+    async def is_sleeping(self, request: web.Request):
+        return web.json_response({"is_sleeping": self.sleeping})
