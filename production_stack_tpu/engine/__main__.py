@@ -108,6 +108,20 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    if args.kv_instance_id == "default-instance":
+        # by convention the instance id is host:port so kvaware routing can
+        # map controller matches back to endpoint urls (routing_logic.py);
+        # 0.0.0.0 never appears in an endpoint url, so resolve a real
+        # address for the id
+        host = args.host
+        if host in ("0.0.0.0", "::", ""):
+            import socket
+
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+        args.kv_instance_id = f"{host}:{args.port}"
     server = EngineServer(config_from_args(args))
     server.run(host=args.host, port=args.port)
 

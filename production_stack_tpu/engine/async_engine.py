@@ -58,6 +58,7 @@ class AsyncLLMEngine:
         self._wake.set()
         if self._thread.is_alive():
             self._thread.join(timeout=5)
+        self.engine.shutdown()
 
     # -- step loop thread --------------------------------------------------
     def _step_loop(self) -> None:

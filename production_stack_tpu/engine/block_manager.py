@@ -72,6 +72,15 @@ class BlockManager:
         self.prefix_queries = 0
         self.prefix_hits = 0
 
+        # KV offload hooks (wired by LLMEngine when offload is configured):
+        # on_admit(hashes)      -> new cached blocks live in HBM
+        # on_evict(hashes)      -> cached blocks dropped from HBM
+        # on_freed_cached(pairs)-> [(block_id, hash)] just became evictable;
+        #                          contents still intact, safe to d2h-export
+        self.on_admit = None
+        self.on_evict = None
+        self.on_freed_cached = None
+
     # -- capacity ---------------------------------------------------------
     @property
     def num_free_blocks(self) -> int:
@@ -95,6 +104,8 @@ class BlockManager:
             blk = self.blocks[bid]
             if blk.block_hash is not None:
                 self.cached_blocks.pop(blk.block_hash, None)
+                if self.on_evict is not None:
+                    self.on_evict([blk.block_hash])
                 blk.block_hash = None
             return bid
         raise RuntimeError("out of KV blocks")
@@ -115,6 +126,9 @@ class BlockManager:
             prev = hash_block(prev, tuple(token_ids[i * bs : (i + 1) * bs]))
             hashes.append(prev)
         return hashes
+
+    def contains_hash(self, h: int) -> bool:
+        return h in self.cached_blocks
 
     def match_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
         """Longest cached prefix: returns (block_ids, num_cached_tokens).
@@ -193,10 +207,35 @@ class BlockManager:
         if blk.block_hash is None and h not in self.cached_blocks:
             blk.block_hash = h
             self.cached_blocks[h] = block_id
+            if self.on_admit is not None:
+                self.on_admit([h])
         return h
+
+    def adopt_cached_block(self, h: int) -> int | None:
+        """Claim a free block to hold offload-restored contents for hash h.
+
+        The block enters the cache ref_count==0 and evictable, exactly like
+        a block left behind by a finished sequence; the caller must import
+        the KV contents before the next model step. Returns None when no
+        block can be claimed (restore is best-effort, admission continues
+        with whatever prefix is already in HBM).
+        """
+        if not self.enable_prefix_caching or h in self.cached_blocks:
+            return None
+        if not self.free_blocks and not self.evictable:
+            return None
+        bid = self._pop_free_block()
+        blk = self.blocks[bid]
+        blk.block_hash = h
+        self.cached_blocks[h] = bid
+        self.evictable[bid] = None
+        if self.on_admit is not None:
+            self.on_admit([h])
+        return bid
 
     def free(self, block_table: list[int]) -> None:
         """Release a sequence's references; cached blocks become evictable."""
+        freed_cached: list[tuple[int, int]] = []
         for bid in block_table:
             blk = self.blocks[bid]
             blk.ref_count -= 1
@@ -204,5 +243,9 @@ class BlockManager:
             if blk.ref_count == 0:
                 if blk.block_hash is not None:
                     self.evictable[bid] = None  # keep contents, LRU-evictable
+                    freed_cached.append((bid, blk.block_hash))
                 else:
                     self.free_blocks.append(bid)
+        if freed_cached and self.on_freed_cached is not None:
+            # one batched d2h export per freed sequence (see kv/offload.py)
+            self.on_freed_cached(freed_cached)

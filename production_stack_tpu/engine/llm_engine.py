@@ -63,6 +63,74 @@ class LLMEngine:
         self._preemptions_total = 0
         self._finished_total = 0
 
+        # -- KV offload tiers + controller reporting (LMCache-equivalent) --
+        self.kv_reporter = None
+        self.offload = None
+        if config.kv_controller_url:
+            from production_stack_tpu.kv.controller import ControllerReporter
+
+            self.kv_reporter = ControllerReporter(
+                config.kv_controller_url,
+                instance_id=config.kv_instance_id,
+                url=config.kv_instance_id,
+                block_size=config.block_size,
+                snapshot_fn=self._kv_snapshot,
+            )
+        from production_stack_tpu.kv.offload import build_offload_manager
+
+        self.offload = build_offload_manager(config, self.kv_reporter)
+        if self.kv_reporter is not None:
+            bm = self.block_manager
+            bm.on_admit = lambda hs: self.kv_reporter.admit("hbm", hs)
+            bm.on_evict = lambda hs: self.kv_reporter.evict("hbm", hs)
+        if self.offload is not None:
+            self.block_manager.on_freed_cached = self._offload_freed_blocks
+            self.scheduler.kv_restore = self._restore_from_offload
+
+    # -- KV offload integration -------------------------------------------
+    def _kv_snapshot(self) -> dict[str, list[int]]:
+        """Full tier->hashes state for controller (re)registration replay."""
+        out = {"hbm": list(self.block_manager.cached_blocks.keys())}
+        if self.offload is not None:
+            out.update(self.offload.snapshot())
+        return out
+
+    def _offload_freed_blocks(self, pairs: list[tuple[int, int]]) -> None:
+        """Cached blocks just became evictable: batched d2h export -> tiers."""
+        pairs = [(bid, h) for bid, h in pairs if not self.offload.contains(h)]
+        if not pairs:
+            return
+        data = self.runner.export_blocks([bid for bid, _ in pairs])
+        self.offload.put_batch(
+            [(h, data[:, :, i]) for i, (_, h) in enumerate(pairs)]
+        )
+
+    def _restore_from_offload(self, seq: Sequence) -> None:
+        """Before admission: pull chain-continuation blocks from offload
+        tiers back into HBM so allocate_prompt sees a longer cached prefix
+        (role of LMCache retrieve on prefix hit)."""
+        bm = self.block_manager
+        if not bm.enable_prefix_caching:
+            return
+        hashes = bm.block_hashes_for(seq.prompt_token_ids)
+        matched, _ = bm.match_prefix(seq.prompt_token_ids)
+        restore: list[tuple[int, np.ndarray]] = []  # (block_id, data)
+        for h in hashes[len(matched):]:
+            if bm.contains_hash(h):
+                break  # already back in HBM (another seq restored it)
+            arr = self.offload.get(h)
+            if arr is None:
+                break  # chain broken; later blocks are useless
+            bid = bm.adopt_cached_block(h)
+            if bid is None:
+                break  # no HBM room; partial restore is still a win
+            restore.append((bid, arr))
+        if restore:
+            self.runner.import_blocks(
+                [bid for bid, _ in restore],
+                np.stack([a for _, a in restore], axis=2),
+            )
+
     # -- request lifecycle ------------------------------------------------
     def add_request(
         self,
@@ -291,6 +359,12 @@ class LLMEngine:
 
     def list_loras(self) -> list[str]:
         return sorted(getattr(self, "_loras", {}))
+
+    def shutdown(self) -> None:
+        if self.offload is not None:
+            self.offload.close()
+        if self.kv_reporter is not None:
+            self.kv_reporter.close()
 
     # -- stats for /metrics -------------------------------------------------
     def stats(self) -> EngineStatsSnapshot:

@@ -76,6 +76,9 @@ class Scheduler:
         self.block_manager = block_manager
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # optional hook (LLMEngine._restore_from_offload): pull offloaded
+        # KV blocks back into HBM before prompt allocation
+        self.kv_restore = None
 
     # -- queue introspection (feeds the vllm:num_requests_* gauges) -------
     @property
@@ -138,6 +141,8 @@ class Scheduler:
                 self.waiting.popleft()
                 out.aborted.append(seq)
                 continue
+            if self.kv_restore is not None:
+                self.kv_restore(seq)
             alloc = self.block_manager.allocate_prompt(seq.prompt_token_ids)
             if alloc is None:
                 break  # out of blocks; retry next step

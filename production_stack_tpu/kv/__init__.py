@@ -1,0 +1,19 @@
+"""KV cache offload + orchestration (the LMCache-equivalent subsystem).
+
+TPU-native tiering: KV blocks live in HBM (managed by the engine's
+BlockManager); when cached blocks are evicted from HBM they cascade down
+host-RAM -> local disk -> remote cache server tiers (reference capability:
+LMCache LocalCpuBackend/LocalDiskBackend + remote server, orchestrated via
+helm env LMCACHE_* in deployment-vllm-multi.yaml:257-345).
+
+A central KV controller (reference: LMCache controller manager imported at
+routing_logic.py:31-39, TCP protocol) tracks which engine instance holds
+which block hashes in which tier, answering Lookup/FullLookup/QueryInst
+messages so `kvaware` and `ttft` routing work.
+
+Modules:
+  wire          length-prefixed JSON+payload framing (async + sync)
+  controller    KVController server, KVControllerClient, ControllerReporter
+  offload       CpuTier / DiskTier / RemoteTier + KVOffloadManager
+  cache_server  standalone remote KV cache server process + client
+"""

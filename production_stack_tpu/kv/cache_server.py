@@ -1,0 +1,222 @@
+"""Standalone remote KV cache server (LMCache remote-server equivalent).
+
+Reference deploys `lmcache_experimental_server` as a shared cache pod
+(helm/templates/deployment-cache-server.yaml:44-52); engines push evicted
+KV blocks to it and pull them back on prefix hits from any replica. Ours
+is an asyncio TCP server storing blocks in a host-RAM LRU with an optional
+disk spill tier, speaking the same length-prefixed frames as the KV
+controller (kv/wire.py).
+
+Run: python -m production_stack_tpu.kv.cache_server --port 8100 \
+         --capacity-gb 16 [--disk-dir /data/kvcache --disk-capacity-gb 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import socket
+import threading
+
+import numpy as np
+
+from production_stack_tpu.kv import wire
+from production_stack_tpu.kv.offload import (
+    CpuTier,
+    DiskTier,
+    deserialize_block,
+    serialize_block,
+)
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_PORT = 8100
+
+
+class KVCacheServer:
+    def __init__(self, capacity_bytes: int = 16 * 2**30,
+                 disk_dir: str | None = None,
+                 disk_capacity_bytes: int | None = None):
+        self.tiers = [CpuTier(capacity_bytes)]
+        if disk_dir:
+            self.tiers.append(DiskTier(disk_dir, disk_capacity_bytes))
+        self._lock = threading.Lock()
+        self._server: asyncio.AbstractServer | None = None
+        self.puts = 0
+        self.gets = 0
+        self.hits = 0
+
+    # -- storage -----------------------------------------------------------
+    def put(self, h: int, arr: np.ndarray) -> None:
+        with self._lock:
+            self.puts += 1
+            cascade = [(h, arr)]
+            for tier in self.tiers:
+                nxt = []
+                for ch, carr in cascade:
+                    nxt.extend(tier.put(ch, carr))
+                cascade = nxt
+                if not cascade:
+                    break
+
+    def get(self, h: int) -> np.ndarray | None:
+        with self._lock:
+            self.gets += 1
+            for tier in self.tiers:
+                arr = tier.get(h)
+                if arr is not None:
+                    self.hits += 1
+                    return arr
+        return None
+
+    def exists(self, h: int) -> bool:
+        with self._lock:
+            return any(t.contains(h) for t in self.tiers)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "puts": self.puts, "gets": self.gets, "hits": self.hits,
+                "tiers": [t.stats() for t in self.tiers],
+            }
+
+    # -- TCP ---------------------------------------------------------------
+    async def start(self, host: str = "0.0.0.0",
+                    port: int = DEFAULT_PORT) -> None:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        logger.info("kv-cache-server listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    msg, payload = await wire.recv_msg(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                t = msg.get("type")
+                if t == "put":
+                    arr = deserialize_block(payload)
+                    # big serialize/IO under a thread so the loop stays live
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.put, msg["hash"], arr
+                    )
+                    await wire.send_msg(writer, {"ok": True})
+                elif t == "get":
+                    arr = await asyncio.get_running_loop().run_in_executor(
+                        None, self.get, msg["hash"]
+                    )
+                    if arr is None:
+                        await wire.send_msg(writer, {"ok": True, "found": False})
+                    else:
+                        await wire.send_msg(
+                            writer, {"ok": True, "found": True},
+                            serialize_block(arr),
+                        )
+                elif t == "exists":
+                    await wire.send_msg(
+                        writer, {"ok": True, "found": self.exists(msg["hash"])}
+                    )
+                elif t == "stats":
+                    await wire.send_msg(writer, {"ok": True, **self.stats()})
+                elif t == "ping":
+                    await wire.send_msg(writer, {"ok": True})
+                else:
+                    await wire.send_msg(
+                        writer, {"ok": False, "error": f"unknown type {t!r}"}
+                    )
+        finally:
+            writer.close()
+
+
+class RemoteCacheClient:
+    """Blocking client used by the engine's RemoteTier (worker thread)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            )
+            self._sock.settimeout(self.timeout)
+        return self._sock
+
+    def _call(self, msg: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        with self._lock:
+            try:
+                s = self._ensure()
+                wire.sync_send(s, msg, payload)
+                return wire.sync_recv(s)
+            except OSError:
+                self.close()
+                s = self._ensure()  # one reconnect, then let it raise
+                wire.sync_send(s, msg, payload)
+                return wire.sync_recv(s)
+
+    def put(self, h: int, arr: np.ndarray) -> None:
+        reply, _ = self._call({"type": "put", "hash": h}, serialize_block(arr))
+        if not reply.get("ok"):
+            raise OSError(reply.get("error", "put failed"))
+
+    def get(self, h: int) -> np.ndarray | None:
+        reply, payload = self._call({"type": "get", "hash": h})
+        if not reply.get("ok"):
+            raise OSError(reply.get("error", "get failed"))
+        if not reply.get("found"):
+            return None
+        return deserialize_block(payload)
+
+    def exists(self, h: int) -> bool:
+        reply, _ = self._call({"type": "exists", "hash": h})
+        return bool(reply.get("found"))
+
+    def stats(self) -> dict:
+        reply, _ = self._call({"type": "stats"})
+        return reply
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="TPU stack remote KV cache server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--capacity-gb", type=float, default=16.0)
+    p.add_argument("--disk-dir", default=None)
+    p.add_argument("--disk-capacity-gb", type=float, default=None)
+    args = p.parse_args()
+
+    async def run() -> None:
+        srv = KVCacheServer(
+            capacity_bytes=int(args.capacity_gb * 2**30),
+            disk_dir=args.disk_dir,
+            disk_capacity_bytes=(
+                int(args.disk_capacity_gb * 2**30)
+                if args.disk_capacity_gb else None
+            ),
+        )
+        await srv.start(args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

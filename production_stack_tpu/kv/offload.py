@@ -1,0 +1,385 @@
+"""Engine-side KV offload tiers: host RAM -> local disk -> remote server.
+
+Capability parity with LMCache's LocalCpuBackend / LocalDiskBackend /
+remote server (reference: routing_logic.py:655-657 names the backends;
+helm wires cpuOffloadingBufferSize / diskOffloadingBufferSize / remote
+cache server at deployment-vllm-multi.yaml:307-323). TPU-native twist:
+blocks arrive as host numpy arrays produced by the model runner's
+device->host block export (model_runner.export_blocks), i.e. the d2h DMA
+is done in one batched copy per freed sequence, not per block.
+
+Each tier is an LRU keyed by the chained block hash (same content address
+the BlockManager and KV controller use). Evictions cascade to the next
+tier. Disk/remote writes happen on a worker thread so the engine step loop
+never blocks on IO; lookups consult the pending-write map first so a block
+is visible the moment it is enqueued.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import queue
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def _nbytes(arr: np.ndarray) -> int:
+    return int(arr.nbytes)
+
+
+def serialize_block(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def deserialize_block(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class KVTier:
+    """Interface for one offload tier.
+
+    Implementations are internally thread-safe: the engine step thread
+    calls get()/contains() while the manager's writer thread calls put().
+    """
+
+    name = "tier"
+
+    def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
+        """Store; returns blocks evicted to make room (cascade down)."""
+        raise NotImplementedError
+
+    def get(self, h: int) -> np.ndarray | None:
+        raise NotImplementedError
+
+    def contains(self, h: int) -> bool:
+        raise NotImplementedError
+
+    def hashes(self) -> list[int]:
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        raise NotImplementedError
+
+
+class CpuTier(KVTier):
+    """Host-RAM LRU of KV blocks."""
+
+    name = "cpu"
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._d: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._lock = threading.RLock()
+
+    def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
+        with self._lock:
+            if h in self._d:
+                self._d.move_to_end(h)
+                return []
+            n = _nbytes(arr)
+            if n > self.capacity:
+                return [(h, arr)]  # doesn't fit at all; pass straight down
+            evicted = []
+            while self.used + n > self.capacity and self._d:
+                eh, earr = self._d.popitem(last=False)
+                self.used -= _nbytes(earr)
+                evicted.append((eh, earr))
+            self._d[h] = arr
+            self.used += n
+            return evicted
+
+    def get(self, h: int) -> np.ndarray | None:
+        with self._lock:
+            arr = self._d.get(h)
+            if arr is not None:
+                self._d.move_to_end(h)
+            return arr
+
+    def contains(self, h: int) -> bool:
+        with self._lock:
+            return h in self._d
+
+    def hashes(self) -> list[int]:
+        with self._lock:
+            return list(self._d.keys())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tier": self.name, "blocks": len(self._d),
+                    "used_bytes": self.used, "capacity_bytes": self.capacity}
+
+
+class DiskTier(KVTier):
+    """Local-disk LRU; one file per block hash."""
+
+    name = "disk"
+
+    def __init__(self, directory: str, capacity_bytes: int | None = None):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._sizes: OrderedDict[int, int] = OrderedDict()
+        self._lock = threading.RLock()
+        # adopt pre-existing blocks (restart resume)
+        for fn in os.listdir(directory):
+            if fn.endswith(".kvblk"):
+                try:
+                    h = int(fn[:-6])
+                except ValueError:
+                    continue
+                sz = os.path.getsize(os.path.join(directory, fn))
+                self._sizes[h] = sz
+                self.used += sz
+
+    def _path(self, h: int) -> str:
+        return os.path.join(self.dir, f"{h}.kvblk")
+
+    def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
+        data = serialize_block(arr)  # serialize outside the lock
+        with self._lock:
+            if h in self._sizes:
+                self._sizes.move_to_end(h)
+                return []
+            evicted = []
+            if self.capacity is not None:
+                if len(data) > self.capacity:
+                    return [(h, arr)]
+                while self.used + len(data) > self.capacity and self._sizes:
+                    eh, esz = self._sizes.popitem(last=False)
+                    earr = self._read(eh)
+                    try:
+                        os.remove(self._path(eh))
+                    except OSError:
+                        pass
+                    self.used -= esz
+                    if earr is not None:
+                        evicted.append((eh, earr))
+            tmp = self._path(h) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._path(h))
+            self._sizes[h] = len(data)
+            self.used += len(data)
+            return evicted
+
+    def _read(self, h: int) -> np.ndarray | None:
+        try:
+            with open(self._path(h), "rb") as f:
+                return deserialize_block(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def get(self, h: int) -> np.ndarray | None:
+        with self._lock:
+            if h not in self._sizes:
+                return None
+            arr = self._read(h)
+            if arr is None:
+                self._sizes.pop(h, None)
+                return None
+            self._sizes.move_to_end(h)
+            return arr
+
+    def contains(self, h: int) -> bool:
+        with self._lock:
+            return h in self._sizes
+
+    def hashes(self) -> list[int]:
+        with self._lock:
+            return list(self._sizes.keys())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tier": self.name, "blocks": len(self._sizes),
+                    "used_bytes": self.used, "capacity_bytes": self.capacity}
+
+
+class RemoteTier(KVTier):
+    """Remote cache-server tier (shared across engines).
+
+    contains() consults a local memo of hashes this engine pushed (no
+    network round-trip — it sits on the engine's free/admission paths);
+    get() does the real fetch and also finds blocks pushed by peers.
+    """
+
+    name = "remote"
+
+    def __init__(self, client):
+        # client: production_stack_tpu.kv.cache_server.RemoteCacheClient
+        self.client = client
+        self._pushed: set[int] = set()
+        self._lock = threading.RLock()
+
+    def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
+        try:
+            self.client.put(h, arr)
+            with self._lock:
+                self._pushed.add(h)
+        except OSError as e:
+            logger.warning("remote KV put failed: %s", e)
+        return []
+
+    def get(self, h: int) -> np.ndarray | None:
+        try:
+            return self.client.get(h)
+        except OSError as e:
+            logger.warning("remote KV get failed: %s", e)
+            return None
+
+    def contains(self, h: int) -> bool:
+        with self._lock:
+            return h in self._pushed
+
+    def hashes(self) -> list[int]:
+        with self._lock:
+            return list(self._pushed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tier": self.name, "blocks_pushed": len(self._pushed)}
+
+
+class KVOffloadManager:
+    """Tier cascade + async writer + controller reporting.
+
+    put_batch() is called from the engine loop when cached blocks leave HBM
+    (BlockManager free/evict hooks); get()/contains() serve prefix restore
+    on the admission path (Scheduler kv_restore hook).
+    """
+
+    def __init__(self, tiers: list[KVTier], reporter=None):
+        self.tiers = tiers
+        self.reporter = reporter
+        # guards only the pending-write map; tiers are internally locked so
+        # the writer thread's disk/remote IO never blocks the engine loop
+        self._lock = threading.Lock()
+        self._pending: dict[int, np.ndarray] = {}
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self.hits = 0
+        self.misses = 0
+        self._worker = threading.Thread(
+            target=self._run, name="kv-offload-writer", daemon=True
+        )
+        self._worker.start()
+
+    # -- engine-facing API -------------------------------------------------
+    def put_batch(self, pairs: list[tuple[int, np.ndarray]]) -> None:
+        if not pairs:
+            return
+        with self._lock:
+            fresh = [
+                (h, arr) for h, arr in pairs
+                if h not in self._pending and not self._contains_tier(h)
+            ]
+            for h, arr in fresh:
+                self._pending[h] = arr
+        for item in fresh:
+            self._q.put(item)
+
+    def get(self, h: int) -> np.ndarray | None:
+        with self._lock:
+            arr = self._pending.get(h)
+        if arr is not None:
+            self.hits += 1
+            return arr
+        for tier in self.tiers:
+            arr = tier.get(h)
+            if arr is not None:
+                self.hits += 1
+                return arr
+        self.misses += 1
+        return None
+
+    def contains(self, h: int) -> bool:
+        with self._lock:
+            if h in self._pending:
+                return True
+        return self._contains_tier(h)
+
+    def _contains_tier(self, h: int) -> bool:
+        return any(t.contains(h) for t in self.tiers)
+
+    def snapshot(self) -> dict[str, list[int]]:
+        """tier -> hashes, for controller re-registration replay."""
+        out = {t.name: t.hashes() for t in self.tiers}
+        with self._lock:
+            if self._pending and self.tiers:
+                out.setdefault(self.tiers[0].name, []).extend(self._pending)
+        return out
+
+    def stats(self) -> list[dict]:
+        with self._lock:
+            n_pending = len(self._pending)
+        return [t.stats() for t in self.tiers] + [
+            {"tier": "pending", "blocks": n_pending,
+             "hits": self.hits, "misses": self.misses}
+        ]
+
+    def close(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=2.0)
+
+    # -- writer thread -----------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                h, arr = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                self._store(h, arr)
+            finally:
+                with self._lock:
+                    self._pending.pop(h, None)
+
+    def _store(self, h: int, arr: np.ndarray) -> None:
+        cascade = [(h, arr)]
+        for i, tier in enumerate(self.tiers):
+            next_cascade: list[tuple[int, np.ndarray]] = []
+            admitted = []
+            for ch, carr in cascade:
+                evicted = tier.put(ch, carr)
+                if not any(eh == ch for eh, _ in evicted):
+                    admitted.append(ch)
+                next_cascade.extend(evicted)
+            if self.reporter is not None:
+                if admitted:
+                    self.reporter.admit(tier.name, admitted)
+                dropped_here = [eh for eh, _ in next_cascade if eh != h or i > 0]
+                if dropped_here:
+                    self.reporter.evict(tier.name, dropped_here)
+            cascade = next_cascade
+            if not cascade:
+                return
+        # fell off the last tier: gone for good (controller already told)
+
+
+def build_offload_manager(config, reporter=None) -> KVOffloadManager | None:
+    """Construct tiers from EngineConfig (cpu/disk/remote settings)."""
+    tiers: list[KVTier] = []
+    if config.cpu_offload_bytes:
+        tiers.append(CpuTier(config.cpu_offload_bytes))
+    if config.disk_offload_dir:
+        tiers.append(DiskTier(config.disk_offload_dir))
+    if config.remote_cache_url:
+        from production_stack_tpu.kv.cache_server import RemoteCacheClient
+
+        host, _, port = config.remote_cache_url.rpartition(":")
+        tiers.append(
+            RemoteTier(RemoteCacheClient(host or "127.0.0.1", int(port)))
+        )
+    if not tiers:
+        return None
+    return KVOffloadManager(tiers, reporter)

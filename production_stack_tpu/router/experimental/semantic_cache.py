@@ -1,0 +1,246 @@
+"""Semantic cache: answer repeated (semantically similar) chat requests
+from the router without hitting an engine.
+
+Capability parity with the reference's semantic cache (reference:
+src/vllm_router/experimental/semantic_cache/semantic_cache.py:16 —
+SentenceTransformer embeddings + FAISS inner-product index persisted via
+pickle; integration check-before-route / store-after-response at
+semantic_cache_integration.py:181/74). This environment has neither
+sentence-transformers nor faiss, so both layers are pluggable:
+
+- Embedder: SentenceTransformer when importable, else a hermetic
+  hashed-character-ngram embedding (deterministic, dependency-free —
+  cosine over ngram profiles is a solid lexical-similarity proxy).
+- Index: exact inner-product search over L2-normalised vectors in numpy
+  (FAISS IndexFlatIP equivalent at router-cache scale), persisted with
+  np.savez + a JSON sidecar instead of pickle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+import numpy as np
+from aiohttp import web
+
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_DIM = 512
+
+
+class HashedNgramEmbedder:
+    """Hermetic text embedder: hashed character n-gram profile, L2-normed."""
+
+    def __init__(self, dim: int = DEFAULT_DIM, ngram: tuple[int, ...] = (3, 4)):
+        self.dim = dim
+        self.ngram = ngram
+
+    def encode(self, text: str) -> np.ndarray:
+        v = np.zeros(self.dim, dtype=np.float32)
+        t = text.lower()
+        for n in self.ngram:
+            for i in range(max(0, len(t) - n + 1)):
+                g = t[i: i + n]
+                hh = int.from_bytes(
+                    hashlib.blake2b(g.encode(), digest_size=8).digest(),
+                    "little",
+                )
+                v[hh % self.dim] += 1.0
+        norm = float(np.linalg.norm(v))
+        return v / norm if norm > 0 else v
+
+
+class SentenceTransformerEmbedder:  # pragma: no cover - heavy optional dep
+    def __init__(self, model_name: str):
+        # zero-egress guard: only use a locally cached model — without this
+        # the HF hub download can hang indefinitely instead of erroring
+        os.environ.setdefault("HF_HUB_OFFLINE", "1")
+        os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+        from sentence_transformers import SentenceTransformer
+
+        self._m = SentenceTransformer(model_name, local_files_only=True)
+        self.dim = self._m.get_sentence_embedding_dimension()
+
+    def encode(self, text: str) -> np.ndarray:
+        v = np.asarray(self._m.encode([text])[0], dtype=np.float32)
+        norm = float(np.linalg.norm(v))
+        return v / norm if norm > 0 else v
+
+
+class VectorIndex:
+    """Exact inner-product index (FAISS IndexFlatIP stand-in) + payloads."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self.vectors = np.zeros((0, dim), dtype=np.float32)
+        self.payloads: list[dict] = []
+
+    def add(self, vec: np.ndarray, payload: dict) -> None:
+        self.vectors = np.vstack([self.vectors, vec[None, :]])
+        self.payloads.append(payload)
+
+    def search(self, vec: np.ndarray) -> tuple[float, dict | None]:
+        if len(self.payloads) == 0:
+            return 0.0, None
+        sims = self.vectors @ vec
+        i = int(np.argmax(sims))
+        return float(sims[i]), self.payloads[i]
+
+    def __len__(self) -> int:
+        return len(self.payloads)
+
+    # -- persistence (np.savez + json, reference pickles FAISS + db:
+    #    db_adapters/faiss_adapter.py:47-70) ------------------------------
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        np.savez(os.path.join(directory, "vectors.npz"), v=self.vectors)
+        with open(os.path.join(directory, "payloads.json"), "w") as f:
+            json.dump(self.payloads, f)
+
+    @classmethod
+    def load(cls, directory: str, dim: int) -> "VectorIndex":
+        idx = cls(dim)
+        try:
+            data = np.load(os.path.join(directory, "vectors.npz"))
+            with open(os.path.join(directory, "payloads.json")) as f:
+                payloads = json.load(f)
+            if data["v"].shape[1] == dim and len(payloads) == len(data["v"]):
+                idx.vectors = data["v"].astype(np.float32)
+                idx.payloads = payloads
+        except (OSError, ValueError, KeyError):
+            pass
+        return idx
+
+
+def _chat_request_text(body: dict) -> str | None:
+    msgs = body.get("messages")
+    if not isinstance(msgs, list):
+        return None
+    parts = []
+    for m in msgs:
+        c = m.get("content") if isinstance(m, dict) else None
+        if isinstance(c, str):
+            parts.append(f"{m.get('role', 'user')}: {c}")
+    return "\n".join(parts) if parts else None
+
+
+class SemanticCache:
+    """check() before routing; store() after a completed response."""
+
+    def __init__(self, model_name: str = "all-MiniLM-L6-v2",
+                 cache_dir: str | None = None, threshold: float = 0.95,
+                 max_entries: int = 4096):
+        self.threshold = threshold
+        self.cache_dir = cache_dir
+        self.max_entries = max_entries
+        try:
+            self.embedder = SentenceTransformerEmbedder(model_name)
+            logger.info("semantic cache: sentence-transformers %s", model_name)
+        except Exception:  # noqa: BLE001 — not installed on this image
+            self.embedder = HashedNgramEmbedder()
+            logger.info("semantic cache: hermetic hashed-ngram embedder")
+        dim = self.embedder.dim
+        self.index = (
+            VectorIndex.load(cache_dir, dim) if cache_dir else VectorIndex(dim)
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        # deferred persistence: a full index rewrite per store would stall
+        # the event loop; a background thread flushes dirty state instead
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._flusher: threading.Thread | None = None
+        if cache_dir:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="semantic-cache-flush",
+                daemon=True,
+            )
+            self._flusher.start()
+
+    # -- integration points ------------------------------------------------
+    async def check(self, request: web.Request) -> web.Response | None:
+        """Early-return a cached response on a similarity hit (reference:
+        semantic_cache_integration.py:181 check_semantic_cache)."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return None
+        if body.get("stream"):
+            return None  # only whole-response caching
+        text = _chat_request_text(body)
+        if not text:
+            return None
+        vec = self.embedder.encode(text)
+        with self._lock:
+            sim, payload = self.index.search(vec)
+        if payload is not None and sim >= self.threshold:
+            self.hits += 1
+            logger.info("semantic cache HIT (sim=%.3f)", sim)
+            resp = dict(payload["response"])
+            resp["served_by"] = "semantic-cache"
+            return web.json_response(
+                resp, headers={"x-semantic-cache": "hit",
+                               "x-semantic-cache-similarity": f"{sim:.4f}"}
+            )
+        self.misses += 1
+        return None
+
+    def store(self, body: dict, response: dict) -> None:
+        """Store a completed chat response (reference:
+        semantic_cache_integration.py:74 store_in_semantic_cache)."""
+        text = _chat_request_text(body)
+        if not text:
+            return
+        vec = self.embedder.encode(text)
+        with self._lock:
+            sim, _ = self.index.search(vec)
+            if sim >= self.threshold:
+                return  # near-duplicate already cached
+            if len(self.index) >= self.max_entries:
+                # simple FIFO trim: drop the oldest half
+                keep = self.max_entries // 2
+                self.index.vectors = self.index.vectors[-keep:]
+                self.index.payloads = self.index.payloads[-keep:]
+            self.index.add(vec, {"request_text": text, "response": response})
+            self.stores += 1
+        self._dirty.set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self.index), "hits": self.hits,
+                    "misses": self.misses, "stores": self.stores}
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5.0)
+
+    # -- background persistence -------------------------------------------
+    def _flush_loop(self, interval_s: float = 5.0) -> None:
+        while not self._stop.is_set():
+            self._dirty.wait(timeout=0.5)
+            if not self._dirty.is_set():
+                continue
+            self._stop.wait(interval_s)  # coalesce a burst of stores
+            self._dirty.clear()
+            self._flush_once()
+        if self._dirty.is_set():  # final flush on shutdown
+            self._flush_once()
+
+    def _flush_once(self) -> None:
+        with self._lock:
+            vectors = self.index.vectors.copy()
+            payloads = list(self.index.payloads)
+        snap = VectorIndex(self.embedder.dim)
+        snap.vectors, snap.payloads = vectors, payloads
+        try:
+            snap.save(self.cache_dir)
+        except OSError as e:
+            logger.warning("semantic cache persist failed: %s", e)

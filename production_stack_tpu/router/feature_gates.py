@@ -82,3 +82,8 @@ def get_feature_gates() -> FeatureGates:
     if _gates is None:
         _gates = FeatureGates()
     return _gates
+
+
+def _reset_feature_gates() -> None:
+    global _gates
+    _gates = None

@@ -111,6 +111,27 @@ class SessionRouter(RoutingInterface):
         return url
 
 
+def _engine_prompt_text(request, tokenizer=None) -> str:
+    """Render the request exactly as the engine will (chat template applied)
+    so chained block hashes line up with engine-side prefix hashes — the
+    reference gets this for free by sharing vLLM's tokenizer
+    (reference: routing_logic.py:324)."""
+    body = request.body
+    msgs = body.get("messages")
+    if isinstance(msgs, list):
+        tok = tokenizer
+        if tok is None:
+            from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+            tok = ByteTokenizer()
+        if hasattr(tok, "apply_chat_template"):
+            try:
+                return tok.apply_chat_template(msgs)
+            except Exception:  # noqa: BLE001 — fall back to flat text
+                pass
+    return request.request_text()
+
+
 class KvawareRouter(RoutingInterface):
     """Route to the engine already holding the longest KV prefix, via the KV
     controller (reference: routing_logic.py:250 asks the LMCache controller;
@@ -131,10 +152,13 @@ class KvawareRouter(RoutingInterface):
         self._client = None
 
     async def start(self) -> None:
-        from production_stack_tpu.kv.controller import KVControllerClient
+        # the router embeds the KV controller (engines report to it over
+        # TCP, reference: routing_logic.py:282 starts the LMCache manager
+        # in-process); falls back to client mode if one is already running
+        from production_stack_tpu.kv.controller import start_or_connect
 
         host, _, port = self.controller_url.rpartition(":")
-        self._client = KVControllerClient(host or "127.0.0.1", int(port))
+        self._client = await start_or_connect(host or "127.0.0.1", int(port))
 
     async def close(self) -> None:
         if self._client is not None:
@@ -143,15 +167,18 @@ class KvawareRouter(RoutingInterface):
     def _tokenize(self, text: str) -> list[int]:
         if self.tokenizer is not None:
             return self.tokenizer.encode(text)
-        # fallback: utf-8 bytes as token ids (matches engines running the
-        # hermetic byte tokenizer; real deployments pass a tokenizer)
-        return list(text.encode("utf-8"))
+        # fallback: the hermetic byte tokenizer (incl. BOS) so hashes line
+        # up with engines running tokenizer="byte"; real deployments pass
+        # the model tokenizer via --tokenizer
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+        return ByteTokenizer().encode(text)
 
     async def route_request(self, endpoints, engine_stats, request_stats,
                             request) -> str:
         if not endpoints:
             raise RuntimeError("no available endpoints")
-        text = request.request_text()
+        text = _engine_prompt_text(request, self.tokenizer)
         if self._client is None or not text:
             return await self.fallback.route_request(
                 endpoints, engine_stats, request_stats, request
@@ -269,11 +296,11 @@ class TtftRouter(RoutingInterface):
         if self.kv_controller_url:
             try:
                 from production_stack_tpu.kv.controller import (
-                    KVControllerClient,
+                    start_or_connect,
                 )
 
                 host, _, port = self.kv_controller_url.rpartition(":")
-                self._kv_client = KVControllerClient(
+                self._kv_client = await start_or_connect(
                     host or "127.0.0.1", int(port)
                 )
             except Exception:  # pragma: no cover
@@ -314,16 +341,19 @@ class TtftRouter(RoutingInterface):
                             request) -> str:
         if not endpoints:
             raise RuntimeError("no available endpoints")
-        text = request.request_text()
+        text = _engine_prompt_text(request, self.tokenizer)
         n_tokens = self._count_tokens(text)
         matches: dict[str, int] = {}
         if self._kv_client is not None and text:
             try:
-                tokens = (
-                    self.tokenizer.encode(text)
-                    if self.tokenizer
-                    else list(text.encode("utf-8"))
-                )
+                if self.tokenizer:
+                    tokens = self.tokenizer.encode(text)
+                else:
+                    from production_stack_tpu.engine.tokenizer import (
+                        ByteTokenizer,
+                    )
+
+                    tokens = ByteTokenizer().encode(text)
                 raw = await self._kv_client.lookup(tokens)
                 for inst, n in raw.items():
                     for ep in endpoints:

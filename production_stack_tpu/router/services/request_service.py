@@ -13,6 +13,7 @@ first-token latency is preserved.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -199,6 +200,15 @@ class RequestService:
         )
         self.in_flight += 1
         first_chunk_seen = False
+        # store-after-response for the semantic cache (reference:
+        # semantic_cache_integration.py:74): only whole (non-stream) chat
+        # completions are cacheable
+        cache_body = (
+            self.semantic_cache is not None
+            and endpoint_path.endswith("chat/completions")
+            and not body.get("stream")
+        )
+        captured: list[bytes] = []
         try:
             async with self.session.post(
                 f"{backend_url}{endpoint_path}",
@@ -222,11 +232,20 @@ class RequestService:
                         )
                     else:
                         monitor.on_token(stats_url, request_id)
+                    if cache_body and upstream.status == 200:
+                        captured.append(chunk)
                     await resp.write(chunk)
                 await resp.write_eof()
                 monitor.on_request_complete(
                     stats_url, request_id, time.time()
                 )
+                if captured:
+                    try:
+                        self.semantic_cache.store(
+                            body, json.loads(b"".join(captured))
+                        )
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        pass
                 if self.callbacks is not None:
                     self.callbacks.post_request(request_id, body)
                 return resp
@@ -242,6 +261,60 @@ class RequestService:
                 status=502,
             )
         finally:
+            self.in_flight -= 1
+
+    # -- headless execution (batch API worker path) ------------------------
+    async def execute_internal(
+        self, body: dict, endpoint_path: str, request_id: str | None = None
+    ) -> tuple[int, dict]:
+        """Route + execute one non-streaming request with no client socket.
+
+        Used by the batch processor (reference executes batches through the
+        same proxy machinery, services/batch_service/local_processor.py).
+        Returns (status_code, response_json)."""
+        request_id = request_id or uuid.uuid4().hex
+        body = dict(body)
+        body.pop("stream", None)
+        endpoints = get_service_discovery().get_endpoint_info()
+        candidates, resolved_model = self._filter_endpoints(
+            endpoints, body.get("model")
+        )
+        if resolved_model is not None and resolved_model != body.get("model"):
+            body["model"] = resolved_model
+        if not candidates:
+            return 503, {"error": {
+                "message": f"no endpoint serving model {body.get('model')!r}",
+                "type": "service_unavailable"}}
+        router = get_routing_logic()
+        monitor = get_request_stats_monitor()
+        try:
+            url = await router.route_request(
+                candidates,
+                get_engine_stats_scraper().get_engine_stats(),
+                monitor.get_request_stats(),
+                RouterRequest(headers={}, body=body, endpoint=endpoint_path),
+            )
+        except RuntimeError as e:
+            return 503, {"error": {"message": str(e),
+                                   "type": "service_unavailable"}}
+        monitor.on_new_request(
+            url, request_id, time.time(), _estimate_prompt_tokens(body)
+        )
+        self.in_flight += 1
+        try:
+            async with self.session.post(
+                f"{url}{endpoint_path}", json=body
+            ) as upstream:
+                monitor.on_request_response(url, request_id, time.time())
+                payload = await upstream.json(content_type=None)
+                return upstream.status, payload
+        except (aiohttp.ClientError, ConnectionResetError,
+                asyncio.TimeoutError, json.JSONDecodeError,
+                UnicodeDecodeError) as e:
+            return 502, {"error": {"message": f"backend error: {e}",
+                                   "type": "bad_gateway"}}
+        finally:
+            monitor.on_request_complete(url, request_id, time.time())
             self.in_flight -= 1
 
     # -- disaggregated prefill (reference: request.py:349-441) -------------

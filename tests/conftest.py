@@ -1,6 +1,13 @@
 """Test harness config: run JAX on CPU with 8 virtual devices so sharding
 tests exercise the multi-chip code paths without TPU hardware (same strategy
-the driver uses for dryrun_multichip)."""
+the driver uses for dryrun_multichip).
+
+The env vars alone are not enough: the image's sitecustomize imports jax at
+interpreter start (before pytest loads this file) with JAX_PLATFORMS=axon
+in the environment, so the config default is already snapshotted. We must
+also update the live jax config; backends are created lazily, so doing it
+here (before any test touches a device) still wins.
+"""
 
 import os
 
@@ -11,3 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pure-router test envs without jax
+    pass

@@ -1,0 +1,222 @@
+"""Files + Batch API tests (reference: src/tests/test_file_storage.py and
+the batches/files router surface, routers/files_router.py:23-81,
+batches_router.py:23-113). E2e tier runs the real router app with fake
+engines and executes a real batch through the routing machinery."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.routing_logic import _reset_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    _reset_service_discovery,
+)
+from production_stack_tpu.router.services.files_service import (
+    FileNotFoundStorageError,
+    FileStorage,
+)
+
+from tests.fake_engine import FakeEngine
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    _reset_routing_logic()
+    _reset_service_discovery()
+
+
+# -- unit: FileStorage ------------------------------------------------------
+class TestFileStorage:
+    def test_save_get_roundtrip(self, tmp_path):
+        async def run():
+            st = FileStorage(str(tmp_path))
+            meta = await st.save_file(b"hello", "a.txt", "batch")
+            assert meta.bytes == 5 and meta.purpose == "batch"
+            got = await st.get_file(meta.id)
+            assert got.filename == "a.txt"
+            assert await st.get_file_content(meta.id) == b"hello"
+        asyncio.run(run())
+
+    def test_list_and_delete(self, tmp_path):
+        async def run():
+            st = FileStorage(str(tmp_path))
+            m1 = await st.save_file(b"1", "one", "batch")
+            await st.save_file(b"2", "two", "batch")
+            assert len(await st.list_files()) == 2
+            assert await st.delete_file(m1.id)
+            assert len(await st.list_files()) == 1
+            assert not await st.delete_file(m1.id)
+            with pytest.raises(FileNotFoundStorageError):
+                await st.get_file(m1.id)
+        asyncio.run(run())
+
+
+# -- e2e: files + batches over the real router app --------------------------
+async def _start_stack(tmp_path, n_engines=2):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+
+    engines = [FakeEngine(model="fake-model") for _ in range(n_engines)]
+    for e in engines:
+        await e.start()
+    args = parsers.parse_args([
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", ",".join("fake-model" for _ in engines),
+        "--routing-logic", "roundrobin",
+        "--enable-batch-api",
+        "--file-storage-path", str(tmp_path),
+    ])
+    ra = build_app(args)
+    # fast poll for tests
+    ra.batch_processor.poll_interval_s = 0.1
+    client = TestClient(TestServer(ra.app))
+    await client.start_server()
+    return client, engines
+
+
+async def _stop_stack(client, engines):
+    await client.close()
+    for e in engines:
+        await e.stop()
+
+
+class TestFilesAPI:
+    def test_upload_retrieve_content_delete(self, tmp_path,
+                                            reset_singletons):
+        async def run():
+            client, engines = await _start_stack(tmp_path)
+            import aiohttp
+
+            form = aiohttp.FormData()
+            form.add_field("file", b"the content", filename="data.jsonl")
+            form.add_field("purpose", "batch")
+            r = await client.post("/v1/files", data=form)
+            assert r.status == 200
+            meta = await r.json()
+            fid = meta["id"]
+            assert meta["filename"] == "data.jsonl"
+
+            r = await client.get("/v1/files")
+            assert fid in [f["id"] for f in (await r.json())["data"]]
+
+            r = await client.get(f"/v1/files/{fid}/content")
+            assert await r.read() == b"the content"
+
+            r = await client.delete(f"/v1/files/{fid}")
+            assert (await r.json())["deleted"]
+            r = await client.get(f"/v1/files/{fid}")
+            assert r.status == 404
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+
+class TestBatchAPI:
+    def test_batch_executes_through_router(self, tmp_path,
+                                           reset_singletons):
+        async def run():
+            client, engines = await _start_stack(tmp_path)
+            lines = [
+                json.dumps({
+                    "custom_id": f"req-{i}",
+                    "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": {
+                        "model": "fake-model",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 2,
+                    },
+                })
+                for i in range(6)
+            ]
+            import aiohttp
+
+            form = aiohttp.FormData()
+            form.add_field("file", "\n".join(lines).encode(),
+                           filename="in.jsonl")
+            form.add_field("purpose", "batch")
+            r = await client.post("/v1/files", data=form)
+            input_id = (await r.json())["id"]
+
+            r = await client.post("/v1/batches", json={
+                "input_file_id": input_id,
+                "endpoint": "/v1/chat/completions",
+                "completion_window": "24h",
+            })
+            assert r.status == 200
+            batch = await r.json()
+            bid = batch["id"]
+            assert batch["status"] == "validating"
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                r = await client.get(f"/v1/batches/{bid}")
+                batch = await r.json()
+                if batch["status"] in ("completed", "failed"):
+                    break
+                await asyncio.sleep(0.1)
+            assert batch["status"] == "completed", batch
+            assert batch["request_counts"]["completed"] == 6
+            assert batch["output_file_id"]
+
+            r = await client.get(
+                f"/v1/files/{batch['output_file_id']}/content"
+            )
+            out = [json.loads(x) for x in
+                   (await r.read()).decode().splitlines()]
+            assert len(out) == 6
+            assert {o["custom_id"] for o in out} == {
+                f"req-{i}" for i in range(6)
+            }
+            assert all(
+                o["response"]["status_code"] == 200 for o in out
+            )
+            # both engines saw work (round-robin through the real router)
+            assert all(e.requests_seen for e in engines)
+
+            # listing surfaces the batch
+            r = await client.get("/v1/batches")
+            assert bid in [b["id"] for b in (await r.json())["data"]]
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_batch_invalid_input_file(self, tmp_path, reset_singletons):
+        async def run():
+            client, engines = await _start_stack(tmp_path)
+            r = await client.post("/v1/batches", json={
+                "input_file_id": "file-doesnotexist",
+                "endpoint": "/v1/chat/completions",
+            })
+            bid = (await r.json())["id"]
+            deadline = time.time() + 10
+            status = None
+            while time.time() < deadline:
+                status = (await (await client.get(
+                    f"/v1/batches/{bid}")).json())["status"]
+                if status == "failed":
+                    break
+                await asyncio.sleep(0.1)
+            assert status == "failed"
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_batch_validation_errors(self, tmp_path, reset_singletons):
+        async def run():
+            client, engines = await _start_stack(tmp_path)
+            r = await client.post("/v1/batches", json={
+                "endpoint": "/v1/chat/completions"})
+            assert r.status == 400
+            r = await client.post("/v1/batches", json={
+                "input_file_id": "f", "endpoint": "/v1/bogus"})
+            assert r.status == 400
+            r = await client.get("/v1/batches/batch_nope")
+            assert r.status == 404
+            await _stop_stack(client, engines)
+        asyncio.run(run())

@@ -1,0 +1,203 @@
+"""Semantic cache + PII detection tests (reference: experimental/
+semantic_cache*, experimental/pii/; integration invariants from
+semantic_cache_integration.py and pii/middleware.py). E2e tier runs the
+real router app with the features gated on."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.experimental.pii import (
+    PIIMiddleware,
+    RegexAnalyzer,
+)
+from production_stack_tpu.router.experimental.semantic_cache import (
+    HashedNgramEmbedder,
+    SemanticCache,
+    VectorIndex,
+)
+from production_stack_tpu.router.feature_gates import (
+    _reset_feature_gates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.routing_logic import _reset_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    _reset_service_discovery,
+)
+
+from tests.fake_engine import FakeEngine
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    _reset_routing_logic()
+    _reset_service_discovery()
+    _reset_feature_gates()
+
+
+# -- unit: embedder + index -------------------------------------------------
+class TestEmbedder:
+    def test_similar_text_scores_higher(self):
+        e = HashedNgramEmbedder()
+        a = e.encode("What is the capital of France?")
+        b = e.encode("What is the capital of France???")
+        c = e.encode("How do I bake sourdough bread at home")
+        assert float(a @ b) > float(a @ c)
+        assert abs(float(a @ a) - 1.0) < 1e-5
+
+    def test_index_search_and_persistence(self, tmp_path):
+        e = HashedNgramEmbedder()
+        idx = VectorIndex(e.dim)
+        idx.add(e.encode("hello world"), {"response": {"id": "1"}})
+        idx.add(e.encode("goodbye moon"), {"response": {"id": "2"}})
+        sim, payload = idx.search(e.encode("hello world"))
+        assert payload["response"]["id"] == "1" and sim > 0.99
+        idx.save(str(tmp_path))
+        idx2 = VectorIndex.load(str(tmp_path), e.dim)
+        assert len(idx2) == 2
+        sim, payload = idx2.search(e.encode("goodbye moon"))
+        assert payload["response"]["id"] == "2"
+
+
+class TestSemanticCacheUnit:
+    def test_store_then_hit(self):
+        sc = SemanticCache(threshold=0.95)
+        body = {"messages": [{"role": "user", "content": "tell me a joke"}]}
+        sc.store(body, {"id": "resp-1", "choices": []})
+        # identical request scores 1.0 -> hit path exercised via search
+        vec = sc.embedder.encode("user: tell me a joke")
+        sim, payload = sc.index.search(vec)
+        assert sim >= 0.99 and payload["response"]["id"] == "resp-1"
+        assert sc.stats()["entries"] == 1
+
+    def test_near_duplicate_not_stored_twice(self):
+        sc = SemanticCache(threshold=0.95)
+        body = {"messages": [{"role": "user", "content": "same question"}]}
+        sc.store(body, {"id": "a"})
+        sc.store(body, {"id": "b"})
+        assert sc.stats()["entries"] == 1
+
+
+# -- unit: PII --------------------------------------------------------------
+class TestPII:
+    def test_regex_analyzer_entities(self):
+        a = RegexAnalyzer()
+        text = ("mail me at alice@example.com, ssn 123-45-6789, "
+                "card 4111 1111 1111 1111, server 10.1.2.3")
+        types = {m.entity_type for m in a.analyze(text)}
+        assert {"EMAIL", "SSN", "CREDIT_CARD", "IP_ADDRESS"} <= types
+
+    def test_clean_text_passes(self):
+        a = RegexAnalyzer()
+        assert a.analyze("what is the weather like tomorrow") == []
+
+    def test_middleware_block_and_log(self):
+        class FakeReq:
+            def __init__(self, body):
+                self._b = body
+
+            async def json(self):
+                return self._b
+
+        async def run():
+            m = PIIMiddleware(analyzer="regex", action="block")
+            r = await m.check(FakeReq({
+                "messages": [{"role": "user",
+                              "content": "my ssn is 123-45-6789"}]}))
+            assert r is not None and r.status == 400
+            m2 = PIIMiddleware(analyzer="regex", action="log")
+            r2 = await m2.check(FakeReq({
+                "messages": [{"role": "user",
+                              "content": "my ssn is 123-45-6789"}]}))
+            assert r2 is None
+            assert m2.stats()["flagged"] == 1
+            r3 = await m.check(FakeReq({
+                "messages": [{"role": "user", "content": "hello"}]}))
+            assert r3 is None
+        asyncio.run(run())
+
+
+# -- e2e through the real router app ----------------------------------------
+async def _start_stack(extra_args=()):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+
+    engines = [FakeEngine(model="fake-model") for _ in range(2)]
+    for e in engines:
+        await e.start()
+    args = parsers.parse_args([
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", "fake-model,fake-model",
+        "--routing-logic", "roundrobin",
+        *extra_args,
+    ])
+    initialize_feature_gates(args.feature_gates)
+    ra = build_app(args)
+    client = TestClient(TestServer(ra.app))
+    await client.start_server()
+    return client, engines
+
+
+async def _stop_stack(client, engines):
+    await client.close()
+    for e in engines:
+        await e.stop()
+
+
+class TestSemanticCacheE2E:
+    def test_second_identical_request_served_from_cache(
+            self, reset_singletons):
+        async def run():
+            client, engines = await _start_stack(
+                ("--feature-gates", "SemanticCache=true",
+                 "--semantic-cache-threshold", "0.95"))
+            body = {"model": "fake-model",
+                    "messages": [{"role": "user", "content": "hi there"}],
+                    "max_tokens": 4}
+            r1 = await client.post("/v1/chat/completions", json=body)
+            assert r1.status == 200
+            assert "x-semantic-cache" not in r1.headers
+            n_backend = sum(len(e.requests_seen) for e in engines)
+            assert n_backend == 1
+
+            r2 = await client.post("/v1/chat/completions", json=body)
+            assert r2.status == 200
+            assert r2.headers.get("x-semantic-cache") == "hit"
+            data = await r2.json()
+            assert data["served_by"] == "semantic-cache"
+            # no extra backend call
+            assert sum(len(e.requests_seen) for e in engines) == n_backend
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+
+class TestPIIE2E:
+    def test_pii_blocked_before_routing(self, reset_singletons):
+        async def run():
+            client, engines = await _start_stack(
+                ("--feature-gates", "PIIDetection=true",
+                 "--pii-analyzer", "regex", "--pii-action", "block"))
+            r = await client.post("/v1/chat/completions", json={
+                "model": "fake-model",
+                "messages": [{"role": "user",
+                              "content": "card 4111 1111 1111 1111"}],
+            })
+            assert r.status == 400
+            data = await r.json()
+            assert data["error"]["code"] == "pii_detected"
+            assert sum(len(e.requests_seen) for e in engines) == 0
+
+            r = await client.post("/v1/chat/completions", json={
+                "model": "fake-model",
+                "messages": [{"role": "user", "content": "clean request"}],
+                "max_tokens": 2,
+            })
+            assert r.status == 200
+            await _stop_stack(client, engines)
+        asyncio.run(run())
