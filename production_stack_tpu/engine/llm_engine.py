@@ -85,6 +85,21 @@ class LLMEngine:
             bm.on_evict = lambda hs: self.kv_reporter.evict("hbm", hs)
         if self.offload is not None:
             self.block_manager.on_freed_cached = self._offload_freed_blocks
+
+        # -- disaggregated-prefill consumer side (reference capability:
+        # decode pod pulls KV produced by the prefill pod via NIXL; ours
+        # pulls content-addressed blocks over TCP, kv/transfer.py) --------
+        self.kv_transfer_client = None
+        peer = (config.kv_transfer_config or {}).get("peer")
+        if config.kv_role == "decode" and peer:
+            from production_stack_tpu.kv import transfer
+            from production_stack_tpu.kv.wire import parse_addr
+
+            self.kv_transfer_client = transfer.KVTransferClient(
+                *parse_addr(peer, transfer.DEFAULT_PORT)
+            )
+
+        if self.offload is not None or self.kv_transfer_client is not None:
             self.scheduler.kv_restore = self._restore_from_offload
 
     # -- KV offload integration -------------------------------------------
@@ -106,25 +121,45 @@ class LLMEngine:
         )
 
     def _restore_from_offload(self, seq: Sequence) -> None:
-        """Before admission: pull chain-continuation blocks from offload
-        tiers back into HBM so allocate_prompt sees a longer cached prefix
-        (role of LMCache retrieve on prefix hit)."""
+        """Before admission: pull chain-continuation blocks back into HBM
+        so allocate_prompt sees a longer cached prefix. Source order:
+        local offload tiers (LMCache-retrieve role), then the remote
+        prefill peer in one batched round-trip (NIXL-receive role)."""
         bm = self.block_manager
         if not bm.enable_prefix_caching:
             return
         hashes = bm.block_hashes_for(seq.prompt_token_ids)
         matched, _ = bm.match_prefix(seq.prompt_token_ids)
         restore: list[tuple[int, np.ndarray]] = []  # (block_id, data)
-        for h in hashes[len(matched):]:
-            if bm.contains_hash(h):
-                break  # already back in HBM (another seq restored it)
-            arr = self.offload.get(h)
-            if arr is None:
-                break  # chain broken; later blocks are useless
-            bid = bm.adopt_cached_block(h)
-            if bid is None:
-                break  # no HBM room; partial restore is still a win
-            restore.append((bid, arr))
+        i = len(matched)
+        hbm_full = False
+        if self.offload is not None:
+            while i < len(hashes):
+                h = hashes[i]
+                if bm.contains_hash(h):
+                    break  # already back in HBM (another seq restored it)
+                arr = self.offload.get(h)
+                if arr is None:
+                    break  # local chain broken; try the PD peer below
+                bid = bm.adopt_cached_block(h)
+                if bid is None:
+                    hbm_full = True  # no room: a network pull is pointless
+                    break
+                restore.append((bid, arr))
+                i += 1
+        if (
+            self.kv_transfer_client is not None
+            and not hbm_full
+            and i < len(hashes)
+            and not bm.contains_hash(hashes[i])
+        ):
+            data = self.kv_transfer_client.get_chain(hashes[i:])
+            if data is not None:
+                for j in range(data.shape[2]):
+                    bid = bm.adopt_cached_block(hashes[i + j])
+                    if bid is None:
+                        break
+                    restore.append((bid, data[:, :, j]))
         if restore:
             self.runner.import_blocks(
                 [bid for bid, _ in restore],
@@ -365,6 +400,8 @@ class LLMEngine:
             self.offload.close()
         if self.kv_reporter is not None:
             self.kv_reporter.close()
+        if self.kv_transfer_client is not None:
+            self.kv_transfer_client.close()
 
     # -- stats for /metrics -------------------------------------------------
     def stats(self) -> EngineStatsSnapshot:

@@ -142,7 +142,12 @@ class Scheduler:
                 out.aborted.append(seq)
                 continue
             if self.kv_restore is not None:
-                self.kv_restore(seq)
+                try:
+                    self.kv_restore(seq)
+                except Exception:  # noqa: BLE001 — restore is best-effort;
+                    # a failure must never kill the step loop (the prompt
+                    # is simply recomputed from scratch)
+                    logger.exception("kv restore failed; recomputing prefix")
             alloc = self.block_manager.allocate_prompt(seq.prompt_token_ids)
             if alloc is None:
                 break  # out of blocks; retry next step

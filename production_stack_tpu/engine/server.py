@@ -77,10 +77,22 @@ class EngineServer:
     async def _on_startup(self, app: web.Application) -> None:
         self.engine.start(asyncio.get_running_loop())
         self._stats_task = asyncio.create_task(self._stats_loop())
+        # disaggregated prefill producer: serve KV blocks to decode peers
+        # (reference: NIXL sender role, LMCACHE_NIXL_ROLE=sender)
+        listen = (self.config.kv_transfer_config or {}).get("listen")
+        if self.config.kv_role == "prefill" and listen:
+            from production_stack_tpu.kv import transfer
+            from production_stack_tpu.kv.wire import parse_addr
+
+            host, port = parse_addr(listen, transfer.DEFAULT_PORT)
+            self._kv_transfer_server = transfer.KVTransferServer(self.engine)
+            await self._kv_transfer_server.start(host or "0.0.0.0", port)
 
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._stats_task:
             self._stats_task.cancel()
+        if getattr(self, "_kv_transfer_server", None) is not None:
+            await self._kv_transfer_server.stop()
         self.engine.shutdown()
 
     async def _stats_loop(self) -> None:

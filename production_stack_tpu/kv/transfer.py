@@ -1,0 +1,162 @@
+"""Disaggregated-prefill KV transfer: prefiller serves KV blocks, decoder
+pulls them by content hash.
+
+Replaces the reference's NIXL/UCX side channel (reference: helm env
+LMCACHE_NIXL_ROLE/PEER/BUFFER + UCX_TLS, deployment-vllm-multi.yaml:273-305;
+examples/disaggregated_prefill/pd.yaml) with a TPU-native design: KV blocks
+are content-addressed by the same chained block hash the prefix cache and
+KV controller use, so the decoder simply asks the prefiller "give me the
+longest run of this hash chain" in ONE round-trip, then imports the blocks
+into its own HBM cache via a single host->device copy. No rendezvous or
+transfer-id plumbing: the prompt itself is the address. If the prefiller
+has already evicted the blocks, the decoder recomputes the prefill locally
+— graceful degradation, never a stall.
+
+Producer side runs inside the prefill engine's aiohttp process; the
+device->host export takes the engine step-loop lock briefly (one batched
+gather per pull). Consumer side is a blocking client called from the
+decode engine's admission path (Scheduler.kv_restore), bounded by a short
+timeout so a dead prefiller cannot stall decode admission.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+
+import numpy as np
+
+from production_stack_tpu.kv import wire
+from production_stack_tpu.kv.offload import deserialize_block, serialize_block
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_PORT = 8200
+
+
+class KVTransferServer:
+    """Serves `get_chain` requests from the prefill engine's KV cache."""
+
+    def __init__(self, async_engine):
+        # async_engine: engine.async_engine.AsyncLLMEngine — we need its
+        # step-loop lock to read block state + export device blocks safely
+        self.async_engine = async_engine
+        self._server: asyncio.AbstractServer | None = None
+        self.chains_served = 0
+        self.blocks_served = 0
+
+    def _export_chain(self, hashes: list[int]) -> np.ndarray | None:
+        """Longest available run of `hashes` -> (2, L, n, bs, nkv, d)."""
+        eng = self.async_engine.engine
+        with self.async_engine._lock:
+            bm = eng.block_manager
+            bids = []
+            for h in hashes:
+                bid = bm.cached_blocks.get(h)
+                if bid is None:
+                    break
+                bids.append(bid)
+            if not bids:
+                return None
+            data = eng.runner.export_blocks(bids)
+        self.chains_served += 1
+        self.blocks_served += len(bids)
+        return data
+
+    async def start(self, host: str = "0.0.0.0",
+                    port: int = DEFAULT_PORT) -> None:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        logger.info("kv-transfer server (prefill role) on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    msg, _ = await wire.recv_msg(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if msg.get("type") == "get_chain":
+                    data = await asyncio.get_running_loop().run_in_executor(
+                        None, self._export_chain, msg["hashes"]
+                    )
+                    if data is None:
+                        await wire.send_msg(writer, {"ok": True, "n": 0})
+                    else:
+                        await wire.send_msg(
+                            writer, {"ok": True, "n": int(data.shape[2])},
+                            serialize_block(data),
+                        )
+                elif msg.get("type") == "ping":
+                    await wire.send_msg(writer, {"ok": True})
+                else:
+                    await wire.send_msg(
+                        writer,
+                        {"ok": False, "error": f"unknown {msg.get('type')!r}"},
+                    )
+        finally:
+            writer.close()
+
+
+class KVTransferClient:
+    """Decode-side blocking puller (runs on the engine step-loop thread)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self.pulls = 0
+        self.blocks_pulled = 0
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            )
+            self._sock.settimeout(self.timeout)
+        return self._sock
+
+    def get_chain(self, hashes: list[int]) -> np.ndarray | None:
+        """Longest run of `hashes` the peer holds, or None.
+
+        Returns (2, L, n, bs, nkv, d) with n <= len(hashes)."""
+        if not hashes:
+            return None
+        with self._lock:
+            try:
+                s = self._ensure()
+                wire.sync_send(s, {"type": "get_chain", "hashes": hashes})
+                reply, payload = wire.sync_recv(s)
+            except (OSError, RuntimeError, ValueError) as e:
+                # OSError: network; WireError(RuntimeError): peer died
+                # mid-frame; ValueError: corrupt frame — all must degrade
+                # to a local prefill, never escape into the step loop
+                self.close()
+                logger.warning("kv-transfer pull failed: %s", e)
+                return None
+        if not reply.get("ok") or reply.get("n", 0) == 0:
+            return None
+        try:
+            data = deserialize_block(payload)
+        except ValueError as e:
+            logger.warning("kv-transfer payload corrupt: %s", e)
+            return None
+        self.pulls += 1
+        self.blocks_pulled += int(data.shape[2])
+        return data
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

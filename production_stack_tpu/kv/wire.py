@@ -25,6 +25,14 @@ class WireError(RuntimeError):
     pass
 
 
+def parse_addr(addr: str, default_port: int) -> tuple[str, int]:
+    """'host:port' / 'host' / ':port' -> (host, port) with defaults."""
+    host, sep, port = addr.rpartition(":")
+    if not sep:  # no colon: the whole string is the host
+        return (addr or "127.0.0.1", default_port)
+    return (host or "127.0.0.1", int(port))
+
+
 def encode_msg(obj: dict, payload: bytes = b"") -> bytes:
     meta = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     return _HDR.pack(len(meta), len(payload)) + meta + payload

@@ -377,3 +377,50 @@ class TestRouterE2E:
             assert "router:cpu_usage_percent" in text
             await _stop_stack(client, engines)
         asyncio.run(run())
+
+
+class TestDisaggregatedPrefillE2E:
+    """Two-phase PD flow through the real router app (reference invariant:
+    prefiller gets the request with max_tokens=1, decoder streams the real
+    completion — tests/e2e/test-routing.py PD section)."""
+
+    async def _start_pd_stack(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import build_app
+
+        prefiller = FakeEngine(model="fake-model", model_label="prefill-1")
+        decoder = FakeEngine(model="fake-model", model_label="decode-1")
+        for e in (prefiller, decoder):
+            await e.start()
+        args = parsers.parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"{prefiller.url},{decoder.url}",
+            "--static-models", "fake-model,fake-model",
+            "--static-model-labels", "prefill-1,decode-1",
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+        ])
+        ra = build_app(args)
+        client = TestClient(TestServer(ra.app))
+        await client.start_server()
+        return client, prefiller, decoder
+
+    def test_pd_two_phase_flow(self, reset_singletons):
+        async def run():
+            client, prefiller, decoder = await self._start_pd_stack()
+            r = await client.post("/v1/chat/completions", json={
+                "model": "fake-model",
+                "messages": [{"role": "user", "content": "hello pd"}],
+                "max_tokens": 7,
+            })
+            assert r.status == 200
+            # phase 1 hit the prefiller with max_tokens forced to 1
+            assert len(prefiller.requests_seen) == 1
+            assert prefiller.requests_seen[0]["max_tokens"] == 1
+            # phase 2 streamed from the decoder with the real budget
+            assert len(decoder.requests_seen) == 1
+            assert decoder.requests_seen[0]["max_tokens"] == 7
+            await _stop_stack(client, [prefiller, decoder])
+        asyncio.run(run())
