@@ -103,6 +103,27 @@ class ModelRunner:
         self.v_cache = zeros()
 
         self._scale = mc.head_dim**-0.5
+        # attention impl: pallas paged kernel on TPU (single-chip; the TP
+        # path stays on the XLA gather impl until the kernel is shard_mapped)
+        impl = config.attention_impl
+        if impl == "auto":
+            impl = (
+                "pallas"
+                if jax.default_backend() == "tpu" and self.mesh is None
+                else "xla"
+            )
+        if impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"attention_impl must be auto|xla|pallas, got {impl!r}"
+            )
+        if impl == "pallas" and self.mesh is not None:
+            raise ValueError(
+                "attention_impl='pallas' is not yet supported with "
+                "tensor_parallel_size > 1 (the kernel is not shard_mapped);"
+                " use 'auto' or 'xla'"
+            )
+        self.attention_impl = impl
+        logger.info("attention impl: %s", impl)
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
@@ -195,17 +216,34 @@ class ModelRunner:
         mc = self.model_config
         scale = self._scale
 
-        def attn(q, l, kc, vc, gather_slots, context_lens):
-            k_ctx = kc[l, gather_slots]  # (b, c, nkv, d)
-            v_ctx = vc[l, gather_slots]
-            return xla_attn.context_attention_decode(
-                q, k_ctx, v_ctx, context_lens, scale
-            )
+        if self.attention_impl == "pallas":
+            from production_stack_tpu.ops import pallas_attention
+
+            bs = self.block_size
+            interpret = jax.default_backend() != "tpu"
+
+            # `tables` = padded per-sequence block tables (b, pages)
+            def attn(q, l, kc, vc, tables, context_lens):
+                # q: (b, nq, d); kc/vc: full (L, slots, nkv, d) — the
+                # kernel DMAs pages straight from HBM, no gathered copy
+                return pallas_attention.paged_decode_attention(
+                    q, kc, vc, l, tables, context_lens,
+                    block_size=bs, scale=scale, interpret=interpret,
+                )
+        else:
+
+            # `tables` = per-position gather slots (b, c_pad)
+            def attn(q, l, kc, vc, tables, context_lens):
+                k_ctx = kc[l, tables]  # (b, c, nkv, d)
+                v_ctx = vc[l, tables]
+                return xla_attn.context_attention_decode(
+                    q, k_ctx, v_ctx, context_lens, scale
+                )
 
         def step(params, kc, vc, tokens, positions, write_slots,
-                 gather_slots, context_lens):
+                 tables, context_lens):
             attn_fn = functools.partial(
-                attn, gather_slots=gather_slots, context_lens=context_lens
+                attn, tables=tables, context_lens=context_lens
             )
             logits, kc, vc = llama.forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
@@ -306,12 +344,24 @@ class ModelRunner:
         ctx[:b_actual] = context_lens
 
         write_slots = np.zeros((b,), dtype=np.int32)
-        gather = np.zeros((b, c_pad), dtype=np.int32)
         for i in range(b_actual):
             write_slots[i] = self._slots_for_positions(
                 block_tables[i], np.asarray([positions[i]])
             )[0]
-            gather[i] = self._gather_slots_for_table(block_tables[i], c_pad)
+        if self.attention_impl == "pallas":
+            # pallas path takes padded block tables (pages), not per-token
+            # gather slots; padding pages point at the null block 0
+            n_pages = c_pad // self.block_size
+            tables = np.zeros((b, n_pages), dtype=np.int32)
+            for i in range(b_actual):
+                bt = np.asarray(block_tables[i], dtype=np.int32)[:n_pages]
+                tables[i, : len(bt)] = bt
+        else:
+            tables = np.zeros((b, c_pad), dtype=np.int32)
+            for i in range(b_actual):
+                tables[i] = self._gather_slots_for_table(
+                    block_tables[i], c_pad
+                )
 
         key = (b, c_pad)
         if key not in self._decode_fns:
@@ -325,7 +375,7 @@ class ModelRunner:
             jnp.asarray(tokens),
             jnp.asarray(pos),
             jnp.asarray(write_slots),
-            jnp.asarray(gather),
+            jnp.asarray(tables),
             jnp.asarray(ctx),
         )
         return logits

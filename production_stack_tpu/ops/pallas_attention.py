@@ -1,0 +1,194 @@
+"""Pallas TPU kernel: paged GQA decode attention over the HBM KV cache.
+
+This is the hot op of the serving engine (the capability the reference
+stack gets from vLLM's PagedAttention CUDA kernels; our TPU-first design
+replaces the gather-based XLA path in ops/attention.py on TPU):
+
+- The KV cache stays in HBM (`memory_space=ANY`); the kernel DMAs one
+  whole page (block_size, num_kv_heads, head_dim) at a time into VMEM,
+  double-buffered so the next page streams in while the current one is
+  on the MXU. The gathered (batch, ctx, ...) context copy the XLA path
+  materialises is never built — decode reads each KV byte exactly once.
+- The block table rides in scalar-prefetch SMEM (PrefetchScalarGridSpec)
+  so page addresses are known before the body runs — this is the "dense
+  tiling, not gather-heavy layout" recipe for TPU paged attention.
+- Online softmax (running max / sum / accumulator in f32) over pages,
+  one grid program per sequence; all KV heads of a page are processed
+  together since a page is contiguous in HBM as (bs, nkv, d).
+- The layer index is a scalar argument indexing the full
+  (L, slots, nkv, d) cache, so jit never slices (= copies) a per-layer
+  cache to feed the kernel.
+
+Numerics match ops/attention.py (f32 softmax, same masking); parity is
+enforced by tests/test_pallas_attention.py in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    layer_ref,          # (1,) int32
+    block_tables_ref,   # (b, P) int32
+    context_lens_ref,   # (b,) int32
+    # array inputs
+    q_ref,              # (1, nq, d) VMEM — this program's query
+    k_cache_ref,        # (L, slots, nkv, d) ANY/HBM
+    v_cache_ref,
+    # outputs
+    out_ref,            # (1, nq, d) VMEM
+    # scratch
+    k_buf,              # (2, bs, nkv, d) VMEM
+    v_buf,
+    sem,                # DMA sems (2, 2)
+    *,
+    block_size: int,
+    num_pages: int,
+    scale: float,
+):
+    i = pl.program_id(0)
+    layer = layer_ref[0]
+    ctx_len = context_lens_ref[i]
+    nq, d = q_ref.shape[1], q_ref.shape[2]
+    nkv = k_buf.shape[2]
+    g = nq // nkv
+    bs = block_size
+
+    # number of pages this sequence actually uses
+    n_used = jnp.minimum(
+        (ctx_len + bs - 1) // bs, jnp.int32(num_pages)
+    )
+
+    def page_dma(slot, page_idx, buf, cache_ref, which):
+        row0 = block_tables_ref[i, page_idx] * bs
+        return pltpu.make_async_copy(
+            cache_ref.at[layer, pl.ds(row0, bs)],
+            buf.at[slot],
+            sem.at[slot, which],
+        )
+
+    @pl.when(n_used > 0)
+    def _():
+        page_dma(0, 0, k_buf, k_cache_ref, 0).start()
+        page_dma(0, 0, v_buf, v_cache_ref, 1).start()
+
+    q = q_ref[0].astype(jnp.float32).reshape(nkv, g, d) * scale
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_used)
+        def _():
+            page_dma(nxt, j + 1, k_buf, k_cache_ref, 0).start()
+            page_dma(nxt, j + 1, v_buf, v_cache_ref, 1).start()
+
+        page_dma(slot, j, k_buf, k_cache_ref, 0).wait()
+        page_dma(slot, j, v_buf, v_cache_ref, 1).wait()
+
+        k = k_buf[slot].astype(jnp.float32)  # (bs, nkv, d)
+        v = v_buf[slot].astype(jnp.float32)
+        # (nkv, g, d) x (bs, nkv, d) -> (nkv, g, bs), batched over kv heads
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
+        s = jnp.where(pos < ctx_len, s, MASK_VALUE)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)  # (nkv, g, bs)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # (nkv, g, bs) x (bs, nkv, d) -> (nkv, g, d)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((nkv, g, 1), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((nkv, g, 1), jnp.float32)
+    acc0 = jnp.zeros((nkv, g, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.reshape(nq, d).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "scale", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,             # (b, nq, d)
+    k_cache: jax.Array,       # (L, num_slots, nkv, d)
+    v_cache: jax.Array,
+    layer: jax.Array,         # scalar int32
+    block_tables: jax.Array,  # (b, P) int32 — page ids per sequence
+    context_lens: jax.Array,  # (b,) int32
+    *,
+    block_size: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """One decode step of paged attention. Returns (b, nq, d) in q.dtype."""
+    b, nq, d = q.shape
+    nkv = k_cache.shape[2]
+    num_pages = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, nq, d), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, nkv, d), k_cache.dtype),
+            pltpu.VMEM((2, block_size, nkv, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        block_size=block_size,
+        num_pages=num_pages,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nq, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        q,
+        k_cache,
+        v_cache,
+    )
