@@ -1,0 +1,45 @@
+{{/* Common naming + label helpers (role of reference helm/templates/_helpers.tpl) */}}
+
+{{- define "pst.fullname" -}}
+{{- .Release.Name | trunc 50 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "pst.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+{{- end -}}
+
+{{- define "pst.engineLabels" -}}
+{{ include "pst.labels" . }}
+{{- with .Values.servingEngineSpec.labels }}
+{{ toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{- define "pst.routerLabels" -}}
+{{ include "pst.labels" . }}
+{{- with .Values.routerSpec.labels }}
+{{ toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{/* HF token secret name: generated unless an existing secret is referenced */}}
+{{- define "pst.hfTokenSecretName" -}}
+{{- $t := .Values.servingEngineSpec.hfToken -}}
+{{- if and $t (kindIs "map" $t) -}}
+{{- $t.secretName -}}
+{{- else -}}
+{{- printf "%s-secrets" (include "pst.fullname" .) -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "pst.hfTokenSecretKey" -}}
+{{- $t := .Values.servingEngineSpec.hfToken -}}
+{{- if and $t (kindIs "map" $t) -}}
+{{- $t.secretKey -}}
+{{- else -}}
+hf-token
+{{- end -}}
+{{- end -}}

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Install the observability stack for production-stack-tpu (reference:
+# observability/install.sh): kube-prometheus-stack + prometheus-adapter +
+# the TPU stack Grafana dashboard as a sidecar-loaded configmap.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+NS="${MONITORING_NS:-monitoring}"
+
+helm repo add prometheus-community \
+  https://prometheus-community.github.io/helm-charts >/dev/null
+helm repo update >/dev/null
+
+echo "Installing kube-prometheus-stack into namespace ${NS}..."
+helm upgrade --install kube-prom-stack \
+  prometheus-community/kube-prometheus-stack \
+  --namespace "${NS}" --create-namespace \
+  -f kube-prom-stack.yaml
+
+echo "Installing prometheus-adapter (custom metrics for HPA)..."
+helm upgrade --install prometheus-adapter \
+  prometheus-community/prometheus-adapter \
+  --namespace "${NS}" \
+  -f prom-adapter.yaml
+
+echo "Loading the TPU stack dashboard..."
+kubectl create configmap tpu-stack-dashboard \
+  --from-file=tpu-stack-dashboard.json \
+  --namespace "${NS}" \
+  --dry-run=client -o yaml |
+  kubectl label -f - grafana_dashboard=1 --local --dry-run=client -o yaml |
+  kubectl apply -f -
+
+echo "Done. Port-forward Grafana with:"
+echo "  kubectl -n ${NS} port-forward svc/kube-prom-stack-grafana 3000:80"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tear down the observability stack (reference: observability/uninstall.sh).
+set -euo pipefail
+NS="${MONITORING_NS:-monitoring}"
+kubectl delete configmap tpu-stack-dashboard -n "${NS}" --ignore-not-found
+helm uninstall prometheus-adapter -n "${NS}" || true
+helm uninstall kube-prom-stack -n "${NS}" || true

