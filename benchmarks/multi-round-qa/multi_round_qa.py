@@ -1,0 +1,269 @@
+"""Multi-round QA serving benchmark.
+
+Same workload semantics as the reference's headline benchmark (reference:
+benchmarks/multi-round-qa/multi-round-qa.py — N concurrent users, M chat
+rounds each, target aggregate QPS, shared system prompt + growing per-user
+history, streamed answers, TTFT at first chunk; summary QPS / prompt
+throughput / generation throughput / average TTFT, :446-518), written
+fresh on asyncio+aiohttp instead of the reference's thread/openai-client
+design.
+
+Usage:
+  python multi_round_qa.py --base-url http://localhost:8001 \
+      --model llama-3.2-1b --num-users 32 --num-rounds 10 --qps 2 \
+      --shared-system-prompt-len 1000 --user-history-len 2000 \
+      --answer-len 100 --duration 120 --output summary.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import string
+import time
+from dataclasses import dataclass, field
+
+import aiohttp
+
+
+def synthetic_text(num_words: int, seed: int) -> str:
+    rng = random.Random(seed)
+    words = []
+    for _ in range(num_words):
+        n = rng.randint(3, 9)
+        words.append(
+            "".join(rng.choices(string.ascii_lowercase, k=n))
+        )
+    return " ".join(words)
+
+
+@dataclass
+class RequestRecord:
+    start: float
+    first_token: float | None = None
+    end: float | None = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    ok: bool = False
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token is None:
+            return None
+        return self.first_token - self.start
+
+
+@dataclass
+class UserSession:
+    """One simulated user: rounds of Q->A with history accumulation
+    (reference: UserSession state machine, multi-round-qa.py:182)."""
+
+    user_id: int
+    args: argparse.Namespace
+    history: list[dict] = field(default_factory=list)
+    rounds_done: int = 0
+
+    def build_messages(self) -> list[dict]:
+        msgs = [{"role": "system", "content": self.args._system_prompt}]
+        if not self.history and self.args.user_history_len > 0:
+            # per-user unique context so prefix caching can't collapse users
+            self.history.append({
+                "role": "user",
+                "content": synthetic_text(
+                    self.args.user_history_len, seed=self.user_id
+                ),
+            })
+            self.history.append({
+                "role": "assistant", "content": "understood.",
+            })
+        msgs.extend(self.history)
+        msgs.append({
+            "role": "user",
+            "content": (
+                f"question {self.rounds_done} from user {self.user_id}: "
+                + synthetic_text(24, seed=self.user_id * 1000 +
+                                 self.rounds_done)
+            ),
+        })
+        return msgs
+
+
+class Benchmark:
+    def __init__(self, args: argparse.Namespace):
+        self.args = args
+        self.records: list[RequestRecord] = []
+        self.errors = 0
+        self.sessions = [
+            UserSession(i, args) for i in range(args.num_users)
+        ]
+        self.free_sessions = asyncio.Queue()
+        for s in self.sessions:
+            self.free_sessions.put_nowait(s)
+
+    async def run_request(self, session: UserSession,
+                          http: aiohttp.ClientSession) -> None:
+        msgs = session.build_messages()
+        rec = RequestRecord(start=time.time())
+        body = {
+            "model": self.args.model,
+            "messages": msgs,
+            "max_tokens": self.args.answer_len,
+            "temperature": 0.0,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+        }
+        answer_parts: list[str] = []
+        try:
+            async with http.post(
+                f"{self.args.base_url}/v1/chat/completions", json=body
+            ) as resp:
+                if resp.status != 200:
+                    self.errors += 1
+                    return
+                async for raw_line in resp.content:
+                    line = raw_line.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        break
+                    try:
+                        chunk = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.first_token is None:
+                        rec.first_token = time.time()
+                    for choice in chunk.get("choices", []):
+                        delta = choice.get("delta", {})
+                        if delta.get("content"):
+                            answer_parts.append(delta["content"])
+                            rec.completion_tokens += 1
+                    usage = chunk.get("usage")
+                    if usage:
+                        rec.prompt_tokens = usage.get("prompt_tokens", 0)
+                        rec.completion_tokens = usage.get(
+                            "completion_tokens", rec.completion_tokens
+                        )
+            rec.end = time.time()
+            rec.ok = True
+            session.history.append({"role": "user",
+                                    "content": msgs[-1]["content"]})
+            session.history.append({"role": "assistant",
+                                    "content": "".join(answer_parts)})
+            session.rounds_done += 1
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            self.errors += 1
+        finally:
+            self.records.append(rec)
+            if session.rounds_done < self.args.num_rounds:
+                self.free_sessions.put_nowait(session)
+
+    async def run(self) -> dict:
+        timeout = aiohttp.ClientTimeout(total=self.args.request_timeout)
+        conn = aiohttp.TCPConnector(limit=0)
+        t_start = time.time()
+        deadline = t_start + self.args.duration
+        interval = 1.0 / self.args.qps if self.args.qps > 0 else 0.0
+        pending: set[asyncio.Task] = set()
+        launched = 0
+        async with aiohttp.ClientSession(
+            timeout=timeout, connector=conn
+        ) as http:
+            next_fire = time.time()
+            while time.time() < deadline:
+                if interval:
+                    now = time.time()
+                    if now < next_fire:
+                        await asyncio.sleep(
+                            min(next_fire - now, deadline - now)
+                        )
+                        continue
+                    next_fire += interval
+                try:
+                    sess = self.free_sessions.get_nowait()
+                except asyncio.QueueEmpty:
+                    # all users busy or finished: yield and retry
+                    await asyncio.sleep(0.005)
+                    continue
+                task = asyncio.create_task(self.run_request(sess, http))
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+                launched += 1
+                if all(
+                    s.rounds_done >= self.args.num_rounds
+                    for s in self.sessions
+                ) and not pending:
+                    break
+            if pending:
+                await asyncio.wait(pending, timeout=self.args.request_timeout)
+        elapsed = time.time() - t_start
+        return self.summary(elapsed, launched)
+
+    def summary(self, elapsed: float, launched: int) -> dict:
+        done = [r for r in self.records if r.ok]
+        ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        prompt_tokens = sum(r.prompt_tokens for r in done)
+        gen_tokens = sum(r.completion_tokens for r in done)
+
+        def pct(p):
+            if not ttfts:
+                return None
+            return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+
+        return {
+            "duration_s": round(elapsed, 2),
+            "requests_launched": launched,
+            "requests_completed": len(done),
+            "errors": self.errors,
+            "qps": round(len(done) / elapsed, 3) if elapsed else 0,
+            "prompt_throughput_tok_s":
+                round(prompt_tokens / elapsed, 1) if elapsed else 0,
+            "generation_throughput_tok_s":
+                round(gen_tokens / elapsed, 1) if elapsed else 0,
+            "avg_ttft_s":
+                round(sum(ttfts) / len(ttfts), 4) if ttfts else None,
+            "p50_ttft_s": round(pct(0.50), 4) if ttfts else None,
+            "p90_ttft_s": round(pct(0.90), 4) if ttfts else None,
+            "p99_ttft_s": round(pct(0.99), 4) if ttfts else None,
+        }
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--base-url", default="http://localhost:8001")
+    p.add_argument("--model", required=True)
+    p.add_argument("--num-users", type=int, default=32)
+    p.add_argument("--num-rounds", type=int, default=10)
+    p.add_argument("--qps", type=float, default=2.0,
+                   help="target aggregate request launch rate; 0 = as "
+                        "fast as users free up")
+    p.add_argument("--shared-system-prompt-len", type=int, default=1000,
+                   help="words in the shared system prompt")
+    p.add_argument("--user-history-len", type=int, default=2000,
+                   help="words of unique per-user first-round context")
+    p.add_argument("--answer-len", type=int, default=100)
+    p.add_argument("--duration", type=float, default=120.0)
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    args._system_prompt = (
+        "You are a helpful assistant. "
+        + synthetic_text(args.shared_system_prompt_len, seed=42)
+    )
+    return args
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    result = asyncio.run(Benchmark(args).run())
+    print(json.dumps(result, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == "__main__":
+    main()

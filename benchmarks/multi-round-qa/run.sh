@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# QPS sweep of the multi-round-qa benchmark (reference: run.sh — warmup
+# then sweep with 320 users / 10 rounds / 1000-token system prompt /
+# 20000-token history / 100-token answers). Scale knobs via env.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+BASE_URL="${BASE_URL:-http://localhost:8001}"
+MODEL="${MODEL:?set MODEL}"
+USERS="${USERS:-320}"
+ROUNDS="${ROUNDS:-10}"
+SYS_LEN="${SYS_LEN:-1000}"
+HIST_LEN="${HIST_LEN:-20000}"
+ANSWER_LEN="${ANSWER_LEN:-100}"
+DURATION="${DURATION:-120}"
+QPS_SWEEP="${QPS_SWEEP:-1 2 4 8}"
+
+echo "== warmup =="
+python3 multi_round_qa.py --base-url "$BASE_URL" --model "$MODEL" \
+  --num-users "$USERS" --num-rounds 2 --qps 0 \
+  --shared-system-prompt-len "$SYS_LEN" --user-history-len "$HIST_LEN" \
+  --answer-len 16 --duration 60 --output warmup.json
+
+for qps in $QPS_SWEEP; do
+  echo "== qps=$qps =="
+  python3 multi_round_qa.py --base-url "$BASE_URL" --model "$MODEL" \
+    --num-users "$USERS" --num-rounds "$ROUNDS" --qps "$qps" \
+    --shared-system-prompt-len "$SYS_LEN" --user-history-len "$HIST_LEN" \
+    --answer-len "$ANSWER_LEN" --duration "$DURATION" \
+    --output "summary_qps${qps}.json"
+done
+
+echo "done; summaries in summary_qps*.json"

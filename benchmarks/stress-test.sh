@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Router stress floor (reference: tests/e2e/stress-test.sh — 10,000
+# requests at 2,000 concurrency through the router against mock backends;
+# asserts even distribution and zero drops). Pure-python load generator
+# instead of Apache Bench (not in this image).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TOTAL="${TOTAL:-10000}"
+CONCURRENCY="${CONCURRENCY:-2000}"
+python3 - "$TOTAL" "$CONCURRENCY" <<'EOF'
+import asyncio, json, sys, time
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+TOTAL, CONCURRENCY = int(sys.argv[1]), int(sys.argv[2])
+
+async def main():
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+    from fake_engine import FakeEngine
+    from production_stack_tpu.router import parsers
+    from production_stack_tpu.router.app import build_app
+
+    engines = [FakeEngine(model="m", num_tokens=2) for _ in range(2)]
+    for e in engines:
+        await e.start()
+    args = parsers.parse_args([
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", "m,m",
+        "--routing-logic", "roundrobin",
+    ])
+    ra = build_app(args)
+    client = TestClient(TestServer(ra.app))
+    await client.start_server()
+
+    sem = asyncio.Semaphore(CONCURRENCY)
+    ok = 0
+    fail = 0
+
+    async def one(i):
+        nonlocal ok, fail
+        async with sem:
+            try:
+                r = await client.post("/v1/completions", json={
+                    "model": "m", "prompt": f"req {i}", "max_tokens": 2})
+                if r.status == 200:
+                    ok += 1
+                else:
+                    fail += 1
+                await r.release()
+            except Exception:
+                fail += 1
+
+    t0 = time.time()
+    await asyncio.gather(*(one(i) for i in range(TOTAL)))
+    dt = time.time() - t0
+    counts = [len(e.requests_seen) for e in engines]
+    print(json.dumps({
+        "total": TOTAL, "concurrency": CONCURRENCY,
+        "ok": ok, "failed": fail, "rps": round(TOTAL / dt, 1),
+        "distribution": counts,
+    }))
+    assert fail == 0, f"{fail} dropped requests"
+    assert abs(counts[0] - counts[1]) <= TOTAL * 0.02, (
+        f"uneven distribution: {counts}")
+    await client.close()
+    for e in engines:
+        await e.stop()
+    print("STRESS TEST PASSED")
+
+asyncio.run(main())
+EOF
