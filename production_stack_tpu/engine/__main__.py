@@ -60,6 +60,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-peer", default=None,
                    help="producer host:port to pull KV from (consumer)")
     # KV offload (LMCache-equivalent)
+    p.add_argument("--max-lora-rank", type=int, default=16)
     p.add_argument("--cpu-offload-gb", type=float, default=0.0)
     p.add_argument("--disk-offload-dir", default=None)
     p.add_argument("--remote-cache-url", default=None)
@@ -92,6 +93,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         served_model_name=args.served_model_name,
         enable_lora=args.enable_lora,
         max_loras=args.max_loras,
+        max_lora_rank=args.max_lora_rank,
         attention_impl=args.attention_impl,
         kv_role=role,
         kv_transfer_config={

@@ -117,10 +117,14 @@ class BlockManager:
         blk.ref_count += 1
 
     # -- sequence-level API ----------------------------------------------
-    def block_hashes_for(self, token_ids: list[int]) -> list[int]:
-        """Chain hashes for each *full* block of token_ids."""
+    def block_hashes_for(self, token_ids: list[int],
+                         seed: int = 0) -> list[int]:
+        """Chain hashes for each *full* block of token_ids.
+
+        `seed` starts the chain (0 = base model; LoRA requests pass a
+        per-adapter seed so adapters never share KV blocks)."""
         hashes = []
-        prev = 0
+        prev = seed
         bs = self.block_size
         for i in range(len(token_ids) // bs):
             prev = hash_block(prev, tuple(token_ids[i * bs : (i + 1) * bs]))
@@ -130,7 +134,8 @@ class BlockManager:
     def contains_hash(self, h: int) -> bool:
         return h in self.cached_blocks
 
-    def match_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
+    def match_prefix(self, token_ids: list[int],
+                     seed: int = 0) -> tuple[list[int], int]:
         """Longest cached prefix: returns (block_ids, num_cached_tokens).
 
         Does NOT take references; pairs with allocate_prompt.
@@ -138,7 +143,7 @@ class BlockManager:
         if not self.enable_prefix_caching:
             return [], 0
         matched: list[int] = []
-        for h in self.block_hashes_for(token_ids):
+        for h in self.block_hashes_for(token_ids, seed):
             bid = self.cached_blocks.get(h)
             if bid is None:
                 break
@@ -146,7 +151,7 @@ class BlockManager:
         return matched, len(matched) * self.block_size
 
     def allocate_prompt(
-        self, token_ids: list[int]
+        self, token_ids: list[int], seed: int = 0
     ) -> tuple[list[int], int] | None:
         """Allocate the block table for a prompt, reusing cached prefix blocks.
 
@@ -156,7 +161,7 @@ class BlockManager:
         """
         n = len(token_ids)
         self.prefix_queries += n
-        matched, cached_tokens = self.match_prefix(token_ids)
+        matched, cached_tokens = self.match_prefix(token_ids, seed)
         cached_tokens = min(cached_tokens, n - 1)
         num_matched_blocks = cached_tokens // self.block_size
         matched = matched[:num_matched_blocks]

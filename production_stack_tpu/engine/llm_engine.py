@@ -128,8 +128,8 @@ class LLMEngine:
         bm = self.block_manager
         if not bm.enable_prefix_caching:
             return
-        hashes = bm.block_hashes_for(seq.prompt_token_ids)
-        matched, _ = bm.match_prefix(seq.prompt_token_ids)
+        hashes = bm.block_hashes_for(seq.prompt_token_ids, seq.hash_seed)
+        matched, _ = bm.match_prefix(seq.prompt_token_ids, seq.hash_seed)
         restore: list[tuple[int, np.ndarray]] = []  # (block_id, data)
         i = len(matched)
         hbm_full = False
@@ -184,7 +184,17 @@ class LLMEngine:
             prompt_token_ids = self.tokenizer.encode(prompt)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if lora_name is not None:
+            if self.runner.lora_manager is None:
+                raise ValueError(
+                    "request names a LoRA adapter but the engine was "
+                    "started without --enable-lora"
+                )
+            self.runner.lora_manager.slot_of(lora_name)  # raises if unknown
         sp = sampling_params or SamplingParams()
+        hash_seed = None
+        if self.runner.lora_manager is not None:
+            hash_seed = self.runner.lora_manager.hash_seed_of(lora_name)
         seq = Sequence(
             request_id=request_id,
             prompt_token_ids=prompt_token_ids,
@@ -192,6 +202,7 @@ class LLMEngine:
             eos_token_id=self.tokenizer.eos_token_id,
             arrival_time=arrival_time,
             lora_name=lora_name,
+            hash_seed=hash_seed,
         )
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
@@ -240,6 +251,7 @@ class LLMEngine:
                 start_pos=w.chunk_start,
                 block_table=seq.block_table,
                 total_len=w.chunk_start + w.chunk_len,
+                lora_slot=self._lora_slot(seq),
             )
             seq.num_computed_tokens += w.chunk_len
             self._prompt_tokens_total += w.chunk_len
@@ -253,7 +265,10 @@ class LLMEngine:
             positions = [s.num_tokens - 1 for s in seqs]
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
-            logits = self.runner.decode(tokens, positions, tables, ctx_lens)
+            logits = self.runner.decode(
+                tokens, positions, tables, ctx_lens,
+                lora_slots=[self._lora_slot(s) for s in seqs],
+            )
             sampled = self._sample(seqs, logits[: len(seqs)])
             for seq, token in zip(seqs, sampled):
                 seq.num_computed_tokens = seq.num_tokens
@@ -354,7 +369,9 @@ class LLMEngine:
             i = len(seq.block_hashes)
             if i >= len(seq.block_table):
                 break
-            prev = seq.block_hashes[-1] if seq.block_hashes else 0
+            prev = (
+                seq.block_hashes[-1] if seq.block_hashes else seq.hash_seed
+            )
             h = self.block_manager.register_block(
                 prev, tuple(all_ids[i * bs : (i + 1) * bs]),
                 seq.block_table[i],
@@ -376,24 +393,37 @@ class LLMEngine:
             num_cached_tokens=seq.metrics.num_cached_prompt_tokens,
         )
 
-    # -- LoRA hot-load (full adapter math lands with the LoRA runner) -------
+    # -- LoRA hot-load (adapters applied in the jitted steps; engine/lora.py)
     def load_lora(self, name: str, path: str) -> None:
-        if not hasattr(self, "_loras"):
-            self._loras: dict[str, str] = {}
-        if len(self._loras) >= self.config.max_loras and (
-            name not in self._loras
-        ):
+        if self.runner.lora_manager is None:
             raise RuntimeError(
-                f"max_loras={self.config.max_loras} adapters already loaded"
+                "LoRA is disabled; start the engine with --enable-lora"
             )
-        self._loras[name] = path
+        self.runner.lora_manager.load(name, path)
 
     def unload_lora(self, name: str) -> None:
-        if hasattr(self, "_loras"):
-            self._loras.pop(name, None)
+        if self.runner.lora_manager is not None:
+            self.runner.lora_manager.unload(name)
 
     def list_loras(self) -> list[str]:
-        return sorted(getattr(self, "_loras", {}))
+        if self.runner.lora_manager is None:
+            return []
+        return self.runner.lora_manager.list_adapters()
+
+    def _lora_slot(self, seq: Sequence) -> int:
+        if self.runner.lora_manager is None:
+            return 0
+        try:
+            return self.runner.lora_manager.slot_of(seq.lora_name)
+        except KeyError:
+            # adapter unloaded mid-request: degrade to the base model
+            # rather than killing the step loop
+            logger.warning(
+                "request %s: LoRA %r no longer loaded; using base model",
+                seq.request_id, seq.lora_name,
+            )
+            seq.lora_name = None
+            return 0
 
     def shutdown(self) -> None:
         if self.offload is not None:

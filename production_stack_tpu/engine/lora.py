@@ -1,0 +1,244 @@
+"""Multi-LoRA serving: stacked adapter slots applied inside the jitted step.
+
+Capability the reference gets from vLLM's LoRA support (engine pods expose
+/v1/load_lora_adapter and the operator's LoraAdapter controller places
+adapters on pods — reference: loraadapter_controller.go:582/:598,
+vllmruntime spec enableLora). TPU-first design:
+
+- All adapters live in ONE pair of stacked device buffers per target
+  projection: A (L, S+1, in, r_max), B (L, S+1, r_max, out), slot 0 all
+  zeros = "no adapter". Loading/unloading an adapter is a buffer row
+  update — the jitted step never recompiles because shapes are static
+  (max_loras and max_lora_rank fixed at engine start, like vLLM).
+- Per-token adapter slots ride into the step as an int32 vector; inside
+  each layer the kernel gathers that token's A/B rows and adds
+  scaling * (x @ A) @ B to the base projection. A batch can mix any
+  combination of adapters (multi-LoRA batching).
+- Ranks smaller than r_max are zero-padded — extra FLOPs are negligible
+  at serving ranks (r <= 64) and uniformity keeps the MXU shapes fixed.
+
+Adapter files: native .npz with arrays `{target}_A` (L, in, r) and
+`{target}_B` (L, r, out) for targets wq/wk/wv/wo plus optional scalar
+`scaling`; HF PEFT safetensors checkpoints are converted when the
+safetensors package is importable.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import xxhash
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _target_dims(mc: ModelConfig) -> dict[str, tuple[int, int]]:
+    h = mc.hidden_size
+    return {
+        "wq": (h, mc.q_size),
+        "wk": (h, mc.kv_size),
+        "wv": (h, mc.kv_size),
+        "wo": (mc.q_size, h),
+    }
+
+
+class LoraManager:
+    """Owns the stacked adapter buffers + name->slot registry."""
+
+    def __init__(self, mc: ModelConfig, max_loras: int, max_rank: int,
+                 dtype=jnp.bfloat16):
+        self.mc = mc
+        self.max_loras = max_loras
+        self.max_rank = max_rank
+        self.dtype = dtype
+        L = mc.num_layers
+        S = max_loras + 1  # slot 0 = no adapter
+        # layer-leading layout (L, S, ...) so the model's lax.scan over
+        # layers slices adapter rows for free alongside the base weights
+        self.buffers: dict[str, jnp.ndarray] = {}
+        for t, (din, dout) in _target_dims(mc).items():
+            self.buffers[f"{t}_A"] = jnp.zeros((L, S, din, max_rank), dtype)
+            self.buffers[f"{t}_B"] = jnp.zeros((L, S, max_rank, dout), dtype)
+        self.buffers["scaling"] = jnp.zeros((S,), jnp.float32)
+        self.name_to_slot: dict[str, int] = {}
+        self._paths: dict[str, str] = {}
+        self._generation: dict[str, int] = {}
+        self._free = list(range(1, S))
+
+    def slot_of(self, name: str | None) -> int:
+        if name is None:
+            return 0
+        slot = self.name_to_slot.get(name)
+        if slot is None:
+            raise KeyError(f"LoRA adapter {name!r} is not loaded")
+        return slot
+
+    def list_adapters(self) -> list[str]:
+        return sorted(self.name_to_slot)
+
+    # -- load/unload -------------------------------------------------------
+    def load(self, name: str, path: str) -> int:
+        if name in self.name_to_slot:
+            if self._paths.get(name) == path:
+                return self.name_to_slot[name]  # idempotent reload
+            # same name, new path: replace the served weights (the caller
+            # expects the new adapter, not a silent no-op)
+            self.unload(name)
+        if not self._free:
+            raise RuntimeError(
+                f"max_loras={self.max_loras} adapters already loaded"
+            )
+        weights = self._read_adapter(path)
+        L = self.mc.num_layers
+        dims = _target_dims(self.mc)
+        # validate + pad EVERY target before any buffer write, so a bad
+        # adapter can never leave partial rows in a freed slot
+        staged: dict[str, np.ndarray] = {}
+        for t in TARGETS:
+            A = weights.get(f"{t}_A")
+            B = weights.get(f"{t}_B")
+            if A is None or B is None:
+                continue  # adapter may target a subset of projections
+            din, dout = dims[t]
+            r = A.shape[-1]
+            if r > self.max_rank:
+                raise ValueError(
+                    f"adapter rank {r} exceeds max_lora_rank={self.max_rank}"
+                )
+            if A.shape != (L, din, r) or B.shape != (L, r, dout):
+                raise ValueError(
+                    f"adapter {t} shapes {A.shape}/{B.shape} do not match "
+                    f"model ({L}, {din}, r)/({L}, r, {dout})"
+                )
+            A_pad = np.zeros((L, din, self.max_rank), np.float32)
+            B_pad = np.zeros((L, self.max_rank, dout), np.float32)
+            A_pad[:, :, :r] = A
+            B_pad[:, :r, :] = B
+            staged[f"{t}_A"] = A_pad
+            staged[f"{t}_B"] = B_pad
+
+        slot = self._free.pop(0)
+        for key, arr in staged.items():
+            self.buffers[key] = self.buffers[key].at[:, slot].set(
+                jnp.asarray(arr, self.dtype)
+            )
+        self.buffers["scaling"] = self.buffers["scaling"].at[slot].set(
+            float(weights.get("scaling", 1.0))
+        )
+        self.name_to_slot[name] = slot
+        self._paths[name] = path
+        # per-load generation: the prefix-cache hash seed folds this in so
+        # KV computed under an earlier load of the same name is never
+        # reused after a reload with different weights
+        self._generation[name] = self._generation.get(name, 0) + 1
+        logger.info("loaded LoRA %r into slot %d (path %s, gen %d)",
+                    name, slot, path, self._generation[name])
+        return slot
+
+    def hash_seed_of(self, name: str | None) -> int:
+        """Prefix-cache chain seed for requests using this adapter: folds
+        the per-load generation in so reloaded weights never hit KV cached
+        under a previous load of the same name."""
+        if name is None:
+            return 0
+        gen = self._generation.get(name, 0)
+        return xxhash.xxh64(
+            f"lora:{name}:{gen}".encode()
+        ).intdigest()
+
+    def unload(self, name: str) -> bool:
+        slot = self.name_to_slot.pop(name, None)
+        self._paths.pop(name, None)
+        if slot is None:
+            return False
+        for t in TARGETS:
+            self.buffers[f"{t}_A"] = (
+                self.buffers[f"{t}_A"].at[:, slot].set(0.0)
+            )
+            self.buffers[f"{t}_B"] = (
+                self.buffers[f"{t}_B"].at[:, slot].set(0.0)
+            )
+        self.buffers["scaling"] = self.buffers["scaling"].at[slot].set(0.0)
+        self._free.insert(0, slot)
+        logger.info("unloaded LoRA %r (slot %d)", name, slot)
+        return True
+
+    # -- adapter file formats ---------------------------------------------
+    def _read_adapter(self, path: str) -> dict:
+        if os.path.isdir(path):
+            for candidate in ("adapter.npz", "adapter_model.safetensors"):
+                p = os.path.join(path, candidate)
+                if os.path.exists(p):
+                    path = p
+                    break
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                return {k: np.asarray(z[k]) for k in z.files}
+        if path.endswith(".safetensors"):
+            return self._read_peft_safetensors(path)
+        raise ValueError(f"unsupported adapter format: {path!r}")
+
+    def _read_peft_safetensors(self, path: str) -> dict:
+        """Convert HF PEFT layout (per-layer q_proj/k_proj/... lora_A/B
+        with (r, in)/(out, r) torch conventions) to our stacked layout.
+        Scaling = lora_alpha / r from the sibling adapter_config.json."""
+        import json
+
+        from safetensors import safe_open  # optional dep, gated
+
+        peft_to_target = {"q_proj": "wq", "k_proj": "wk",
+                          "v_proj": "wv", "o_proj": "wo"}
+        L = self.mc.num_layers
+        per_target: dict[str, dict[int, dict[str, np.ndarray]]] = {}
+        with safe_open(path, framework="numpy") as f:
+            for key in f.keys():
+                parts = key.split(".")
+                try:
+                    layer = int(parts[parts.index("layers") + 1])
+                except (ValueError, IndexError):
+                    continue
+                proj = next(
+                    (t for p, t in peft_to_target.items() if p in key), None
+                )
+                if proj is None:
+                    continue
+                ab = "A" if "lora_A" in key else "B"
+                per_target.setdefault(proj, {}).setdefault(layer, {})[ab] = (
+                    f.get_tensor(key)
+                )
+        out: dict[str, np.ndarray] = {}
+        for t, layers in per_target.items():
+            if len(layers) != L:
+                raise ValueError(
+                    f"adapter covers {len(layers)} layers for {t}, "
+                    f"model has {L}"
+                )
+            # torch lora_A: (r, in) -> ours (in, r); lora_B: (out, r) ->
+            # ours (r, out)
+            A = np.stack([layers[i]["A"].T for i in range(L)])
+            B = np.stack([layers[i]["B"].T for i in range(L)])
+            out[f"{t}_A"] = A
+            out[f"{t}_B"] = B
+        # PEFT scaling convention: lora_alpha / r from adapter_config.json
+        cfg_path = os.path.join(os.path.dirname(path),
+                                "adapter_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            alpha = cfg.get("lora_alpha")
+            r = cfg.get("r")
+            if alpha and r:
+                out["scaling"] = np.float32(alpha / r)
+        return out
+
+
+def save_adapter_npz(path: str, weights: dict) -> None:
+    """Write an adapter in the native .npz format (tests, tooling)."""
+    np.savez(path, **weights)

@@ -124,6 +124,17 @@ class ModelRunner:
             )
         self.attention_impl = impl
         logger.info("attention impl: %s", impl)
+
+        # multi-LoRA: stacked adapter buffers applied inside the jitted
+        # steps (engine/lora.py); None when --enable-lora is off so the
+        # step functions trace without the adapter math
+        self.lora_manager = None
+        if config.enable_lora:
+            from production_stack_tpu.engine.lora import LoraManager
+
+            self.lora_manager = LoraManager(
+                mc, config.max_loras, config.max_lora_rank, self.dtype
+            )
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
@@ -196,7 +207,8 @@ class ModelRunner:
             )
 
         def step(params, kc, vc, tokens, positions, write_slots,
-                 gather_slots, total_len, last_row):
+                 gather_slots, total_len, last_row, lora=None,
+                 lora_slots=None):
             attn_fn = functools.partial(
                 attn,
                 gather_slots=gather_slots,
@@ -207,6 +219,7 @@ class ModelRunner:
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=last_row[None],
+                lora=lora, lora_slots=lora_slots,
             )
             return logits[0], kc, vc
 
@@ -241,7 +254,7 @@ class ModelRunner:
                 )
 
         def step(params, kc, vc, tokens, positions, write_slots,
-                 tables, context_lens):
+                 tables, context_lens, lora=None, lora_slots=None):
             attn_fn = functools.partial(
                 attn, tables=tables, context_lens=context_lens
             )
@@ -249,6 +262,7 @@ class ModelRunner:
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=jnp.arange(b),
+                lora=lora, lora_slots=lora_slots,
             )
             return logits, kc, vc
 
@@ -289,6 +303,7 @@ class ModelRunner:
         start_pos: int,
         block_table: list[int],
         total_len: int,
+        lora_slot: int = 0,
     ) -> jax.Array:
         """Run one prefill chunk; returns fp32 logits (vocab,) for the chunk's
         last *actual* token. K/V for the chunk is written into the cache."""
@@ -310,6 +325,14 @@ class ModelRunner:
             logger.info("compiling prefill step t=%d ctx=%d", t_pad, c_pad)
             self._prefill_fns[key] = self._build_prefill(t_pad, c_pad)
         fn = self._prefill_fns[key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            # scalar slot: prefill is one sequence, so the whole chunk
+            # shares one adapter and forward() takes the uniform fast path
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.int32(lora_slot),
+            }
         logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
@@ -320,6 +343,7 @@ class ModelRunner:
             jnp.asarray(gather_slots),
             jnp.int32(total_len),
             jnp.int32(t - 1),
+            **lora_kw,
         )
         return logits
 
@@ -329,6 +353,7 @@ class ModelRunner:
         positions: list[int],
         block_tables: list[list[int]],
         context_lens: list[int],
+        lora_slots: list[int] | None = None,
     ) -> jax.Array:
         """One decode step for a batch; returns fp32 logits (b, vocab) where
         rows beyond len(token_ids) are padded lanes."""
@@ -368,6 +393,15 @@ class ModelRunner:
             logger.info("compiling decode step b=%d ctx=%d", b, c_pad)
             self._decode_fns[key] = self._build_decode(b, c_pad)
         fn = self._decode_fns[key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            slots = np.zeros((b,), dtype=np.int32)
+            if lora_slots is not None:
+                slots[:b_actual] = lora_slots
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.asarray(slots),
+            }
         logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
@@ -377,6 +411,7 @@ class ModelRunner:
             jnp.asarray(write_slots),
             jnp.asarray(tables),
             jnp.asarray(ctx),
+            **lora_kw,
         )
         return logits
 

@@ -148,7 +148,9 @@ class Scheduler:
                     # a failure must never kill the step loop (the prompt
                     # is simply recomputed from scratch)
                     logger.exception("kv restore failed; recomputing prefix")
-            alloc = self.block_manager.allocate_prompt(seq.prompt_token_ids)
+            alloc = self.block_manager.allocate_prompt(
+                seq.prompt_token_ids, seed=seq.hash_seed
+            )
             if alloc is None:
                 break  # out of blocks; retry next step
             table, cached = alloc

@@ -6,6 +6,8 @@ import enum
 import time
 from dataclasses import dataclass, field
 
+import xxhash
+
 from production_stack_tpu.engine.sampling_params import SamplingParams
 
 
@@ -47,6 +49,7 @@ class Sequence:
         eos_token_id: int | None,
         arrival_time: float | None = None,
         lora_name: str | None = None,
+        hash_seed: int | None = None,
     ):
         self.request_id = request_id
         self.prompt_token_ids = list(prompt_token_ids)
@@ -57,6 +60,18 @@ class Sequence:
         self.sampling_params = sampling_params
         self.eos_token_id = eos_token_id
         self.lora_name = lora_name
+        # prefix-cache hash-chain seed: LoRA requests must never share KV
+        # blocks with base-model (or other-adapter) requests, so the chain
+        # starts from a per-adapter seed instead of 0 (the engine passes a
+        # LoraManager-derived seed that also folds in the load generation)
+        if hash_seed is not None:
+            self.hash_seed = hash_seed
+        elif lora_name is None:
+            self.hash_seed = 0
+        else:
+            self.hash_seed = xxhash.xxh64(
+                b"lora:" + lora_name.encode()
+            ).intdigest()
         self.status = SequenceStatus.WAITING
         self.metrics = RequestMetrics()
         if arrival_time is not None:

@@ -83,12 +83,19 @@ def forward(
     write_slots: jax.Array,  # (n,) int32 cache rows for the new tokens
     attn_fn: AttnFn,
     logits_rows: jax.Array,  # (r,) int32 rows of h to project to logits
+    lora: dict | None = None,  # LoraManager.buffers: (L, S, in, r)/(L, S, r, out) + scaling (S,)
+    lora_slots: jax.Array | None = None,  # (n,) int32 adapter slot per token
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run the decoder over n tokens; returns (logits[r, V] fp32, k_cache, v_cache).
 
     The caller is responsible for the attention gather pattern via attn_fn;
     this function writes the new tokens' K/V into the cache *before* calling
     attn_fn, so attention sees them.
+
+    Multi-LoRA: when `lora`/`lora_slots` are given, each token's adapter
+    rows are gathered per layer and scaling * (x @ A) @ B is added to the
+    wq/wk/wv/wo projections (slot 0 is all-zero = no adapter), so one
+    batch can mix adapters freely (see engine/lora.py).
     """
     n = token_ids.shape[0]
     dtype = params["embed"].dtype
@@ -98,18 +105,56 @@ def forward(
 
     h = params["embed"][token_ids].astype(dtype)
 
+    use_lora = lora is not None
+    if use_lora:
+        # scalar lora_slots = whole batch uses one adapter (prefill runs
+        # one sequence per step): skip the per-token gather entirely and
+        # use plain (in, r) matmuls — per-token A/B copies would dominate
+        # HBM traffic at prefill chunk sizes
+        lora_uniform = jnp.ndim(lora_slots) == 0
+        if lora_uniform:
+            lora_scaling = lora["scaling"][lora_slots]  # scalar f32
+        else:
+            lora_scaling = lora["scaling"][lora_slots][:, None]  # (n, 1)
+        lora_layers = {k: v for k, v in lora.items() if k != "scaling"}
+
     def layer(carry, xs):
         h, kc, vc = carry
-        lp, l = xs
+        if use_lora:
+            lp, l, lz = xs
+        else:
+            lp, l = xs
+
+        def proj(x, target, base):
+            out = jnp.dot(x, lp[target], preferred_element_type=jnp.float32)
+            if base is not None:
+                out = out + base.astype(jnp.float32)
+            if use_lora:
+                if lora_uniform:
+                    A = lz[f"{target}_A"][lora_slots]  # (in, r)
+                    B = lz[f"{target}_B"][lora_slots]  # (r, out)
+                    delta = jnp.dot(
+                        jnp.dot(x, A, preferred_element_type=jnp.float32),
+                        B.astype(jnp.float32),
+                    )
+                else:
+                    A = lz[f"{target}_A"][lora_slots]  # (n, in, r)
+                    B = lz[f"{target}_B"][lora_slots]  # (n, r, out)
+                    t = jnp.einsum(
+                        "ni,nir->nr", x, A,
+                        preferred_element_type=jnp.float32,
+                    )
+                    delta = jnp.einsum(
+                        "nr,nro->no", t, B,
+                        preferred_element_type=jnp.float32,
+                    )
+                out = out + delta * lora_scaling
+            return out
 
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(x, lp["wq"], preferred_element_type=jnp.float32)
-        k = jnp.dot(x, lp["wk"], preferred_element_type=jnp.float32)
-        v = jnp.dot(x, lp["wv"], preferred_element_type=jnp.float32)
-        if cfg.qkv_bias:
-            q = q + lp["bq"].astype(jnp.float32)
-            k = k + lp["bk"].astype(jnp.float32)
-            v = v + lp["bv"].astype(jnp.float32)
+        q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
+        k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
+        v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
         q = q.astype(dtype).reshape(n, cfg.num_heads, cfg.head_dim)
         k = k.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
         v = v.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
@@ -119,20 +164,21 @@ def forward(
         vc = vc.at[l, write_slots].set(v.astype(cache_dtype))
 
         attn_out = attn_fn(q, l, kc, vc)  # (n, nq, d)
-        h = h + jnp.dot(
-            attn_out.reshape(n, cfg.q_size).astype(dtype),
-            lp["wo"],
-            preferred_element_type=jnp.float32,
+        h = h + proj(
+            attn_out.reshape(n, cfg.q_size).astype(dtype), "wo", None
         ).astype(dtype)
 
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
         return (h, kc, vc), None
 
+    xs = (
+        (params["layers"], jnp.arange(cfg.num_layers), lora_layers)
+        if use_lora
+        else (params["layers"], jnp.arange(cfg.num_layers))
+    )
     (h, k_cache, v_cache), _ = jax.lax.scan(
-        layer,
-        (h, k_cache, v_cache),
-        (params["layers"], jnp.arange(cfg.num_layers)),
+        layer, (h, k_cache, v_cache), xs
     )
 
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)

@@ -1,0 +1,225 @@
+"""Multi-LoRA serving tests (capability parity: engine-side adapter math
+behind /v1/load_lora_adapter — reference engines get this from vLLM; the
+operator's LoraAdapter controller drives the same endpoints,
+loraadapter_controller.go:582).
+
+Correctness oracle: a LoRA adapter (A, B, scaling) applied at serving time
+must produce exactly the same outputs as a base model whose projection
+weights were merged offline (W' = W + scaling * A @ B)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.lora import LoraManager, save_adapter_npz
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.models import llama
+from production_stack_tpu.models.config import get_model_config
+
+
+def engine_kwargs(**kw):
+    base = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=4, num_kv_blocks=64,
+        max_num_seqs=2, max_prefill_chunk=32,
+        enable_lora=True, max_loras=2, max_lora_rank=4,
+    )
+    base.update(kw)
+    return base
+
+
+def make_adapter(mc, rank=2, seed=0, scaling=0.5, targets=("wq", "wo")):
+    rng = np.random.RandomState(seed)
+    L, h = mc.num_layers, mc.hidden_size
+    dims = {"wq": (h, mc.q_size), "wk": (h, mc.kv_size),
+            "wv": (h, mc.kv_size), "wo": (mc.q_size, h)}
+    w = {"scaling": np.float32(scaling)}
+    for t in targets:
+        din, dout = dims[t]
+        w[f"{t}_A"] = rng.randn(L, din, rank).astype(np.float32) * 0.05
+        w[f"{t}_B"] = rng.randn(L, rank, dout).astype(np.float32) * 0.05
+    return w
+
+
+# -- unit: manager ----------------------------------------------------------
+class TestLoraManager:
+    def test_load_unload_slots(self, tmp_path):
+        mc = get_model_config("pst-tiny-debug")
+        m = LoraManager(mc, max_loras=2, max_rank=4, dtype=jnp.float32)
+        p1 = str(tmp_path / "a1.npz")
+        save_adapter_npz(p1, make_adapter(mc, seed=1))
+        s1 = m.load("a1", p1)
+        assert s1 == 1 and m.slot_of("a1") == 1
+        assert m.slot_of(None) == 0
+        assert m.load("a1", p1) == 1  # idempotent
+        p2 = str(tmp_path / "a2.npz")
+        save_adapter_npz(p2, make_adapter(mc, seed=2))
+        assert m.load("a2", p2) == 2
+        p3 = str(tmp_path / "a3.npz")
+        save_adapter_npz(p3, make_adapter(mc, seed=3))
+        with pytest.raises(RuntimeError, match="max_loras"):
+            m.load("a3", p3)
+        assert m.unload("a1")
+        assert not m.unload("a1")
+        assert m.load("a3", p3) == 1  # slot recycled
+        with pytest.raises(KeyError):
+            m.slot_of("a1")
+
+    def test_rank_too_large_rejected(self, tmp_path):
+        mc = get_model_config("pst-tiny-debug")
+        m = LoraManager(mc, max_loras=1, max_rank=2, dtype=jnp.float32)
+        p = str(tmp_path / "big.npz")
+        save_adapter_npz(p, make_adapter(mc, rank=8))
+        with pytest.raises(ValueError, match="rank"):
+            m.load("big", p)
+        assert m._free  # slot returned on failure
+
+
+# -- engine-level correctness ----------------------------------------------
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+def test_lora_matches_merged_weights(tmp_path):
+    """Serving-time adapter == offline weight merge, token for token."""
+    mc = get_model_config("pst-tiny-debug")
+    adapter = make_adapter(mc, rank=2, seed=7, scaling=0.5,
+                           targets=("wq", "wk", "wv", "wo"))
+    path = str(tmp_path / "ad.npz")
+    save_adapter_npz(path, adapter)
+
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+
+    eng = LLMEngine(EngineConfig(**engine_kwargs()))
+    base_params = eng.runner.params
+    eng.load_lora("ad", path)
+    assert eng.list_loras() == ["ad"]
+    eng.add_request("with-lora", prompt=PROMPT, sampling_params=sp,
+                    lora_name="ad")
+    eng.add_request("base", prompt=PROMPT, sampling_params=sp)
+    outs = {}
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished:
+                outs[o.request_id] = o.token_ids
+
+    # merged-weights oracle engine shares the SAME base weights
+    import jax
+
+    merged = jax.tree.map(lambda x: x, base_params)
+    layers = dict(merged["layers"])
+    for t in ("wq", "wk", "wv", "wo"):
+        delta = jnp.asarray(
+            adapter[f"{t}_A"] @ adapter[f"{t}_B"] * adapter["scaling"],
+            layers[t].dtype,
+        )
+        layers[t] = layers[t] + delta
+    merged["layers"] = layers
+    eng_merged = LLMEngine(
+        EngineConfig(**engine_kwargs(enable_lora=False)), params=merged
+    )
+    out_merged = eng_merged.generate([PROMPT], sp)[0].token_ids
+
+    assert outs["with-lora"] == out_merged, (
+        "LoRA serving output != merged-weight output"
+    )
+    # and the adapter genuinely changes behaviour vs base in-batch
+    eng_base = LLMEngine(
+        EngineConfig(**engine_kwargs(enable_lora=False)),
+        params=base_params,
+    )
+    assert outs["base"] == eng_base.generate([PROMPT], sp)[0].token_ids
+
+
+def test_multi_lora_batch_isolation(tmp_path):
+    """Two adapters decoding in the same batch each match their solo run,
+    and LoRA/base requests never share prefix-cache blocks."""
+    mc = get_model_config("pst-tiny-debug")
+    p1, p2 = str(tmp_path / "a1.npz"), str(tmp_path / "a2.npz")
+    save_adapter_npz(p1, make_adapter(mc, seed=11, scaling=1.0))
+    save_adapter_npz(p2, make_adapter(mc, seed=22, scaling=1.0))
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+
+    def run(reqs):  # [(name, lora)] -> {name: tokens}
+        eng = LLMEngine(EngineConfig(**engine_kwargs()))
+        eng.load_lora("a1", p1)
+        eng.load_lora("a2", p2)
+        for name, lora in reqs:
+            eng.add_request(name, prompt=PROMPT, sampling_params=sp,
+                            lora_name=lora)
+        outs = {}
+        while eng.has_unfinished():
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.token_ids
+        return outs, eng
+
+    solo1, _ = run([("r1", "a1")])
+    solo2, _ = run([("r2", "a2")])
+    both, eng = run([("r1", "a1"), ("r2", "a2")])
+    assert both["r1"] == solo1["r1"]
+    assert both["r2"] == solo2["r2"]
+
+    # prefix isolation: same prompt under a different adapter must MISS
+    # the prefix cache (hash chains are seeded per adapter)
+    h0 = eng.block_manager.prefix_hits
+    eng.add_request("base-after", prompt=PROMPT, sampling_params=sp)
+    while eng.has_unfinished():
+        eng.step()
+    assert eng.block_manager.prefix_hits == h0, (
+        "base request reused adapter KV blocks"
+    )
+
+
+def test_lora_requires_enable_flag():
+    eng = LLMEngine(EngineConfig(**engine_kwargs(enable_lora=False)))
+    with pytest.raises(RuntimeError, match="enable-lora"):
+        eng.load_lora("x", "/tmp/nope.npz")
+    with pytest.raises(ValueError, match="enable-lora"):
+        eng.add_request("r", prompt="hi", lora_name="x")
+
+
+def test_unknown_adapter_rejected_at_admission(tmp_path):
+    eng = LLMEngine(EngineConfig(**engine_kwargs()))
+    with pytest.raises(KeyError):
+        eng.add_request("r", prompt="hi", lora_name="ghost")
+
+
+def test_reload_with_new_weights_misses_stale_kv(tmp_path):
+    """Reloading a name with different weights must not reuse KV cached
+    under the previous load (per-load generation folded into the seed)."""
+    mc = get_model_config("pst-tiny-debug")
+    p1, p2 = str(tmp_path / "v1.npz"), str(tmp_path / "v2.npz")
+    save_adapter_npz(p1, make_adapter(mc, seed=1, scaling=1.0))
+    save_adapter_npz(p2, make_adapter(mc, seed=2, scaling=1.0))
+    sp = SamplingParams(max_tokens=4, temperature=0.0)
+
+    eng = LLMEngine(EngineConfig(**engine_kwargs()))
+    eng.load_lora("ad", p1)
+    eng.add_request("r1", prompt=PROMPT, sampling_params=sp, lora_name="ad")
+    while eng.has_unfinished():
+        eng.step()
+
+    eng.load_lora("ad", p2)  # same name, new path -> reload
+    h0 = eng.block_manager.prefix_hits
+    eng.add_request("r2", prompt=PROMPT, sampling_params=sp, lora_name="ad")
+    out2 = []
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished:
+                out2 = o.token_ids
+    assert eng.block_manager.prefix_hits == h0, (
+        "reloaded adapter reused stale KV from the previous weights"
+    )
+    # and matches a fresh engine loaded directly with v2
+    eng_fresh = LLMEngine(EngineConfig(**engine_kwargs()))
+    eng_fresh.load_lora("ad", p2)
+    eng_fresh.add_request("r", prompt=PROMPT, sampling_params=sp,
+                          lora_name="ad")
+    out_fresh = []
+    while eng_fresh.has_unfinished():
+        for o in eng_fresh.step():
+            if o.finished:
+                out_fresh = o.token_ids
+    assert out2 == out_fresh
