@@ -96,3 +96,26 @@ def test_tp2_matches_tp1():
     [o1] = e1.generate([prompt], sp)
     [o2] = e2.generate([prompt], sp)
     assert o1.token_ids == o2.token_ids
+
+
+def test_multihost_mesh_layout():
+    """(dp, tp) mesh construction for multi-host serving over DCN
+    (parallel/multihost.py): tp groups stay device-contiguous (ICI) and
+    the dp axis spans groups (DCN)."""
+    import jax
+
+    from production_stack_tpu.parallel.multihost import (
+        initialize,
+        make_multihost_mesh,
+    )
+
+    initialize()  # single-host no-op
+    mesh = make_multihost_mesh(tp=4, dp=2)
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
+    devs = jax.devices()
+    # tp groups are contiguous in enumeration order (slice-major)
+    assert list(mesh.devices[0]) == devs[:4]
+    assert list(mesh.devices[1]) == devs[4:8]
+    with pytest.raises(ValueError, match="device count"):
+        make_multihost_mesh(tp=3, dp=2)
