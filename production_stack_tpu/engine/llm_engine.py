@@ -433,6 +433,28 @@ class LLMEngine:
         if self.kv_transfer_client is not None:
             self.kv_transfer_client.close()
 
+    # -- embeddings (stateless one-shots, /v1/embeddings) -------------------
+    def embed_one(
+        self, text: str, lora_name: str | None = None
+    ) -> tuple[np.ndarray, int]:
+        """Embed one text -> (vector, token_count). One text per call so
+        the server can release the step-loop lock between items."""
+        ids = self.tokenizer.encode(text)
+        if not ids:
+            ids = [self.tokenizer.eos_token_id or 0]
+        lora_slot = 0
+        if lora_name is not None:
+            if self.runner.lora_manager is None:
+                raise ValueError(
+                    "embeddings for a LoRA adapter require --enable-lora"
+                )
+            lora_slot = self.runner.lora_manager.slot_of(lora_name)
+        return self.runner.embed(ids, lora_slot=lora_slot), len(ids)
+
+    def embed(self, texts: list[str],
+              lora_name: str | None = None) -> list[np.ndarray]:
+        return [self.embed_one(t, lora_name)[0] for t in texts]
+
     # -- stats for /metrics -------------------------------------------------
     def stats(self) -> EngineStatsSnapshot:
         return EngineStatsSnapshot(

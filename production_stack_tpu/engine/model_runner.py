@@ -62,6 +62,14 @@ class ModelRunner:
             sharding_rules.validate_tp(mc, self.mesh.size)
 
         if params is None:
+            # real checkpoints load from disk (local dir or HF cache);
+            # preset/debug names fall through to random init
+            from production_stack_tpu.models import weights as weight_loader
+
+            # the mesh-sharding elif below handles TP placement for
+            # loaded params, same as caller-supplied ones
+            params = weight_loader.maybe_load(config.model, mc, self.dtype)
+        if params is None:
             logger.info(
                 "initializing random %s params (%.2fB params, %s, tp=%d)",
                 mc.name, mc.num_params() / 1e9, config.dtype,
@@ -122,6 +130,18 @@ class ModelRunner:
                 "tensor_parallel_size > 1 (the kernel is not shard_mapped);"
                 " use 'auto' or 'xla'"
             )
+        if impl == "pallas" and jax.default_backend() == "tpu":
+            # compile-check the kernel on tiny shapes before committing:
+            # if this TPU generation/toolchain rejects it, serve on the
+            # XLA path instead of failing at the first request
+            try:
+                self._pallas_smoke_test(mc)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "pallas attention failed its smoke test (%s); "
+                    "falling back to the XLA gather path", e,
+                )
+                impl = "xla"
         self.attention_impl = impl
         logger.info("attention impl: %s", impl)
 
@@ -179,6 +199,23 @@ class ModelRunner:
             * max(1, cfg.max_num_seqs)
         )
         return int(min(num, max(cap, 2)))
+
+    def _pallas_smoke_test(self, mc: ModelConfig) -> None:
+        from production_stack_tpu.ops import pallas_attention
+
+        bs = self.block_size
+        d, nkv = mc.head_dim, mc.num_kv_heads
+        kc = jnp.zeros((1, 4 * bs, nkv, d), self.cache_dtype)
+        out = pallas_attention.paged_decode_attention(
+            jnp.zeros((1, mc.num_heads, d), self.dtype),
+            kc, kc,
+            jnp.int32(0),
+            jnp.zeros((1, 2), jnp.int32),
+            jnp.ones((1,), jnp.int32),
+            block_size=bs,
+            scale=self._scale,
+        )
+        jax.block_until_ready(out)
 
     # -- buckets ----------------------------------------------------------
     def _ctx_bucket(self, num_tokens: int) -> int:
@@ -414,6 +451,91 @@ class ModelRunner:
             **lora_kw,
         )
         return logits
+
+    # -- embeddings (stateless, /v1/embeddings) ----------------------------
+    def _build_embed(self, t_pad: int, c_pad: int):
+        """One chunked-prefill embed step over a caller-owned scratch KV
+        cache; returns (hidden-sum over valid chunk rows, kc, vc). Reuses
+        llama.forward (LoRA/bias/rope can never diverge from serving) with
+        the chunk x context score shape of the serving prefill path, so
+        long inputs never materialize t x t attention."""
+        mc = self.model_config
+        scale = self._scale
+
+        def step(params, kc, vc, toks, positions, total_len, valid_len,
+                 lora=None, lora_slots=None):
+            def attn(q, l, kcache, vcache):
+                return xla_attn.context_attention_prefill(
+                    q, kcache[l], vcache[l], positions, total_len, scale
+                )
+
+            # scratch cache row == absolute position; padded chunk rows
+            # carry position c_pad, landing in the extra trash row
+            h, kc, vc = llama.forward(
+                mc, params, toks, positions, kc, vc,
+                write_slots=positions,
+                attn_fn=attn,
+                logits_rows=jnp.arange(t_pad),
+                lora=lora, lora_slots=lora_slots,
+                return_hidden=True,
+            )  # (t_pad, hidden) f32
+            keep = (positions < valid_len)[:, None].astype(jnp.float32)
+            return jnp.sum(h * keep, axis=0), kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def embed(self, token_ids: list[int], lora_slot: int = 0) -> np.ndarray:
+        """Mean-pooled + L2-normalised final hidden state -> (hidden,) f32
+        (decoder-as-embedder, e5-mistral pattern). Inputs above
+        max_model_len are rejected, never silently truncated."""
+        t = len(token_ids)
+        if t > self.max_model_len:
+            raise ValueError(
+                f"embedding input has {t} tokens, exceeds max_model_len="
+                f"{self.max_model_len}"
+            )
+        mc = self.model_config
+        c_pad = self._ctx_bucket(t)
+        chunk = self.config.max_prefill_chunk
+        # c_pad + 1 rows: the last row is the trash slot padded chunk rows
+        # write into (they carry position c_pad)
+        kc = jnp.zeros(
+            (mc.num_layers, c_pad + 1, mc.num_kv_heads, mc.head_dim),
+            self.cache_dtype,
+        )
+        vc = jnp.zeros_like(kc)
+        if not hasattr(self, "_embed_fns"):
+            self._embed_fns: dict[tuple[int, int], object] = {}
+        lora_kw = {}
+        if self.lora_manager is not None:
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.int32(lora_slot),
+            }
+        pooled_sum = np.zeros((mc.hidden_size,), np.float64)
+        for start in range(0, t, chunk):
+            ids = token_ids[start: start + chunk]
+            t_pad = self._prefill_bucket(len(ids))
+            toks = np.zeros((t_pad,), np.int32)
+            toks[: len(ids)] = ids
+            # padded rows park at position c_pad (write redirected to 0,
+            # masked out of both attention and pooling)
+            positions = np.full((t_pad,), c_pad, np.int32)
+            positions[: len(ids)] = np.arange(start, start + len(ids))
+            key = (t_pad, c_pad)
+            if key not in self._embed_fns:
+                logger.info("compiling embed step t=%d ctx=%d", t_pad,
+                            c_pad)
+                self._embed_fns[key] = self._build_embed(t_pad, c_pad)
+            part, kc, vc = self._embed_fns[key](
+                self.params, kc, vc, jnp.asarray(toks),
+                jnp.asarray(positions),
+                jnp.int32(start + len(ids)), jnp.int32(t), **lora_kw,
+            )
+            pooled_sum += np.asarray(part, np.float64)
+        pooled = pooled_sum / max(t, 1)
+        norm = float(np.linalg.norm(pooled))
+        return (pooled / max(norm, 1e-12)).astype(np.float32)
 
     # -- cache import/export (KV offload + PD transfer tiers) -------------
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:

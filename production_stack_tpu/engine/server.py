@@ -60,6 +60,7 @@ class EngineServer:
         r.add_post("/v1/completions", self.handle_completions)
         r.add_post("/v1/chat/completions", self.handle_chat)
         r.add_get("/v1/models", self.handle_models)
+        r.add_post("/v1/embeddings", self.handle_embeddings)
         r.add_post("/tokenize", self.handle_tokenize)
         r.add_post("/detokenize", self.handle_detokenize)
         r.add_get("/health", self.handle_health)
@@ -307,6 +308,59 @@ class EngineServer:
             logger.info("client disconnected from %s", request_id)
         await resp.write_eof()
         return resp
+
+    # -- embeddings (reference engines serve /v1/embeddings too) -----------
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                proto.error_json("invalid JSON"), status=400
+            )
+        if err := self._check_model(body):
+            return err
+        model = body.get("model", self.model_name)
+        lora_name = model if model in self.lora_adapters else None
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not all(
+            isinstance(x, str) for x in inputs
+        ):
+            return web.json_response(
+                proto.error_json("'input' must be a string or list of "
+                                 "strings"), status=400
+            )
+
+        # one text per lock acquisition: an in-flight decode batch only
+        # ever waits for ONE embedding forward (or its first-bucket
+        # compile), never the whole list
+        def run_one(text: str):
+            with self.engine._lock:
+                return self.engine.engine.embed_one(text, lora_name)
+
+        loop = asyncio.get_running_loop()
+        data = []
+        n_tokens = 0
+        for i, text in enumerate(inputs):
+            try:
+                vec, count = await loop.run_in_executor(
+                    None, run_one, text
+                )
+            except ValueError as e:
+                return web.json_response(
+                    proto.error_json(str(e)), status=400
+                )
+            data.append({"object": "embedding", "index": i,
+                         "embedding": vec.tolist()})
+            n_tokens += count
+        return web.json_response({
+            "object": "list",
+            "model": model,
+            "data": data,
+            "usage": {"prompt_tokens": n_tokens,
+                      "total_tokens": n_tokens},
+        })
 
     # -- misc endpoints ----------------------------------------------------
     async def handle_models(self, request: web.Request) -> web.Response:

@@ -214,15 +214,22 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
 
 
 def get_model_config(model: str) -> ModelConfig:
-    """Resolve a model by preset name or local HF checkpoint directory."""
+    """Resolve a model: preset name, local HF checkpoint directory, or an
+    HF id already present in the local HF cache (zero-egress)."""
     if model in _PRESETS:
         return _PRESETS[model]
     if os.path.isdir(model) and os.path.exists(
         os.path.join(model, "config.json")
     ):
         return from_hf_config(model)
+    from production_stack_tpu.models.weights import resolve_model_dir
+
+    d = resolve_model_dir(model)
+    if d is not None:
+        return from_hf_config(d, name=model)
     raise ValueError(
-        f"unknown model {model!r}; known presets: {sorted(_PRESETS)}"
+        f"unknown model {model!r} (not a preset, local checkpoint dir, or "
+        f"cached HF id); known presets: {sorted(_PRESETS)}"
     )
 
 

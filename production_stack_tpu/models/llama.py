@@ -85,6 +85,7 @@ def forward(
     logits_rows: jax.Array,  # (r,) int32 rows of h to project to logits
     lora: dict | None = None,  # LoraManager.buffers: (L, S, in, r)/(L, S, r, out) + scaling (S,)
     lora_slots: jax.Array | None = None,  # (n,) int32 adapter slot per token
+    return_hidden: bool = False,  # final-norm hidden states instead of logits
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run the decoder over n tokens; returns (logits[r, V] fp32, k_cache, v_cache).
 
@@ -183,6 +184,8 @@ def forward(
 
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     h_sel = h[logits_rows]  # (r, hidden)
+    if return_hidden:
+        return h_sel.astype(jnp.float32), k_cache, v_cache
     lm_head = (
         params["embed"].T
         if cfg.tie_word_embeddings
@@ -197,3 +200,5 @@ def forward(
 # `scale` for attn_fn implementations; re-exported for the runner.
 def attention_scale(cfg: ModelConfig) -> float:
     return cfg.head_dim**-0.5
+
+

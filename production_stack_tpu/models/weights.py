@@ -1,0 +1,183 @@
+"""HF checkpoint loading: safetensors/torch shards -> stacked JAX params.
+
+The reference stack's engines load HF weights inside vLLM; our engine
+loads them directly. Layout conversion: HF Llama-family per-layer
+`{q,k,v,o}_proj.weight` are (out, in) torch matrices; our params store
+them transposed (in, out) and stacked over layers on axis 0 so the
+decoder runs as one lax.scan (models/llama.py init_params:36).
+
+Zero-egress friendly: only local paths (a model directory, or an HF id
+already present in the local HF cache) are accepted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.utils.log import init_logger
+
+logger = init_logger(__name__)
+
+
+def resolve_model_dir(model: str) -> str | None:
+    """Local directory containing config.json + weights for `model`."""
+    if os.path.isdir(model) and os.path.exists(
+        os.path.join(model, "config.json")
+    ):
+        return model
+    # HF cache layout: <cache>/models--org--name/snapshots/<rev>/
+    cache = os.environ.get(
+        "HF_HOME", os.path.expanduser("~/.cache/huggingface")
+    )
+    hub = os.path.join(cache, "hub", f"models--{model.replace('/', '--')}")
+    snaps = os.path.join(hub, "snapshots")
+    if os.path.isdir(snaps):
+        for rev in sorted(os.listdir(snaps), reverse=True):
+            d = os.path.join(snaps, rev)
+            if os.path.exists(os.path.join(d, "config.json")):
+                return d
+    return None
+
+
+def _iter_tensors(model_dir: str):
+    """Yield (name, np.ndarray) across all weight shards in the dir."""
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for fn in st_files:
+            with safe_open(os.path.join(model_dir, fn),
+                           framework="numpy") as f:
+                for key in f.keys():
+                    yield key, f.get_tensor(key)
+        return
+    bin_files = sorted(
+        f for f in os.listdir(model_dir)
+        if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if not bin_files:
+        raise FileNotFoundError(
+            f"no safetensors or pytorch_model*.bin in {model_dir}"
+        )
+    import torch
+
+    for fn in bin_files:
+        sd = torch.load(
+            os.path.join(model_dir, fn), map_location="cpu",
+            weights_only=True,
+        )
+        for key, t in sd.items():
+            yield key, t.to(torch.float32).numpy()
+
+
+def load_hf_weights(
+    cfg: ModelConfig, model_dir: str, dtype=jnp.bfloat16
+) -> dict:
+    """Read an HF Llama/Mistral/Qwen2 checkpoint into our param tree."""
+    L, h = cfg.num_layers, cfg.hidden_size
+    np_dtype = np.dtype(jnp.dtype(dtype).name) if jnp.dtype(
+        dtype) != jnp.bfloat16 else np.float32
+
+    def alloc(shape):
+        return np.zeros(shape, np_dtype)
+
+    layers = {
+        "attn_norm": alloc((L, h)),
+        "mlp_norm": alloc((L, h)),
+        "wq": alloc((L, h, cfg.q_size)),
+        "wk": alloc((L, h, cfg.kv_size)),
+        "wv": alloc((L, h, cfg.kv_size)),
+        "wo": alloc((L, cfg.q_size, h)),
+        "w_gate": alloc((L, h, cfg.intermediate_size)),
+        "w_up": alloc((L, h, cfg.intermediate_size)),
+        "w_down": alloc((L, cfg.intermediate_size, h)),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = alloc((L, cfg.q_size))
+        layers["bk"] = alloc((L, cfg.kv_size))
+        layers["bv"] = alloc((L, cfg.kv_size))
+    top: dict[str, np.ndarray] = {}
+
+    # HF key suffix -> (our key, transpose?)
+    per_layer = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "self_attn.q_proj.bias": ("bq", False),
+        "self_attn.k_proj.bias": ("bk", False),
+        "self_attn.v_proj.bias": ("bv", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+    n_loaded = 0
+    for name, tensor in _iter_tensors(model_dir):
+        key = name.removeprefix("model.")
+        if key == "embed_tokens.weight":
+            top["embed"] = np.asarray(tensor, np_dtype)
+            n_loaded += 1
+            continue
+        if key == "norm.weight":
+            top["final_norm"] = np.asarray(tensor, np_dtype)
+            n_loaded += 1
+            continue
+        if name == "lm_head.weight":
+            top["lm_head"] = np.asarray(tensor, np_dtype).T
+            n_loaded += 1
+            continue
+        if not key.startswith("layers."):
+            continue
+        _, idx, *rest = key.split(".", 2)
+        suffix = rest[0]
+        mapping = per_layer.get(suffix)
+        if mapping is None:
+            continue
+        ours, transpose = mapping
+        if ours not in layers:
+            continue  # bias tensors on a model without qkv_bias
+        arr = np.asarray(tensor, np.float32)
+        layers[ours][int(idx)] = (arr.T if transpose else arr).astype(
+            np_dtype
+        )
+        n_loaded += 1
+
+    if "embed" not in top:
+        raise ValueError(f"checkpoint at {model_dir} has no embed_tokens")
+    params = {
+        "embed": jnp.asarray(top["embed"], dtype),
+        "layers": {k: jnp.asarray(v, dtype) for k, v in layers.items()},
+        "final_norm": jnp.asarray(top["final_norm"], dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head" in top:
+            params["lm_head"] = jnp.asarray(top["lm_head"], dtype)
+        else:
+            logger.warning("no lm_head in checkpoint; tying to embeddings")
+            params["lm_head"] = params["embed"].T
+    logger.info(
+        "loaded %d tensors from %s (%s)", n_loaded, model_dir, cfg.name
+    )
+    return params
+
+
+def maybe_load(model: str, cfg: ModelConfig, dtype=jnp.bfloat16):
+    """Load weights if `model` resolves to a local checkpoint, else None
+    (the runner falls back to random init for presets/debug configs)."""
+    d = resolve_model_dir(model)
+    if d is None:
+        return None
+    try:
+        return load_hf_weights(cfg, d, dtype)
+    except (FileNotFoundError, ValueError) as e:
+        logger.warning("weight load from %s failed: %s", d, e)
+        return None
