@@ -158,6 +158,7 @@ class ModelRunner:
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
+        self._embed_fns: dict[tuple[int, int], object] = {}
 
         self.max_ctx_bucket = self._ctx_bucket(self.max_model_len)
 
@@ -504,8 +505,6 @@ class ModelRunner:
             self.cache_dtype,
         )
         vc = jnp.zeros_like(kc)
-        if not hasattr(self, "_embed_fns"):
-            self._embed_fns: dict[tuple[int, int], object] = {}
         lora_kw = {}
         if self.lora_manager is not None:
             lora_kw = {

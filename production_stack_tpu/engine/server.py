@@ -324,12 +324,14 @@ class EngineServer:
         inputs = body.get("input")
         if isinstance(inputs, str):
             inputs = [inputs]
-        if not isinstance(inputs, list) or not all(
-            isinstance(x, str) for x in inputs
+        if (
+            not isinstance(inputs, list)
+            or not inputs
+            or not all(isinstance(x, str) for x in inputs)
         ):
             return web.json_response(
-                proto.error_json("'input' must be a string or list of "
-                                 "strings"), status=400
+                proto.error_json("'input' must be a non-empty string or "
+                                 "list of strings"), status=400
             )
 
         # one text per lock acquisition: an in-flight decode batch only

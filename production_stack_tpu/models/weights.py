@@ -37,7 +37,16 @@ def resolve_model_dir(model: str) -> str | None:
     hub = os.path.join(cache, "hub", f"models--{model.replace('/', '--')}")
     snaps = os.path.join(hub, "snapshots")
     if os.path.isdir(snaps):
-        for rev in sorted(os.listdir(snaps), reverse=True):
+        # prefer the revision refs/main points at (the cache's notion of
+        # "current"); fall back to any snapshot with a config.json
+        ref_main = os.path.join(hub, "refs", "main")
+        if os.path.exists(ref_main):
+            with open(ref_main) as f:
+                rev = f.read().strip()
+            d = os.path.join(snaps, rev)
+            if os.path.exists(os.path.join(d, "config.json")):
+                return d
+        for rev in sorted(os.listdir(snaps)):
             d = os.path.join(snaps, rev)
             if os.path.exists(os.path.join(d, "config.json")):
                 return d
@@ -153,6 +162,16 @@ def load_hf_weights(
 
     if "embed" not in top:
         raise ValueError(f"checkpoint at {model_dir} has no embed_tokens")
+    # completeness: a partial shard set must never load as zero-filled
+    # layers (n per-layer tensors + embed + final_norm [+ lm_head])
+    expected = L * len(
+        [k for k, (ours, _) in per_layer.items() if ours in layers]
+    ) + 2 + (0 if cfg.tie_word_embeddings else 1)
+    if n_loaded < expected:
+        raise ValueError(
+            f"checkpoint at {model_dir} is incomplete: loaded {n_loaded} "
+            f"of {expected} expected tensors (missing shards?)"
+        )
     params = {
         "embed": jnp.asarray(top["embed"], dtype),
         "layers": {k: jnp.asarray(v, dtype) for k, v in layers.items()},
@@ -172,12 +191,12 @@ def load_hf_weights(
 
 def maybe_load(model: str, cfg: ModelConfig, dtype=jnp.bfloat16):
     """Load weights if `model` resolves to a local checkpoint, else None
-    (the runner falls back to random init for presets/debug configs)."""
+    (the runner falls back to random init for presets/debug names).
+
+    A checkpoint that RESOLVES but fails to load raises: silently serving
+    random weights under a real model's name would be far worse than
+    failing startup."""
     d = resolve_model_dir(model)
     if d is None:
         return None
-    try:
-        return load_hf_weights(cfg, d, dtype)
-    except (FileNotFoundError, ValueError) as e:
-        logger.warning("weight load from %s failed: %s", d, e)
-        return None
+    return load_hf_weights(cfg, d, dtype)
