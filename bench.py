@@ -27,6 +27,10 @@ os.environ.setdefault("PST_LOG_LEVEL", "WARNING")  # keep stdout JSON-only
 import numpy as np  # noqa: E402
 
 MODEL = os.environ.get("PST_BENCH_MODEL", "llama-3.2-1b")
+# north-star config is Llama-3-8B tp=8 on a v5e-8; the driver exposes one
+# chip, so the default serves the largest family member that fits it.
+# On a full slice: PST_BENCH_MODEL=llama-3-8b PST_BENCH_TP=8 python bench.py
+TP = int(os.environ.get("PST_BENCH_TP", "1"))
 NUM_USERS = int(os.environ.get("PST_BENCH_USERS", "16"))
 SYSTEM_PROMPT_TOK = int(os.environ.get("PST_BENCH_SYS_TOK", "512"))
 HISTORY_TOK = int(os.environ.get("PST_BENCH_HISTORY_TOK", "1024"))
@@ -34,8 +38,56 @@ ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
 HBM_BW_GBPS = float(os.environ.get("PST_BENCH_HBM_BW", "819"))  # v5e
 
 
+def _init_backend_or_die(timeout_s: float = 60.0, retries: int = 1):
+    """Initialize the jax backend with a hard deadline.
+
+    Round-1 lesson: `jax.devices()` can hang indefinitely when the TPU
+    backend is unreachable, leaving the driver to kill the process with no
+    diagnostic. Probe backend init in a daemon thread with a bounded wait;
+    on failure emit the ONE JSON line the driver records (with an `error`
+    field) and exit non-zero fast.
+    """
+    import threading
+
+    err = "unknown"
+    for attempt in range(retries + 1):
+        box: dict = {}
+
+        def probe() -> None:
+            try:
+                import jax
+
+                box["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 - report any init failure
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            err = f"jax backend init timed out after {timeout_s:.0f}s"
+        elif "error" in box:
+            err = box["error"]
+        else:
+            return box["devices"]
+        print(f"# backend init attempt {attempt + 1} failed: {err}",
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": "bench-aborted: jax backend unavailable",
+        "value": 0.0,
+        "unit": "gen_tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": err,
+    }))
+    sys.exit(1)
+
+
 def main() -> None:
+    devices = _init_backend_or_die()
     import jax
+
+    print(f"# backend: {devices[0].platform} x{len(devices)}",
+          file=sys.stderr)
 
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.llm_engine import LLMEngine
@@ -52,6 +104,7 @@ def main() -> None:
         max_model_len=4096,
         max_num_seqs=NUM_USERS,
         max_prefill_chunk=512,
+        tensor_parallel_size=TP,
         seed=0,
     )
     engine = LLMEngine(config)
@@ -112,20 +165,24 @@ def main() -> None:
     p50_ttft = float(np.percentile(ttft_arr, 50)) if len(ttft_arr) else -1
 
     model_bytes = mc.num_params() * 2  # bf16
-    roofline_tps = NUM_USERS * HBM_BW_GBPS * 1e9 / model_bytes
+    # each of the TP chips holds model_bytes/TP and streams it per decode
+    # step at HBM_BW, so the aggregate roofline scales with TP; reported
+    # value and vs_baseline are both per-chip so TP runs stay comparable
+    roofline_tps = NUM_USERS * TP * HBM_BW_GBPS * 1e9 / model_bytes
 
     result = {
         "metric": (
             f"multi-round-qa-style serving throughput "
             f"({mc.name}, {NUM_USERS} users, "
             f"{SYSTEM_PROMPT_TOK}+{HISTORY_TOK} tok prompts, "
-            f"{ANSWER_TOK} tok answers, 1 chip)"
+            f"{ANSWER_TOK} tok answers, {TP} chip(s))"
         ),
-        "value": round(overall_tps, 1),
+        "value": round(overall_tps / TP, 1),
         "unit": "gen_tokens/s/chip",
         "vs_baseline": round(decode_tps / roofline_tps, 3),
         "detail": {
-            "decode_tokens_per_s": round(decode_tps, 1),
+            "tensor_parallel_size": TP,
+            "decode_tokens_per_s_aggregate": round(decode_tps, 1),
             "p50_ttft_s": round(p50_ttft, 3),
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)

@@ -111,24 +111,15 @@ class ModelRunner:
         self.v_cache = zeros()
 
         self._scale = mc.head_dim**-0.5
-        # attention impl: pallas paged kernel on TPU (single-chip; the TP
-        # path stays on the XLA gather impl until the kernel is shard_mapped)
+        # attention impl: pallas paged kernel on TPU; under TP the kernel
+        # is shard_mapped over the kv-head-sharded cache (each chip's GQA
+        # groups are local, so the kernel body needs no collectives)
         impl = config.attention_impl
         if impl == "auto":
-            impl = (
-                "pallas"
-                if jax.default_backend() == "tpu" and self.mesh is None
-                else "xla"
-            )
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         if impl not in ("xla", "pallas"):
             raise ValueError(
                 f"attention_impl must be auto|xla|pallas, got {impl!r}"
-            )
-        if impl == "pallas" and self.mesh is not None:
-            raise ValueError(
-                "attention_impl='pallas' is not yet supported with "
-                "tensor_parallel_size > 1 (the kernel is not shard_mapped);"
-                " use 'auto' or 'xla'"
             )
         if impl == "pallas" and jax.default_backend() == "tpu":
             # compile-check the kernel on tiny shapes before committing:
@@ -207,15 +198,23 @@ class ModelRunner:
         bs = self.block_size
         d, nkv = mc.head_dim, mc.num_kv_heads
         kc = jnp.zeros((1, 4 * bs, nkv, d), self.cache_dtype)
-        out = pallas_attention.paged_decode_attention(
-            jnp.zeros((1, mc.num_heads, d), self.dtype),
-            kc, kc,
-            jnp.int32(0),
-            jnp.zeros((1, 2), jnp.int32),
-            jnp.ones((1,), jnp.int32),
-            block_size=bs,
-            scale=self._scale,
-        )
+        q = jnp.zeros((1, mc.num_heads, d), self.dtype)
+        tables = jnp.zeros((1, 2), jnp.int32)
+        lens = jnp.ones((1,), jnp.int32)
+        if self.mesh is not None:
+            # exercise the exact shard_map path serving will take
+            kc = jax.device_put(
+                kc, sharding_rules.cache_sharding(self.mesh)
+            )
+            out = pallas_attention.paged_decode_attention_tp(
+                q, kc, kc, jnp.int32(0), tables, lens,
+                mesh=self.mesh, block_size=bs, scale=self._scale,
+            )
+        else:
+            out = pallas_attention.paged_decode_attention(
+                q, kc, kc, jnp.int32(0), tables, lens,
+                block_size=bs, scale=self._scale,
+            )
         jax.block_until_ready(out)
 
     # -- buckets ----------------------------------------------------------
@@ -272,11 +271,19 @@ class ModelRunner:
 
             bs = self.block_size
             interpret = jax.default_backend() != "tpu"
+            mesh = self.mesh
 
             # `tables` = padded per-sequence block tables (b, pages)
             def attn(q, l, kc, vc, tables, context_lens):
                 # q: (b, nq, d); kc/vc: full (L, slots, nkv, d) — the
-                # kernel DMAs pages straight from HBM, no gathered copy
+                # kernel DMAs pages straight from HBM, no gathered copy.
+                # Under TP the kernel is shard_mapped: each chip runs it
+                # on its local kv-head shard (GQA groups are chip-local)
+                if mesh is not None:
+                    return pallas_attention.paged_decode_attention_tp(
+                        q, kc, vc, l, tables, context_lens, mesh=mesh,
+                        block_size=bs, scale=scale, interpret=interpret,
+                    )
                 return pallas_attention.paged_decode_attention(
                     q, kc, vc, l, tables, context_lens,
                     block_size=bs, scale=scale, interpret=interpret,

@@ -129,6 +129,63 @@ def _decode_kernel(
     out_ref[0] = out.reshape(nq, d).astype(out_ref.dtype)
 
 
+def paged_decode_attention_tp(
+    q: jax.Array,             # (b, nq, d) — heads sharded over tp
+    k_cache: jax.Array,       # (L, num_slots, nkv, d) — kv heads sharded
+    v_cache: jax.Array,
+    layer: jax.Array,
+    block_tables: jax.Array,  # (b, P) replicated
+    context_lens: jax.Array,  # (b,) replicated
+    *,
+    mesh: jax.sharding.Mesh,
+    block_size: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel paged decode attention via shard_map.
+
+    The KV cache is sharded over the kv-head axis and q heads are split
+    congruently (parallel/sharding.py), so each chip's GQA groups are fully
+    local: the kernel body needs zero cross-chip communication — the psum
+    stays where GSPMD already puts it, after the wo row-parallel projection.
+    shard_map hands each chip its (b, nq/tp, d) query slice and
+    (L, slots, nkv/tp, d) cache shard; block tables and context lens ride
+    replicated. check_vma=False because pallas_call does not participate in
+    varying-axes inference.
+    """
+    # resolve the tensor-parallel axis by name: on the multihost (dp, tp)
+    # mesh, axis_names[0] would be the DP axis and silently reshard the
+    # cache; only a single-axis mesh may fall back to its sole axis
+    if "tp" in mesh.axis_names:
+        tp = "tp"
+    elif len(mesh.axis_names) == 1:
+        tp = mesh.axis_names[0]
+    else:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no 'tp' axis; paged attention "
+            "needs the kv-head-sharded tensor-parallel axis"
+        )
+    P = jax.sharding.PartitionSpec
+    body = functools.partial(
+        paged_decode_attention,
+        block_size=block_size, scale=scale, interpret=interpret,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(None, tp, None),
+            P(None, None, tp, None),
+            P(None, None, tp, None),
+            P(),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, tp, None),
+        check_vma=False,
+    )(q, k_cache, v_cache, layer, block_tables, context_lens)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_size", "scale", "interpret"),

@@ -121,3 +121,32 @@ def test_engine_decode_parity_pallas_vs_xla():
     assert eng_p.runner.attention_impl == "pallas"
     out_p = [o.token_ids for o in eng_p.generate(prompts, sp)]
     assert out_p == out_x
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_tp_shard_map_parity(seed):
+    """The shard_mapped TP kernel (8-device CPU mesh, kv heads sharded)
+    must match the single-device XLA gather reference exactly — the
+    config the north-star benchmark serves (Llama-3-8B tp=8)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from production_stack_tpu.ops.pallas_attention import (
+        paged_decode_attention_tp,
+    )
+    from production_stack_tpu.parallel.sharding import make_mesh
+
+    # nkv=8 so the kv-head axis splits 1-per-chip at tp=8 (hardest case)
+    q, kc, vc, bt, ctx = make_case(seed, b=4, nkv=8, g=2, d=128)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    mesh = make_mesh(8)
+    kc_sh = jax.device_put(kc, NamedSharding(mesh, P(None, None, "tp", None)))
+    vc_sh = jax.device_put(vc, NamedSharding(mesh, P(None, None, "tp", None)))
+    q_sh = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
+    out_p = paged_decode_attention_tp(
+        q_sh, kc_sh, vc_sh, jnp.int32(1), bt, ctx,
+        mesh=mesh, block_size=8, scale=scale, interpret=True,
+    )
+    out_r = reference(q, kc, vc, 1, bt, ctx, 8, scale)
+    np.testing.assert_allclose(
+        np.asarray(out_p), np.asarray(out_r), rtol=2e-5, atol=2e-5
+    )

@@ -119,3 +119,35 @@ def test_multihost_mesh_layout():
     assert list(mesh.devices[1]) == devs[4:8]
     with pytest.raises(ValueError, match="device count"):
         make_multihost_mesh(tp=3, dp=2)
+
+
+def test_tp8_pallas_matches_dense_reference():
+    """attention_impl='pallas' at tp=8 (shard_mapped kernel, interpret
+    mode on the CPU mesh) must produce the same greedy tokens as the
+    dense single-device reference — the north-star serving config."""
+    engine = LLMEngine(
+        EngineConfig(
+            model=TP_TEST_CFG.name,
+            tokenizer="byte",
+            dtype="float32",
+            cache_dtype="float32",
+            block_size=4,
+            num_kv_blocks=64,
+            max_num_seqs=2,
+            max_prefill_chunk=16,
+            tensor_parallel_size=8,
+            attention_impl="pallas",
+            seed=0,
+        )
+    )
+    assert engine.runner.attention_impl == "pallas"
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 512, size=n).tolist() for n in (9, 21)]
+    outs = engine.generate(
+        prompts,
+        SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True),
+    )
+    host_params = jax.tree.map(np.asarray, engine.runner.params)
+    for p, o in zip(prompts, outs):
+        expected = dense_greedy_generate(TP_TEST_CFG, host_params, p, 6)
+        assert o.token_ids == expected
