@@ -65,8 +65,12 @@ def _init_backend_or_die(timeout_s: float = 60.0, retries: int = 1):
         t.start()
         t.join(timeout_s)
         if t.is_alive():
+            # a hung probe still holds the import/backend-init lock, so a
+            # retry would block on the same state — abort immediately
             err = f"jax backend init timed out after {timeout_s:.0f}s"
-        elif "error" in box:
+            print(f"# backend init: {err}", file=sys.stderr)
+            break
+        if "error" in box:
             err = box["error"]
         else:
             return box["devices"]
@@ -145,6 +149,8 @@ def main() -> None:
 
     gen_tokens = 0
     decode_time = 0.0
+    last_token_t: dict[str, float] = {}
+    itls: list[float] = []  # inter-token gaps across all streams
     while engine.has_unfinished():
         st = time.time()
         outs = engine.step()
@@ -153,6 +159,11 @@ def main() -> None:
         for out in outs:
             if out.request_id not in ttfts and out.token_ids:
                 ttfts[out.request_id] = now - submit_t[out.request_id]
+            if out.new_token_ids:
+                prev = last_token_t.get(out.request_id)
+                if prev is not None:
+                    itls.append(now - prev)
+                last_token_t[out.request_id] = now
         if engine.last_step_kind == "decode":
             gen_tokens += sum(len(o.new_token_ids) for o in outs)
             decode_time += dt
@@ -163,6 +174,16 @@ def main() -> None:
     overall_tps = all_gen / total_time
     ttft_arr = np.asarray(sorted(ttfts.values()))
     p50_ttft = float(np.percentile(ttft_arr, 50)) if len(ttft_arr) else -1
+    itl_arr = np.asarray(itls)
+    itl_p = (
+        {
+            "p50_itl_s": round(float(np.percentile(itl_arr, 50)), 4),
+            "p90_itl_s": round(float(np.percentile(itl_arr, 90)), 4),
+            "p99_itl_s": round(float(np.percentile(itl_arr, 99)), 4),
+        }
+        if len(itl_arr)
+        else {}
+    )
 
     model_bytes = mc.num_params() * 2  # bf16
     # each of the TP chips holds model_bytes/TP and streams it per decode
@@ -192,6 +213,7 @@ def main() -> None:
             "prefix_cache_hit_rate": round(
                 engine.stats().prefix_cache_hit_rate, 3
             ),
+            **itl_p,
         },
     }
     print(json.dumps(result))

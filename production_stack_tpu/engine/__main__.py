@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     p.add_argument("--no-enable-chunked-prefill",
                    dest="enable_chunked_prefill", action="store_false")
+    p.add_argument("--decode-interleave", type=int, default=1,
+                   help="max consecutive prefill chunks while decodes "
+                        "wait (0 = prefill always wins)")
     p.add_argument("--enable-prefix-caching", action="store_true",
                    default=True)
     p.add_argument("--no-enable-prefix-caching",
@@ -88,6 +91,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_num_seqs=args.max_num_seqs,
         max_prefill_chunk=args.max_prefill_chunk,
         enable_chunked_prefill=args.enable_chunked_prefill,
+        decode_interleave=args.decode_interleave,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
         served_model_name=args.served_model_name,

@@ -26,6 +26,9 @@ class EngineConfig:
     max_prefill_chunk: int = 512
     enable_chunked_prefill: bool = True
     enable_prefix_caching: bool = True
+    # max consecutive prefill chunks while decodes wait (bounded ITL);
+    # 0 = prefill always wins (round-1 behavior)
+    decode_interleave: int = 1
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1

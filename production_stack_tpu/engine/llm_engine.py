@@ -52,6 +52,7 @@ class LLMEngine:
                 max_prefill_chunk=config.max_prefill_chunk,
                 max_model_len=config.resolved_max_model_len(),
                 enable_chunked_prefill=config.enable_chunked_prefill,
+                decode_interleave=config.decode_interleave,
             ),
             self.block_manager,
         )

@@ -201,8 +201,10 @@ class ModelRunner:
         q = jnp.zeros((1, mc.num_heads, d), self.dtype)
         tables = jnp.zeros((1, 2), jnp.int32)
         lens = jnp.ones((1,), jnp.int32)
+        qp = jnp.zeros((8, mc.num_heads, d), self.dtype)
+        table1 = jnp.zeros((2,), jnp.int32)
         if self.mesh is not None:
-            # exercise the exact shard_map path serving will take
+            # exercise the exact shard_map paths serving will take
             kc = jax.device_put(
                 kc, sharding_rules.cache_sharding(self.mesh)
             )
@@ -210,12 +212,20 @@ class ModelRunner:
                 q, kc, kc, jnp.int32(0), tables, lens,
                 mesh=self.mesh, block_size=bs, scale=self._scale,
             )
+            out2 = pallas_attention.paged_prefill_attention_tp(
+                qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
+                mesh=self.mesh, block_size=bs, scale=self._scale,
+            )
         else:
             out = pallas_attention.paged_decode_attention(
                 q, kc, kc, jnp.int32(0), tables, lens,
                 block_size=bs, scale=self._scale,
             )
-        jax.block_until_ready(out)
+            out2 = pallas_attention.paged_prefill_attention(
+                qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
+                block_size=bs, scale=self._scale,
+            )
+        jax.block_until_ready((out, out2))
 
     # -- buckets ----------------------------------------------------------
     def _ctx_bucket(self, num_tokens: int) -> int:
@@ -236,12 +246,37 @@ class ModelRunner:
         mc = self.model_config
         scale = self._scale
 
-        def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
-            k_ctx = kc[l, gather_slots]  # (c, nkv, d)
-            v_ctx = vc[l, gather_slots]
-            return xla_attn.context_attention_prefill(
-                q, k_ctx, v_ctx, q_positions, total_len, scale
-            )
+        if self.attention_impl == "pallas":
+            from production_stack_tpu.ops import pallas_attention
+
+            bs = self.block_size
+            interpret = jax.default_backend() != "tpu"
+            mesh = self.mesh
+
+            # `gather_slots` = this sequence's padded block table (P,);
+            # the kernel streams context pages from HBM once per chunk —
+            # the per-layer (ctx, nkv, d) gathered copy is never built.
+            # q row 0 is always a real token, so positions[0] is the
+            # chunk's absolute start position.
+            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
+                if mesh is not None:
+                    return pallas_attention.paged_prefill_attention_tp(
+                        q, kc, vc, l, gather_slots, q_positions[0],
+                        mesh=mesh, block_size=bs, scale=scale,
+                        interpret=interpret,
+                    )
+                return pallas_attention.paged_prefill_attention(
+                    q, kc, vc, l, gather_slots, q_positions[0],
+                    block_size=bs, scale=scale, interpret=interpret,
+                )
+        else:
+
+            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
+                k_ctx = kc[l, gather_slots]  # (c, nkv, d)
+                v_ctx = vc[l, gather_slots]
+                return xla_attn.context_attention_prefill(
+                    q, k_ctx, v_ctx, q_positions, total_len, scale
+                )
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, last_row, lora=None,
@@ -330,14 +365,23 @@ class ModelRunner:
         slots[positions < 0] = 0
         return slots
 
+    def _padded_block_table(
+        self, block_table: list[int], n_pages: int
+    ) -> np.ndarray:
+        """Block table padded/truncated to n_pages; padding pages point at
+        the null block 0 (shared convention of both attention impls)."""
+        bt = np.zeros((n_pages,), dtype=np.int32)
+        use = min(len(block_table), n_pages)
+        if use:
+            bt[:use] = np.asarray(block_table[:use], dtype=np.int32)
+        return bt
+
     def _gather_slots_for_table(
         self, block_table: list[int], c_pad: int
     ) -> np.ndarray:
-        nb = c_pad // self.block_size
-        bt = np.zeros((nb,), dtype=np.int32)
-        use = min(len(block_table), nb)
-        if use:
-            bt[:use] = np.asarray(block_table[:use], dtype=np.int32)
+        bt = self._padded_block_table(
+            block_table, c_pad // self.block_size
+        )
         offs = np.arange(self.block_size, dtype=np.int32)
         return (bt[:, None] * self.block_size + offs).reshape(-1)
 
@@ -363,7 +407,15 @@ class ModelRunner:
         write_slots = self._slots_for_positions(block_table, positions)
         # padded rows: position -1 -> rope of position 0, write to trash
         positions_dev = np.where(positions < 0, 0, positions).astype(np.int32)
-        gather_slots = self._gather_slots_for_table(block_table, c_pad)
+        if self.attention_impl == "pallas":
+            # pallas path takes the padded block table (pages); padding
+            # pages hold positions beyond every real query's causal
+            # horizon, so they are masked out
+            gather_slots = self._padded_block_table(
+                block_table, c_pad // self.block_size
+            )
+        else:
+            gather_slots = self._gather_slots_for_table(block_table, c_pad)
 
         key = (t_pad, c_pad)
         if key not in self._prefill_fns:
@@ -420,12 +472,16 @@ class ModelRunner:
             )[0]
         if self.attention_impl == "pallas":
             # pallas path takes padded block tables (pages), not per-token
-            # gather slots; padding pages point at the null block 0
+            # gather slots
             n_pages = c_pad // self.block_size
-            tables = np.zeros((b, n_pages), dtype=np.int32)
-            for i in range(b_actual):
-                bt = np.asarray(block_tables[i], dtype=np.int32)[:n_pages]
-                tables[i, : len(bt)] = bt
+            tables = np.stack(
+                [
+                    self._padded_block_table(
+                        block_tables[i] if i < b_actual else [], n_pages
+                    )
+                    for i in range(b)
+                ]
+            )
         else:
             tables = np.zeros((b, c_pad), dtype=np.int32)
             for i in range(b_actual):

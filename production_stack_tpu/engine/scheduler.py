@@ -12,6 +12,14 @@ budget per chunk; decode packs all running sequences into one batch.
 Queues: waiting (FIFO admission) -> running; preemption-by-recomputation
 pushes the youngest running sequence back to the front of waiting when KV
 blocks run out (vLLM v0 semantics).
+
+Prefill/decode interleaving: a long multi-chunk prefill must not starve
+running decodes (the reference stack's engines mix chunked prefill with
+decode in one step — reference: helm/templates/deployment-vllm-multi.yaml:140-146;
+our static-shape design alternates instead). `decode_interleave = K` caps
+consecutive prefill chunks at K while any decode-ready sequence exists, so
+the inter-token gap of a running stream is bounded by K prefill chunks +
+one decode step regardless of how many new users are admitted.
 """
 
 from __future__ import annotations
@@ -68,6 +76,9 @@ class SchedulerConfig:
     max_prefill_chunk: int = 512
     max_model_len: int = 8192
     enable_chunked_prefill: bool = True
+    # max consecutive prefill chunks while decode-ready sequences wait;
+    # 0 disables interleaving (prefill runs to completion first)
+    decode_interleave: int = 1
 
 
 class Scheduler:
@@ -79,6 +90,7 @@ class Scheduler:
         # optional hook (LLMEngine._restore_from_offload): pull offloaded
         # KV blocks back into HBM before prompt allocation
         self.kv_restore = None
+        self._prefill_streak = 0  # consecutive prefill steps scheduled
 
     # -- queue introspection (feeds the vllm:num_requests_* gauges) -------
     @property
@@ -161,24 +173,42 @@ class Scheduler:
             self.waiting.popleft()
             self.running.append(seq)
 
-        # 2) prefill priority: oldest running sequence with prompt left
-        for seq in self.running:
-            if not seq.prefill_done:
-                chunk_len = seq.num_uncomputed_prompt_tokens
-                if self.config.enable_chunked_prefill:
-                    chunk_len = min(chunk_len, self.config.max_prefill_chunk)
-                out.prefill = PrefillWork(
-                    seq=seq,
-                    chunk_start=seq.num_computed_tokens,
-                    chunk_len=chunk_len,
-                )
-                return out
+        # 2) prefill priority: oldest running sequence with prompt left —
+        # UNLESS decode-ready sequences have already waited through
+        # `decode_interleave` consecutive prefill chunks (bounded ITL)
+        has_decode_ready = any(
+            s.prefill_done and not s.finished for s in self.running
+        )
+        decode_starved = (
+            self.config.decode_interleave > 0
+            and has_decode_ready
+            and self._prefill_streak >= self.config.decode_interleave
+        )
+        if not decode_starved:
+            for seq in self.running:
+                if not seq.prefill_done:
+                    chunk_len = seq.num_uncomputed_prompt_tokens
+                    if self.config.enable_chunked_prefill:
+                        chunk_len = min(
+                            chunk_len, self.config.max_prefill_chunk
+                        )
+                    out.prefill = PrefillWork(
+                        seq=seq,
+                        chunk_start=seq.num_computed_tokens,
+                        chunk_len=chunk_len,
+                    )
+                    self._prefill_streak += 1
+                    return out
+        self._prefill_streak = 0
 
-        # 3) otherwise decode every running sequence (ensure slot capacity)
+        # 3) otherwise decode every decode-ready running sequence (mid-
+        # prefill sequences sit out the interleaved decode steps)
         decode_seqs: list[Sequence] = []
         for seq in list(self.running):
             if seq.finished or seq not in self.running:
                 # may have been preempted while scheduling an earlier seq
+                continue
+            if not seq.prefill_done:
                 continue
             while not self.block_manager.ensure_capacity(
                 seq.num_tokens, seq.block_table

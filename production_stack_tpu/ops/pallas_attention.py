@@ -129,6 +129,259 @@ def _decode_kernel(
     out_ref[0] = out.reshape(nq, d).astype(out_ref.dtype)
 
 
+def _prefill_kernel(
+    # scalar prefetch
+    meta_ref,           # (2,) int32: [layer, q_start]
+    block_table_ref,    # (P,) int32 — this sequence's pages
+    # array inputs
+    q_ref,              # (Tq, nq, d) VMEM — this program's query tile
+    k_cache_ref,        # (L, slots, nkv, d) ANY/HBM
+    v_cache_ref,
+    # outputs
+    out_ref,            # (Tq, nq, d) VMEM
+    # scratch
+    k_buf,              # (2, bs, nkv, d) VMEM
+    v_buf,
+    sem,                # DMA sems (2, 2)
+    *,
+    block_size: int,
+    num_pages: int,
+    scale: float,
+):
+    """Ragged chunked-prefill attention for ONE sequence over the paged
+    HBM cache (SURVEY §7 hard-part #1, prefill half).
+
+    Kernel contract: query rows are CONTIGUOUS absolute positions
+    q_start + row (the model runner always prefills a contiguous chunk;
+    padded tail rows simply read garbage that the runner discards, exactly
+    like the XLA path's padded rows). Causality is per-element:
+    key_pos <= q_pos, evaluated against the online softmax, so one pass
+    over the context pages serves every query row — the per-layer
+    (ctx, nkv, d) gathered copy the XLA path materialises is never built
+    and each KV byte streams from HBM exactly once per chunk.
+    """
+    i = pl.program_id(0)
+    layer = meta_ref[0]
+    q_start = meta_ref[1]
+    tq, nq, d = q_ref.shape
+    nkv = k_buf.shape[2]
+    g = nq // nkv
+    bs = block_size
+
+    tile_base = q_start + i * tq
+    # pages holding positions [0, tile_base + tq): later tiles see more
+    n_used = jnp.minimum(
+        (tile_base + tq + bs - 1) // bs, jnp.int32(num_pages)
+    )
+
+    def page_dma(slot, page_idx, buf, cache_ref, which):
+        row0 = block_table_ref[page_idx] * bs
+        return pltpu.make_async_copy(
+            cache_ref.at[layer, pl.ds(row0, bs)],
+            buf.at[slot],
+            sem.at[slot, which],
+        )
+
+    page_dma(0, 0, k_buf, k_cache_ref, 0).start()
+    page_dma(0, 0, v_buf, v_cache_ref, 1).start()
+
+    # (Tq, nq, d) -> (nkv, Tq*g, d): batch kv heads on the MXU; row r of
+    # the fused axis belongs to query row r // g
+    q = q_ref[...].astype(jnp.float32)
+    q = (
+        q.reshape(tq, nkv, g, d)
+        .transpose(1, 0, 2, 3)
+        .reshape(nkv, tq * g, d)
+        * scale
+    )
+    q_pos = tile_base + (
+        jax.lax.broadcasted_iota(jnp.int32, (1, tq * g, 1), 1) // g
+    )
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_used)
+        def _():
+            page_dma(nxt, j + 1, k_buf, k_cache_ref, 0).start()
+            page_dma(nxt, j + 1, v_buf, v_cache_ref, 1).start()
+
+        page_dma(slot, j, k_buf, k_cache_ref, 0).wait()
+        page_dma(slot, j, v_buf, v_cache_ref, 1).wait()
+
+        k = k_buf[slot].astype(jnp.float32)  # (bs, nkv, d)
+        v = v_buf[slot].astype(jnp.float32)
+        # (nkv, Tq*g, d) x (bs, nkv, d) -> (nkv, Tq*g, bs)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, bs), 2
+        )
+        s = jnp.where(k_pos <= q_pos, s, MASK_VALUE)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * corr + pv
+
+    m0 = jnp.full((nkv, tq * g, 1), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((nkv, tq * g, 1), jnp.float32)
+    acc0 = jnp.zeros((nkv, tq * g, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    out = (
+        out.reshape(nkv, tq, g, d)
+        .transpose(1, 0, 2, 3)
+        .reshape(tq, nq, d)
+    )
+    out_ref[...] = out.astype(out_ref.dtype)
+
+
+def _prefill_q_tile(t: int, nq: int, d: int) -> int:
+    """Largest pow2 query tile whose f32 q + accumulator fit a ~4 MiB VMEM
+    budget each (v5e VMEM is 128 MiB but leave room for double-buffered KV
+    pages, the output tile, and Mosaic's own spills). One tile per chunk
+    (the common case) means the context streams from HBM exactly once."""
+    budget = 4 * 2**20
+    per_row = nq * d * 4
+    tile = 1 << max(3, (budget // per_row).bit_length() - 1)
+    while t % tile:
+        tile //= 2
+    return max(1, min(tile, t))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "scale", "interpret"),
+)
+def paged_prefill_attention(
+    q: jax.Array,            # (t, nq, d) — one chunk, contiguous positions
+    k_cache: jax.Array,      # (L, num_slots, nkv, d)
+    v_cache: jax.Array,
+    layer: jax.Array,        # scalar int32
+    block_table: jax.Array,  # (P,) int32 — pages of THIS sequence
+    q_start: jax.Array,      # scalar int32 — absolute position of q row 0
+    *,
+    block_size: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Chunked-prefill paged attention for one sequence. -> (t, nq, d)."""
+    t, nq, d = q.shape
+    nkv = k_cache.shape[2]
+    num_pages = block_table.shape[0]
+    tq = _prefill_q_tile(t, nq, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(t // tq,),
+        in_specs=[
+            pl.BlockSpec(
+                (tq, nq, d), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (tq, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, nkv, d), k_cache.dtype),
+            pltpu.VMEM((2, block_size, nkv, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        block_size=block_size,
+        num_pages=num_pages,
+        scale=scale,
+    )
+    meta = jnp.stack(
+        [jnp.asarray(layer, jnp.int32), jnp.asarray(q_start, jnp.int32)]
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, nq, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(
+        meta,
+        block_table.astype(jnp.int32),
+        q,
+        k_cache,
+        v_cache,
+    )
+
+
+def paged_prefill_attention_tp(
+    q: jax.Array,            # (t, nq, d) — heads sharded over tp
+    k_cache: jax.Array,      # (L, num_slots, nkv, d) — kv heads sharded
+    v_cache: jax.Array,
+    layer: jax.Array,
+    block_table: jax.Array,  # (P,) replicated
+    q_start: jax.Array,      # scalar replicated
+    *,
+    mesh: jax.sharding.Mesh,
+    block_size: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel chunked-prefill paged attention via shard_map (same
+    head-congruence argument as paged_decode_attention_tp: GQA groups are
+    chip-local, so the kernel body needs no collectives)."""
+    tp = _resolve_tp_axis(mesh)
+    P = jax.sharding.PartitionSpec
+    body = functools.partial(
+        paged_prefill_attention,
+        block_size=block_size, scale=scale, interpret=interpret,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(None, tp, None),
+            P(None, None, tp, None),
+            P(None, None, tp, None),
+            P(),
+            P(None),
+            P(),
+        ),
+        out_specs=P(None, tp, None),
+        check_vma=False,
+    )(q, k_cache, v_cache, layer, block_table, q_start)
+
+
+def _resolve_tp_axis(mesh: jax.sharding.Mesh) -> str:
+    """Resolve the tensor-parallel axis by name: on the multihost (dp, tp)
+    mesh, axis_names[0] would be the DP axis and silently reshard the
+    cache; only a single-axis mesh may fall back to its sole axis."""
+    if "tp" in mesh.axis_names:
+        return "tp"
+    if len(mesh.axis_names) == 1:
+        return mesh.axis_names[0]
+    raise ValueError(
+        f"mesh {mesh.axis_names} has no 'tp' axis; paged attention "
+        "needs the kv-head-sharded tensor-parallel axis"
+    )
+
+
 def paged_decode_attention_tp(
     q: jax.Array,             # (b, nq, d) — heads sharded over tp
     k_cache: jax.Array,       # (L, num_slots, nkv, d) — kv heads sharded
@@ -153,18 +406,7 @@ def paged_decode_attention_tp(
     replicated. check_vma=False because pallas_call does not participate in
     varying-axes inference.
     """
-    # resolve the tensor-parallel axis by name: on the multihost (dp, tp)
-    # mesh, axis_names[0] would be the DP axis and silently reshard the
-    # cache; only a single-axis mesh may fall back to its sole axis
-    if "tp" in mesh.axis_names:
-        tp = "tp"
-    elif len(mesh.axis_names) == 1:
-        tp = mesh.axis_names[0]
-    else:
-        raise ValueError(
-            f"mesh {mesh.axis_names} has no 'tp' axis; paged attention "
-            "needs the kv-head-sharded tensor-parallel axis"
-        )
+    tp = _resolve_tp_axis(mesh)
     P = jax.sharding.PartitionSpec
     body = functools.partial(
         paged_decode_attention,

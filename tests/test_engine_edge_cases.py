@@ -188,3 +188,42 @@ def test_embeddings_chunked_and_rejects_overlength():
     eng = build(64)
     with pytest.raises(ValueError, match="exceeds max_model_len"):
         eng.embed(["x" * 100])  # 101 tokens > max_model_len=64
+
+
+def test_decode_not_starved_by_long_prefill():
+    """A streaming decode's inter-token gap stays bounded while a long
+    multi-chunk prompt prefills (decode_interleave=1: at most one prefill
+    chunk between decode steps)."""
+    engine = tiny_engine(
+        num_kv_blocks=128, max_model_len=512, max_prefill_chunk=16
+    )
+    sp = SamplingParams(max_tokens=64, temperature=0.0, ignore_eos=True)
+    engine.add_request("stream", prompt_token_ids=[1, 2, 3],
+                       sampling_params=sp)
+    # let the short request finish prefill and emit its first token
+    while not engine._seqs["stream"].prefill_done:
+        engine.step()
+
+    # long prompt: 160 tokens = 10 chunks of 16
+    engine.add_request(
+        "bulk", prompt_token_ids=list(range(160)),
+        sampling_params=SamplingParams(max_tokens=2, temperature=0.0,
+                                       ignore_eos=True),
+    )
+    gaps, since_last = [], 0
+    for _ in range(40):
+        outs = engine.step()
+        stream_grew = any(
+            o.request_id == "stream" and o.new_token_ids for o in outs
+        )
+        if stream_grew:
+            gaps.append(since_last)
+            since_last = 0
+        else:
+            since_last += 1
+        if engine._seqs.get("bulk") is None:
+            break
+    # every gap bounded: at most 1 prefill step between stream tokens
+    assert gaps and max(gaps) <= 1, gaps
+    # and the bulk prompt finished (prefill made progress too)
+    assert engine._seqs.get("bulk") is None

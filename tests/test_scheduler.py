@@ -60,11 +60,18 @@ def test_decode_batches_all_running():
     seqs = [seq(f"s{i}", 4) for i in range(3)]
     for s in seqs:
         sched.add_seq(s)
-    # drain all prefills
-    for _ in range(3):
+    # drain all prefills (decode steps interleave once s0 is ready)
+    for _ in range(8):
         out = sched.schedule()
+        if out.prefill is None:
+            for s in out.decode.seqs:
+                s.num_computed_tokens = s.num_tokens
+                s.append_token(1)
+            continue
         run_prefill(sched, out.prefill)
         out.prefill.seq.append_token(1)
+        if all(s.prefill_done for s in seqs):
+            break
     out = sched.schedule()
     assert out.decode is not None
     assert set(s.request_id for s in out.decode.seqs) == {"s0", "s1", "s2"}
@@ -118,3 +125,71 @@ def test_abort_waiting_and_running():
     assert sched.abort("b")
     assert sched.num_running == 0
     assert bm.num_free_blocks == 63  # all returned
+
+
+# ---- prefill/decode interleaving (bounded ITL) ----------------------------
+
+def test_decode_interleave_bounds_starvation():
+    """While a long multi-chunk prefill runs, a decode-ready sequence must
+    get a decode step at least every `decode_interleave` prefill chunks."""
+    sched, _ = make_sched(max_prefill_chunk=8, max_model_len=256)
+    a = seq("a", 4)
+    sched.add_seq(a)
+    out = sched.schedule()
+    assert out.prefill is not None and out.prefill.seq is a
+    run_prefill(sched, out.prefill)
+    a.append_token(1)  # a is now decode-ready
+
+    b = seq("b", 64)  # 8 chunks of prefill
+    sched.add_seq(b)
+    kinds = []
+    for _ in range(20):
+        out = sched.schedule()
+        if out.prefill is not None:
+            kinds.append("p")
+            run_prefill(sched, out.prefill)
+            if out.prefill.is_last_chunk:
+                out.prefill.seq.append_token(1)
+        elif out.decode is not None:
+            kinds.append("d")
+            for s in out.decode.seqs:
+                s.num_computed_tokens = s.num_tokens
+                s.append_token(1)
+        if b.prefill_done:
+            break
+    # no two consecutive prefill chunks without a decode in between
+    assert "pp" not in "".join(kinds), kinds
+    # and prefill still progresses (not starved either)
+    assert kinds.count("p") == 8
+
+
+def test_decode_interleave_zero_restores_prefill_priority():
+    sched, _ = make_sched(max_prefill_chunk=8, max_model_len=256)
+    sched.config.decode_interleave = 0
+    a = seq("a", 4)
+    sched.add_seq(a)
+    out = sched.schedule()
+    run_prefill(sched, out.prefill)
+    a.append_token(1)
+
+    b = seq("b", 32)
+    sched.add_seq(b)
+    kinds = []
+    for _ in range(4):
+        out = sched.schedule()
+        assert out.prefill is not None  # prefill runs to completion
+        kinds.append("p")
+        run_prefill(sched, out.prefill)
+    assert kinds == ["p", "p", "p", "p"]
+
+
+def test_interleave_noop_without_decode_ready():
+    """A lone prompt's chunks are never interrupted (nothing to starve)."""
+    sched, _ = make_sched(max_prefill_chunk=8, max_model_len=256)
+    s = seq("a", 32)
+    sched.add_seq(s)
+    for _ in range(4):
+        out = sched.schedule()
+        assert out.prefill is not None
+        run_prefill(sched, out.prefill)
+    assert s.prefill_done
