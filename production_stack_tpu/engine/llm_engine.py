@@ -117,8 +117,14 @@ class LLMEngine:
         if not pairs:
             return
         data = self.runner.export_blocks([bid for bid, _ in pairs])
+        # per-block contiguous copies: a view of the batched export array
+        # would pin the WHOLE export alive in the CPU tier until every
+        # sibling block is evicted, blowing the tier's byte accounting
         self.offload.put_batch(
-            [(h, data[:, :, i]) for i, (_, h) in enumerate(pairs)]
+            [
+                (h, np.ascontiguousarray(data[:, :, i]))
+                for i, (_, h) in enumerate(pairs)
+            ]
         )
 
     def _restore_from_offload(self, seq: Sequence) -> None:

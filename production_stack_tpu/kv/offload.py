@@ -346,20 +346,28 @@ class KVOffloadManager:
 
     def _store(self, h: int, arr: np.ndarray) -> None:
         cascade = [(h, arr)]
-        for i, tier in enumerate(self.tiers):
+        for tier in self.tiers:
             next_cascade: list[tuple[int, np.ndarray]] = []
-            admitted = []
+            admitted: list[int] = []
+            displaced: list[int] = []
             for ch, carr in cascade:
                 evicted = tier.put(ch, carr)
+                # a put may (a) admit ch, possibly displacing residents, or
+                # (b) reject ch outright (ch comes back in the evict list).
+                # Only displaced RESIDENTS are evictions of this tier —
+                # reporting a rejected block as evicted would make the
+                # controller delete state the tier never held.
                 if not any(eh == ch for eh, _ in evicted):
                     admitted.append(ch)
-                next_cascade.extend(evicted)
+                for eh, earr in evicted:
+                    next_cascade.append((eh, earr))
+                    if eh != ch:
+                        displaced.append(eh)
             if self.reporter is not None:
                 if admitted:
                     self.reporter.admit(tier.name, admitted)
-                dropped_here = [eh for eh, _ in next_cascade if eh != h or i > 0]
-                if dropped_here:
-                    self.reporter.evict(tier.name, dropped_here)
+                if displaced:
+                    self.reporter.evict(tier.name, displaced)
             cascade = next_cascade
             if not cascade:
                 return

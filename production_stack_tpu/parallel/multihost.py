@@ -36,6 +36,22 @@ from production_stack_tpu.utils.log import init_logger
 logger = init_logger(__name__)
 
 
+def _distributed_active() -> bool:
+    """True iff jax.distributed.initialize has already run.
+
+    Deliberately does NOT call jax.process_count(): that initializes the
+    XLA backend, after which jax.distributed.initialize() can never
+    succeed (it must run pre-backend), silently degrading every multi-host
+    deployment to per-host single-process serving."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # noqa: BLE001 — private API may move; worst case
+        # we attempt a redundant initialize and surface its error
+        return False
+
+
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -45,15 +61,22 @@ def initialize(
 
     On GKE TPU podslices, all three values resolve from the metadata/env
     that the TPU runtime injects, so a bare `initialize()` suffices; args
-    override for bare-metal or testing.
+    override for bare-metal or testing. Must run before anything touches
+    a device (jax.distributed requirement).
     """
-    if jax.process_count() > 1:
+    if _distributed_active():
         return  # already initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    # explicit multi-host intent: a failure here must be loud, not a
+    # silent fallback to single-host serving
+    explicit = coordinator_address is not None or (
+        num_processes is not None and num_processes > 1
+    )
     kwargs = {}
-    if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
-        kwargs["coordinator_address"] = (
-            coordinator_address or os.environ["COORDINATOR_ADDRESS"]
-        )
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
     if num_processes is not None:
         kwargs["num_processes"] = num_processes
     if process_id is not None:
@@ -66,6 +89,12 @@ def initialize(
             jax.local_device_count(), jax.device_count(),
         )
     except (RuntimeError, ValueError) as e:
+        if explicit:
+            raise RuntimeError(
+                "jax.distributed.initialize failed for an explicitly "
+                "configured multi-host job (it must run before the XLA "
+                f"backend is touched): {e}"
+            ) from e
         # single-host runs (including tests) land here; that's fine
         logger.info("jax.distributed not initialized (%s); single host", e)
 

@@ -77,7 +77,14 @@ class RouterRequest:
     def session_id(self, session_key: str | None) -> str | None:
         if not session_key:
             return None
-        return self.headers.get(session_key) or self.body.get(session_key)
+        # HTTP header names are case-insensitive and clients vary the
+        # casing (urllib sends X-user-id for x-user-id); a case-sensitive
+        # miss here silently downgrades session stickiness to QPS routing
+        want = session_key.lower()
+        for k, v in self.headers.items():
+            if k.lower() == want:
+                return v
+        return self.body.get(session_key)
 
     def request_text(self) -> str:
         """Flatten the prompt/messages for prefix matching."""

@@ -111,6 +111,23 @@ class SessionRouter(RoutingInterface):
         return url
 
 
+def _hostport(url_or_instance: str) -> str:
+    """Normalize an endpoint url or kv instance id to "host:port".
+
+    kv instance ids are free-form strings; anything urlparse cannot treat
+    as host:port (e.g. "engine-a:dev0") is compared verbatim instead of
+    crashing the routing path."""
+    from urllib.parse import urlparse
+
+    s = url_or_instance
+    try:
+        p = urlparse(s if "//" in s else f"//{s}")
+        host = p.hostname or s
+        return f"{host}:{p.port}" if p.port else host
+    except ValueError:
+        return s
+
+
 def _engine_prompt_text(request, tokenizer=None) -> str:
     """Render the request exactly as the engine will (chat template applied)
     so chained block hashes line up with engine-side prefix hashes — the
@@ -198,16 +215,17 @@ class KvawareRouter(RoutingInterface):
             # map instance ids -> endpoint urls (instance id is the engine's
             # kv_instance_id; by convention it equals its url host:port or is
             # advertised via /v1/models metadata)
-            urls = {e.url: e for e in endpoints}
+            # exact host:port comparison — substring matching would let
+            # instance "host:80" claim endpoint "http://host:8000"
+            urls = {e.url: _hostport(e.url) for e in endpoints}
             best = sorted(
                 by_instance.items(), key=lambda kv: -kv[1]
             )
             for inst, _ in best:
-                for url in urls:
-                    if inst in url or inst == url:
+                inst_hp = _hostport(inst)
+                for url, url_hp in urls.items():
+                    if inst == url or inst_hp == url_hp:
                         return url
-                if inst in urls:
-                    return inst
         return await self.fallback.route_request(
             endpoints, engine_stats, request_stats, request
         )

@@ -201,3 +201,20 @@ class TestPIIE2E:
             assert r.status == 200
             await _stop_stack(client, engines)
         asyncio.run(run())
+
+
+class TestPIILuhn:
+    def test_luhn_invalid_digit_runs_not_flagged(self):
+        a = RegexAnalyzer()
+        # 16-digit order id failing the Luhn checksum: benign
+        hits = [m for m in a.analyze("order id 1234 5678 9012 3455")
+                if m.entity_type == "CREDIT_CARD"]
+        assert hits == []
+
+    def test_luhn_valid_card_still_flagged(self):
+        a = RegexAnalyzer()
+        for card in ("4111 1111 1111 1111", "5500-0000-0000-0004",
+                     "340000000000009"):  # visa / mc / amex test numbers
+            hits = [m for m in a.analyze(f"pay with {card} today")
+                    if m.entity_type == "CREDIT_CARD"]
+            assert hits, card

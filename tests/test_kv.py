@@ -282,3 +282,86 @@ def test_engine_reports_to_controller(tiny_engine_cfg):
         eng.shutdown()
         holder["loop"].call_soon_threadsafe(holder["stop"].set)
         loop_thread.join(timeout=5)
+
+
+def test_rejected_block_not_reported_as_tier_evict():
+    """A tier that rejects an incoming block outright must not have that
+    block reported as EVICTED from it (it was never admitted), or the
+    controller would delete state the tier never held."""
+    from production_stack_tpu.kv.offload import KVTier
+
+    class Reporter:
+        def __init__(self):
+            self.events = []
+
+        def admit(self, tier, hashes):
+            self.events.append(("admit", tier, sorted(hashes)))
+
+        def evict(self, tier, hashes):
+            self.events.append(("evict", tier, sorted(hashes)))
+
+    class RejectTier(KVTier):
+        name = "reject"
+
+        def put(self, h, arr):
+            return [(h, arr)]  # rejects everything
+
+        def get(self, h):
+            return None
+
+        def contains(self, h):
+            return False
+
+        def hashes(self):
+            return []
+
+        def stats(self):
+            return {"tier": self.name, "blocks": 0}
+
+    one = blk(1)
+    cpu = CpuTier(capacity_bytes=one.nbytes)  # room for exactly one block
+    rep = Reporter()
+    m = KVOffloadManager([cpu, RejectTier()], reporter=rep)
+    try:
+        m.put_batch([(1, blk(1))])
+        m.put_batch([(2, blk(2))])  # displaces 1 -> reject tier drops it
+        deadline = time.time() + 5
+        while time.time() < deadline and not cpu.contains(2):
+            time.sleep(0.01)
+        time.sleep(0.05)  # let the cascade finish reporting
+    finally:
+        m.close()
+    assert ("admit", "cpu", [1]) in rep.events
+    assert ("evict", "cpu", [1]) in rep.events
+    # the reject tier never admitted nor evicted anything
+    assert not [e for e in rep.events if e[1] == "reject"], rep.events
+
+
+def test_offloaded_blocks_own_their_memory(tiny_engine_cfg):
+    """Engine d2h export must hand each tier per-block OWNING copies: a
+    view into the batched export array would pin the whole export alive
+    until every sibling evicts, breaking tier byte accounting."""
+    cfg = dict(tiny_engine_cfg)
+    cfg["cpu_offload_bytes"] = 1 << 20
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    engine = LLMEngine(EngineConfig(**cfg))
+    try:
+        sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        outs = engine.generate([list(range(24)), list(range(30, 50))], sp)
+        assert all(len(o.token_ids) == 4 for o in outs)
+        # force frees so cached blocks offload
+        deadline = time.time() + 5
+        cpu_tier = engine.offload.tiers[0]
+        while time.time() < deadline and not cpu_tier.hashes():
+            time.sleep(0.01)
+        assert cpu_tier.hashes(), "no blocks were offloaded"
+        for h in cpu_tier.hashes():
+            arr = cpu_tier.get(h)
+            assert arr.flags["OWNDATA"] or arr.base is None, (
+                "offloaded block is a view into a shared export array"
+            )
+    finally:
+        engine.shutdown()

@@ -424,3 +424,35 @@ class TestDisaggregatedPrefillE2E:
             assert decoder.requests_seen[0]["max_tokens"] == 7
             await _stop_stack(client, [prefiller, decoder])
         asyncio.run(run())
+
+
+def test_kvaware_no_port_prefix_collision():
+    """Instance 'host:80' must not claim endpoint 'http://host:8000'
+    (exact host:port comparison, not substring)."""
+    from production_stack_tpu.router.routing_logic import _hostport
+
+    assert _hostport("http://host:8000") == "host:8000"
+    assert _hostport("host:80") == "host:80"
+    assert _hostport("host:80") != _hostport("http://host:8000")
+    assert _hostport("http://10.0.0.2:8000/v1") == "10.0.0.2:8000"
+    assert _hostport("10.0.0.2:8000") == "10.0.0.2:8000"
+
+
+def test_session_id_header_case_insensitive():
+    """urllib-style clients send X-user-id for x-user-id; HTTP header
+    names are case-insensitive so stickiness must survive the casing."""
+    from production_stack_tpu.router.protocols import RouterRequest
+
+    r = RouterRequest(headers={"X-User-Id": "alice"}, body={},
+                      endpoint="/v1/completions")
+    assert r.session_id("x-user-id") == "alice"
+    r2 = RouterRequest(headers={}, body={"x-user-id": "bob"},
+                       endpoint="/v1/completions")
+    assert r2.session_id("x-user-id") == "bob"
+    assert r2.session_id(None) is None
+
+
+def test_hostport_tolerates_freeform_instance_ids():
+    from production_stack_tpu.router.routing_logic import _hostport
+
+    assert _hostport("engine-a:dev0") == "engine-a:dev0"  # no crash
