@@ -28,6 +28,42 @@ from dataclasses import dataclass, field
 import aiohttp
 
 
+def load_sharegpt(path: str, max_conversations: int = 0) -> list[list[dict]]:
+    """Load ShareGPT-format conversations -> list of OpenAI message lists.
+
+    Accepts the standard dump format (list of {"conversations":
+    [{"from": "human"|"gpt", "value": ...}]}) the reference prepares via
+    prepare_sharegpt_data.sh. Conversations are normalized to
+    user/assistant turns starting with a user turn.
+    """
+    with open(path) as f:
+        raw = json.load(f)
+    out: list[list[dict]] = []
+    role_map = {"human": "user", "user": "user",
+                "gpt": "assistant", "assistant": "assistant"}
+    for item in raw:
+        turns = item.get("conversations") or item.get("messages") or []
+        msgs: list[dict] = []
+        for t in turns:
+            role = role_map.get(t.get("from") or t.get("role"))
+            text = t.get("value") or t.get("content")
+            if role is None or not text:
+                continue
+            if not msgs and role != "user":
+                continue  # drop leading assistant turns
+            if msgs and msgs[-1]["role"] == role:
+                msgs[-1]["content"] += "\n" + text
+                continue
+            msgs.append({"role": role, "content": text})
+        if len(msgs) >= 2:
+            out.append(msgs)
+        if max_conversations and len(out) >= max_conversations:
+            break
+    if not out:
+        raise ValueError(f"no usable conversations in {path}")
+    return out
+
+
 def synthetic_text(num_words: int, seed: int) -> str:
     rng = random.Random(seed)
     words = []
@@ -47,6 +83,7 @@ class RequestRecord:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     ok: bool = False
+    itls: list = field(default_factory=list)  # inter-chunk gaps (s)
 
     @property
     def ttft(self) -> float | None:
@@ -64,9 +101,21 @@ class UserSession:
     args: argparse.Namespace
     history: list[dict] = field(default_factory=list)
     rounds_done: int = 0
+    sharegpt_conv: list[dict] | None = None  # this user's conversation
 
     def build_messages(self) -> list[dict]:
         msgs = [{"role": "system", "content": self.args._system_prompt}]
+        if self.sharegpt_conv is not None:
+            # replay the real conversation: history so far + next user turn
+            user_turn_idx = [
+                i for i, m in enumerate(self.sharegpt_conv)
+                if m["role"] == "user"
+            ]
+            k = self.rounds_done % len(user_turn_idx)
+            upto = user_turn_idx[k]
+            msgs.extend(self.history)
+            msgs.append(self.sharegpt_conv[upto])
+            return msgs
         if not self.history and self.args.user_history_len > 0:
             # per-user unique context so prefix caching can't collapse users
             self.history.append({
@@ -98,6 +147,10 @@ class Benchmark:
         self.sessions = [
             UserSession(i, args) for i in range(args.num_users)
         ]
+        if getattr(args, "sharegpt_path", None):
+            convs = load_sharegpt(args.sharegpt_path)
+            for s in self.sessions:
+                s.sharegpt_conv = convs[s.user_id % len(convs)]
         self.free_sessions = asyncio.Queue()
         for s in self.sessions:
             self.free_sessions.put_nowait(s)
@@ -115,6 +168,7 @@ class Benchmark:
             "stream_options": {"include_usage": True},
         }
         answer_parts: list[str] = []
+        last_chunk_t = 0.0
         try:
             async with http.post(
                 f"{self.args.base_url}/v1/chat/completions", json=body
@@ -133,8 +187,12 @@ class Benchmark:
                         chunk = json.loads(payload)
                     except json.JSONDecodeError:
                         continue
+                    now_chunk = time.time()
                     if rec.first_token is None:
-                        rec.first_token = time.time()
+                        rec.first_token = now_chunk
+                    else:
+                        rec.itls.append(now_chunk - last_chunk_t)
+                    last_chunk_t = now_chunk
                     for choice in chunk.get("choices", []):
                         delta = choice.get("delta", {})
                         if delta.get("content"):
@@ -204,6 +262,7 @@ class Benchmark:
     def summary(self, elapsed: float, launched: int) -> dict:
         done = [r for r in self.records if r.ok]
         ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        itls = sorted(g for r in done for g in r.itls)
         prompt_tokens = sum(r.prompt_tokens for r in done)
         gen_tokens = sum(r.completion_tokens for r in done)
 
@@ -227,6 +286,13 @@ class Benchmark:
             "p50_ttft_s": round(pct(0.50), 4) if ttfts else None,
             "p90_ttft_s": round(pct(0.90), 4) if ttfts else None,
             "p99_ttft_s": round(pct(0.99), 4) if ttfts else None,
+            "p50_itl_s": round(itls[len(itls) // 2], 4) if itls else None,
+            "p90_itl_s":
+                round(itls[min(len(itls) - 1, int(0.9 * len(itls)))], 4)
+                if itls else None,
+            "p99_itl_s":
+                round(itls[min(len(itls) - 1, int(0.99 * len(itls)))], 4)
+                if itls else None,
         }
 
 
@@ -246,6 +312,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--answer-len", type=int, default=100)
     p.add_argument("--duration", type=float, default=120.0)
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--sharegpt-path", default=None,
+                   help="ShareGPT-format JSON: replay real conversations "
+                        "instead of synthetic text (see "
+                        "prepare_sharegpt_data.sh)")
     p.add_argument("--output", default=None)
     args = p.parse_args(argv)
     args._system_prompt = (

@@ -1,0 +1,79 @@
+"""Plot QPS-sweep results (role parity with reference plot.py).
+
+Reads the summary_qps*.json files a sweep produces and renders TTFT +
+throughput vs offered QPS, one series per labelled directory so two
+stacks (e.g. round N vs round N+1, or TPU vs GPU) can be compared.
+
+Usage:
+  python plot.py summary_qps*.json -o sweep.png
+  python plot.py --series tpu=run_tpu --series a100=run_a100 -o cmp.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+
+
+def load_series(paths: list[str]) -> list[tuple[float, dict]]:
+    out = []
+    for path in paths:
+        with open(path) as f:
+            summary = json.load(f)
+        m = re.search(r"qps(\d+(?:\.\d+)?)", os.path.basename(path))
+        qps = float(m.group(1)) if m else summary.get("qps", 0.0)
+        out.append((qps, summary))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("files", nargs="*", help="summary_qps*.json files")
+    p.add_argument("--series", action="append", default=[],
+                   help="label=dir with summary_qps*.json inside")
+    p.add_argument("-o", "--output", default="sweep.png")
+    args = p.parse_args(argv)
+
+    series: dict[str, list[tuple[float, dict]]] = {}
+    if args.files:
+        series["run"] = load_series(args.files)
+    for spec in args.series:
+        label, _, d = spec.partition("=")
+        series[label] = load_series(
+            sorted(glob.glob(os.path.join(d, "summary_qps*.json")))
+        )
+    if not series:
+        raise SystemExit("no input files (pass files or --series)")
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4.2))
+    metrics = [
+        ("p50_ttft_s", "p50 TTFT (s)"),
+        ("generation_throughput_tok_s", "generation tok/s"),
+        ("p50_itl_s", "p50 ITL (s)"),
+    ]
+    for ax, (key, label) in zip(axes, metrics):
+        for name, rows in series.items():
+            xs = [q for q, s in rows if s.get(key) is not None]
+            ys = [s[key] for _, s in rows if s.get(key) is not None]
+            if xs:
+                ax.plot(xs, ys, marker="o", label=name)
+        ax.set_xlabel("offered QPS")
+        ax.set_ylabel(label)
+        ax.grid(True, alpha=0.3)
+        ax.legend()
+    fig.suptitle("multi-round-qa QPS sweep")
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Prepare a ShareGPT workload file for --sharegpt-path (role parity with
+# reference prepare_sharegpt_data.sh, which downloads the HF dump).
+#
+# With network access, download the standard cleaned split:
+#   curl -L -o sharegpt.json \
+#     https://huggingface.co/datasets/anon8231489123/ShareGPT_Vicuna_unfiltered/resolve/main/ShareGPT_V3_unfiltered_cleaned_split.json
+#
+# Air-gapped environments (CI, this repo's tests) can generate a
+# synthetic file with the same schema instead:
+#   ./prepare_sharegpt_data.sh --synthetic sharegpt.json [num_convs]
+set -euo pipefail
+
+if [[ "${1:-}" == "--synthetic" ]]; then
+  OUT="${2:-sharegpt.json}"
+  N="${3:-64}"
+  python3 - "$OUT" "$N" << 'EOF'
+import json, random, string, sys
+
+out, n = sys.argv[1], int(sys.argv[2])
+rng = random.Random(0)
+
+def text(words):
+    return " ".join(
+        "".join(rng.choices(string.ascii_lowercase, k=rng.randint(3, 9)))
+        for _ in range(words)
+    )
+
+data = []
+for i in range(n):
+    turns = []
+    for r in range(rng.randint(2, 6)):
+        turns.append({"from": "human", "value": text(rng.randint(10, 120))})
+        turns.append({"from": "gpt", "value": text(rng.randint(20, 200))})
+    data.append({"id": f"synthetic-{i}", "conversations": turns})
+with open(out, "w") as f:
+    json.dump(data, f)
+print(f"wrote {out}: {n} synthetic ShareGPT conversations")
+EOF
+  exit 0
+fi
+
+OUT="${1:-sharegpt.json}"
+curl -L -o "$OUT" \
+  "https://huggingface.co/datasets/anon8231489123/ShareGPT_Vicuna_unfiltered/resolve/main/ShareGPT_V3_unfiltered_cleaned_split.json"
+echo "wrote $OUT"

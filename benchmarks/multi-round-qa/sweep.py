@@ -1,0 +1,107 @@
+"""One-command QPS sweep: run multi_round_qa at each QPS, print ONE table.
+
+The round-over-round perf surface (reference: run.sh sweep loop +
+manual spreadsheet): each row is one QPS point with completion rate,
+throughputs, TTFT and ITL percentiles; results land in --out-dir as
+summary_qps*.json (plot.py consumes them) plus sweep.md with the table.
+
+Usage:
+  python sweep.py --base-url http://localhost:8001 --model llama-3.2-1b \
+      --qps 1 2 4 8 --num-users 32 --duration 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import multi_round_qa
+
+
+COLUMNS = [
+    ("qps", "achieved QPS"),
+    ("requests_completed", "done"),
+    ("errors", "errors"),
+    ("prompt_throughput_tok_s", "prompt tok/s"),
+    ("generation_throughput_tok_s", "gen tok/s"),
+    ("avg_ttft_s", "avg TTFT"),
+    ("p50_ttft_s", "p50 TTFT"),
+    ("p99_ttft_s", "p99 TTFT"),
+    ("p50_itl_s", "p50 ITL"),
+    ("p99_itl_s", "p99 ITL"),
+]
+
+
+def to_table(rows: list[tuple[float, dict]]) -> str:
+    header = ["offered QPS"] + [label for _, label in COLUMNS]
+    lines = [
+        "| " + " | ".join(header) + " |",
+        "|" + "|".join("---" for _ in header) + "|",
+    ]
+    for qps, s in rows:
+        cells = [str(qps)] + [
+            "-" if s.get(key) is None else str(s[key]) for key, _ in COLUMNS
+        ]
+        lines.append("| " + " | ".join(cells) + " |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--base-url", default="http://localhost:8001")
+    p.add_argument("--model", required=True)
+    p.add_argument("--qps", type=float, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--num-users", type=int, default=32)
+    p.add_argument("--num-rounds", type=int, default=10)
+    p.add_argument("--shared-system-prompt-len", type=int, default=1000)
+    p.add_argument("--user-history-len", type=int, default=2000)
+    p.add_argument("--answer-len", type=int, default=100)
+    p.add_argument("--duration", type=float, default=120.0)
+    p.add_argument("--sharegpt-path", default=None)
+    p.add_argument("--out-dir", default="sweep-results")
+    p.add_argument("--skip-warmup", action="store_true")
+    args = p.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    def qa_args(qps: float, **over) -> list[str]:
+        base = [
+            "--base-url", args.base_url, "--model", args.model,
+            "--num-users", str(args.num_users),
+            "--num-rounds", str(over.get("num_rounds", args.num_rounds)),
+            "--qps", str(qps),
+            "--shared-system-prompt-len",
+            str(args.shared_system_prompt_len),
+            "--user-history-len", str(args.user_history_len),
+            "--answer-len", str(over.get("answer_len", args.answer_len)),
+            "--duration", str(over.get("duration", args.duration)),
+        ]
+        if args.sharegpt_path:
+            base += ["--sharegpt-path", args.sharegpt_path]
+        if "output" in over:
+            base += ["--output", over["output"]]
+        return base
+
+    if not args.skip_warmup:
+        print("== warmup (compile buckets, fill prefix cache) ==")
+        multi_round_qa.main(
+            qa_args(0, num_rounds=2, answer_len=16,
+                    duration=min(60.0, args.duration))
+        )
+
+    rows: list[tuple[float, dict]] = []
+    for qps in args.qps:
+        print(f"== qps={qps} ==")
+        out = os.path.join(args.out_dir, f"summary_qps{qps}.json")
+        rows.append((qps, multi_round_qa.main(qa_args(qps, output=out))))
+
+    table = to_table(rows)
+    print("\n" + table)
+    with open(os.path.join(args.out_dir, "sweep.md"), "w") as f:
+        f.write(table + "\n")
+    print(f"\nresults in {args.out_dir}/ (plot: python plot.py --series "
+          f"run={args.out_dir} -o {args.out_dir}/sweep.png)")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,3 +43,83 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 hf-token
 {{- end -}}
 {{- end -}}
+
+{{/* Pod spec shared by multi-host leader and worker templates: every
+host of the slice runs the same engine command; the process id comes
+from the LWS worker index and the coordinator is the leader pod's
+LWS-provided address. GKE schedules the group onto one multi-host slice
+via the TPU nodeSelectors. */}}
+{{- define "pst.multihostPodSpec" -}}
+{{- $root := .root -}}
+{{- $ms := .ms -}}
+{{- $leader := .leader -}}
+nodeSelector:
+  cloud.google.com/gke-tpu-accelerator: {{ $ms.tpuAccelerator | default "tpu-v5-lite-podslice" }}
+  cloud.google.com/gke-tpu-topology: {{ $ms.tpuTopology | quote }}
+  {{- with $ms.nodeSelector }}
+  {{- toYaml . | nindent 2 }}
+  {{- end }}
+{{- with $ms.tolerations }}
+tolerations: {{ toYaml . | nindent 2 }}
+{{- end }}
+containers:
+  - name: engine
+    image: "{{ $ms.image.repository }}:{{ $ms.image.tag | default "latest" }}"
+    command: ["python", "-m", "production_stack_tpu.engine"]
+    args:
+      - "--model"
+      - {{ $ms.modelURL | quote }}
+      - "--host"
+      - "0.0.0.0"
+      - "--port"
+      - {{ $root.Values.servingEngineSpec.containerPort | default 8000 | quote }}
+      - "--multihost"
+      - "--coordinator-address"
+      - "$(LWS_LEADER_ADDRESS):{{ ($ms.multiHost).coordinatorPort | default 10001 }}"
+      - "--num-processes"
+      - {{ $ms.multiHost.hosts | quote }}
+      - "--process-id"
+      - "$(LWS_WORKER_INDEX)"
+      {{- if $ms.tensorParallelSize }}
+      - "--tensor-parallel-size"
+      - {{ $ms.tensorParallelSize | quote }}
+      {{- end }}
+      {{- if $ms.maxModelLen }}
+      - "--max-model-len"
+      - {{ $ms.maxModelLen | quote }}
+      {{- end }}
+      {{- range $arg := $ms.extraArgs }}
+      - {{ $arg | quote }}
+      {{- end }}
+    env:
+      - name: LWS_WORKER_INDEX
+        valueFrom:
+          fieldRef:
+            fieldPath: metadata.labels['leaderworkerset.sigs.k8s.io/worker-index']
+      {{- if $root.Values.servingEngineSpec.hfToken }}
+      - name: HF_TOKEN
+        valueFrom:
+          secretKeyRef:
+            name: {{ include "pst.hfTokenSecretName" $root }}
+            key: {{ include "pst.hfTokenSecretKey" $root }}
+      {{- end }}
+    ports:
+      - containerPort: {{ $root.Values.servingEngineSpec.containerPort | default 8000 }}
+      - containerPort: {{ ($ms.multiHost).coordinatorPort | default 10001 }}
+    resources:
+      requests:
+        google.com/tpu: {{ $ms.multiHost.tpuPerHost | default 4 | quote }}
+        {{- with ($ms.resources).requests }}
+        {{- range $k, $v := . }}
+        {{ $k }}: {{ $v | quote }}
+        {{- end }}
+        {{- end }}
+      limits:
+        google.com/tpu: {{ $ms.multiHost.tpuPerHost | default 4 | quote }}
+    {{- if $leader }}
+    startupProbe:
+      httpGet: {path: /health, port: {{ $root.Values.servingEngineSpec.containerPort | default 8000 }}}
+      failureThreshold: 120
+      periodSeconds: 10
+    {{- end }}
+{{- end -}}

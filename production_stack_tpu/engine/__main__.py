@@ -69,6 +69,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remote-cache-url", default=None)
     p.add_argument("--kv-controller-url", default=None)
     p.add_argument("--kv-instance-id", default="default-instance")
+    p.add_argument("--multihost", action="store_true",
+                   help="one engine spanning a multi-host slice: host 0 "
+                        "schedules + serves HTTP, other hosts replay its "
+                        "steps (jax.distributed SPMD)")
+    p.add_argument("--coordinator-address", default=None,
+                   help="host0:port for jax.distributed (defaults to "
+                        "COORDINATOR_ADDRESS env / TPU metadata)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     return p
 
 
@@ -94,6 +103,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         decode_interleave=args.decode_interleave,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
+        multihost=args.multihost,
         served_model_name=args.served_model_name,
         enable_lora=args.enable_lora,
         max_loras=args.max_loras,
@@ -128,6 +138,27 @@ def main(argv: list[str] | None = None) -> None:
             except OSError:
                 host = "127.0.0.1"
         args.kv_instance_id = f"{host}:{args.port}"
+    if args.multihost:
+        # must run before anything touches a device (jax.distributed)
+        from production_stack_tpu.parallel import multihost
+
+        multihost.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        if multihost.process_index() != 0:
+            # follower host: no HTTP server, replay host 0's device steps
+            from production_stack_tpu.engine.model_runner import ModelRunner
+            from production_stack_tpu.engine.multihost_engine import (
+                follower_loop,
+                validate_multihost_config,
+            )
+
+            cfg = config_from_args(args)
+            validate_multihost_config(cfg)
+            follower_loop(ModelRunner(cfg))
+            return
     server = EngineServer(config_from_args(args))
     server.run(host=args.host, port=args.port)
 

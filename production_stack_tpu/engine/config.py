@@ -32,6 +32,9 @@ class EngineConfig:
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1
+    # one engine spanning the hosts of a multi-host slice (jax.distributed
+    # SPMD; host 0 schedules + serves HTTP, followers replay its steps)
+    multihost: bool = False
 
     # serving
     served_model_name: str | None = None

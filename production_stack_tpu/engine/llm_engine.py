@@ -39,8 +39,24 @@ logger = init_logger(__name__)
 class LLMEngine:
     def __init__(self, config: EngineConfig, params: dict | None = None):
         self.config = config
+        if config.multihost:
+            from production_stack_tpu.engine.multihost_engine import (
+                validate_multihost_config,
+            )
+
+            validate_multihost_config(config)
         self.tokenizer = get_tokenizer(config.tokenizer, config.model)
         self.runner = ModelRunner(config, params=params)
+        if config.multihost:
+            from production_stack_tpu.engine.multihost_engine import (
+                wrap_engine_for_multihost,
+            )
+            from production_stack_tpu.parallel import multihost
+
+            if multihost.is_multihost():
+                # host 0 only: followers never construct an LLMEngine,
+                # they run multihost_engine.follower_loop on a bare runner
+                wrap_engine_for_multihost(self)
         self.block_manager = BlockManager(
             num_blocks=self.runner.num_blocks,
             block_size=config.block_size,
@@ -433,6 +449,8 @@ class LLMEngine:
             return 0
 
     def shutdown(self) -> None:
+        if hasattr(self.runner, "shutdown_followers"):
+            self.runner.shutdown_followers()
         if self.offload is not None:
             self.offload.close()
         if self.kv_reporter is not None:

@@ -146,6 +146,11 @@ class ModelRunner:
             self.lora_manager = LoraManager(
                 mc, config.max_loras, config.max_lora_rank, self.dtype
             )
+        # multi-host SPMD: logits must come back fully replicated so host 0
+        # can pull them to the host for sampling (shards on follower hosts
+        # are not addressable from host 0)
+        self.replicate_logits = bool(config.multihost)
+
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
@@ -227,6 +232,16 @@ class ModelRunner:
             )
         jax.block_until_ready((out, out2))
 
+    def _step_jit_kwargs(self) -> dict:
+        """Extra jit options for the prefill/decode step builders."""
+        if not (self.replicate_logits and self.mesh is not None):
+            return {}
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        cs = sharding_rules.cache_sharding(self.mesh)
+        return {"out_shardings": (rep, cs, cs)}
+
     # -- buckets ----------------------------------------------------------
     def _ctx_bucket(self, num_tokens: int) -> int:
         """Context bucket in tokens: whole blocks, pow2 block count."""
@@ -295,7 +310,7 @@ class ModelRunner:
             )
             return logits[0], kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
     def _build_decode(self, b: int, c_pad: int):
         mc = self.model_config
@@ -346,7 +361,7 @@ class ModelRunner:
             )
             return logits, kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
     # -- host-side helpers -------------------------------------------------
     def _slots_for_positions(
@@ -546,7 +561,7 @@ class ModelRunner:
             keep = (positions < valid_len)[:, None].astype(jnp.float32)
             return jnp.sum(h * keep, axis=0), kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
     def embed(self, token_ids: list[int], lora_slot: int = 0) -> np.ndarray:
         """Mean-pooled + L2-normalised final hidden state -> (hidden,) f32

@@ -1,0 +1,133 @@
+"""One engine spanning the hosts of a multi-host TPU slice.
+
+TPU-native replacement for the reference's Ray pipeline-parallel
+multi-host path (reference: helm/templates/ray-cluster.yaml:1-622,
+tutorial 15 `pipelineParallelSize`): instead of a Ray actor tree, the
+engine runs SPMD under jax.distributed — every host executes the same
+jitted steps over a global (tp) mesh whose devices span the slice, and
+XLA lays the collectives on ICI/DCN.
+
+Control flow: the scheduler, HTTP server, and sampler live on host 0
+only. Host 0 wraps its ModelRunner in `BroadcastingRunner`, which
+publishes a step descriptor (step kind + host-side integer args) through
+the jax.distributed coordinator KV store before executing it locally;
+follower hosts run `follower_loop`, replaying each descriptor against
+their local ModelRunner so all hosts issue identical device programs in
+identical order (the SPMD contract).
+
+v1 scope (documented, loudly enforced in config validation below):
+base-model serving only — KV offload tiers, PD transfer, LoRA hot-load
+and /v1/embeddings are single-host features for now (each needs its own
+broadcast/addressability story).
+"""
+
+from __future__ import annotations
+
+from production_stack_tpu.parallel import multihost
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+def validate_multihost_config(config) -> None:
+    """Reject single-host-only features early with a clear message."""
+    problems = []
+    if config.enable_lora:
+        problems.append("--enable-lora (adapter loads are not broadcast)")
+    if config.cpu_offload_bytes or config.disk_offload_dir or (
+        config.remote_cache_url
+    ):
+        problems.append(
+            "KV offload tiers (cache export needs host-0-addressable "
+            "shards)"
+        )
+    if config.kv_role:
+        problems.append("disaggregated prefill roles")
+    if problems:
+        raise ValueError(
+            "multihost mode does not yet support: " + "; ".join(problems)
+        )
+
+
+class BroadcastingRunner:
+    """Host-0 ModelRunner proxy: publish each device step, then run it.
+
+    Only the methods that issue device programs are intercepted; all
+    other attribute access (model_config, num_blocks, params, ...)
+    delegates to the wrapped runner.
+    """
+
+    def __init__(self, runner, broadcaster: multihost.StepBroadcaster):
+        self._runner = runner
+        self._bc = broadcaster
+
+    def __getattr__(self, name):
+        return getattr(self._runner, name)
+
+    def prefill(self, token_ids, start_pos, block_table, total_len,
+                lora_slot=0):
+        self._bc.publish({
+            "kind": "prefill",
+            "token_ids": [int(t) for t in token_ids],
+            "start_pos": int(start_pos),
+            "block_table": [int(b) for b in block_table],
+            "total_len": int(total_len),
+        })
+        return self._runner.prefill(
+            token_ids, start_pos, block_table, total_len,
+            lora_slot=lora_slot,
+        )
+
+    def decode(self, token_ids, positions, block_tables, context_lens,
+               lora_slots=None):
+        self._bc.publish({
+            "kind": "decode",
+            "token_ids": [int(t) for t in token_ids],
+            "positions": [int(p) for p in positions],
+            "block_tables": [[int(b) for b in t] for t in block_tables],
+            "context_lens": [int(c) for c in context_lens],
+        })
+        return self._runner.decode(
+            token_ids, positions, block_tables, context_lens,
+            lora_slots=lora_slots,
+        )
+
+    def embed(self, *a, **kw):
+        raise NotImplementedError(
+            "/v1/embeddings is not yet supported in multihost mode"
+        )
+
+    def shutdown_followers(self) -> None:
+        self._bc.publish({"kind": "shutdown"})
+
+
+def wrap_engine_for_multihost(engine) -> None:
+    """Host 0: swap the engine's runner for the broadcasting proxy."""
+    engine.runner = BroadcastingRunner(
+        engine.runner, multihost.StepBroadcaster()
+    )
+    logger.info(
+        "multihost host 0: broadcasting steps to %d follower hosts",
+        multihost.process_count() - 1,
+    )
+
+
+def follower_loop(runner, timeout_s: float = 600.0) -> None:
+    """Follower hosts: replay host 0's device steps until shutdown."""
+    bc = multihost.StepBroadcaster()
+    logger.info(
+        "multihost follower %d: replaying host 0's steps",
+        multihost.process_index(),
+    )
+    while True:
+        msg = bc.next(timeout_s=timeout_s)
+        kind = msg.pop("kind")
+        if kind == "shutdown":
+            logger.info("follower: shutdown received")
+            return
+        if kind == "prefill":
+            runner.prefill(**msg)
+        elif kind == "decode":
+            runner.decode(**msg)
+        else:  # future step kinds must fail loudly, not silently desync
+            raise RuntimeError(f"unknown multihost step kind {kind!r}")

@@ -99,6 +99,72 @@ def initialize(
         logger.info("jax.distributed not initialized (%s); single host", e)
 
 
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+class StepBroadcaster:
+    """Host-0 -> followers step-descriptor stream over the jax.distributed
+    coordinator KV store.
+
+    One engine spanning N hosts runs SPMD: every host must issue the same
+    jitted calls in the same order. The scheduler lives on host 0 only;
+    each step it publishes a small JSON descriptor (step kind + host-side
+    args) that followers block on and replay against their local
+    ModelRunner. The coordinator round-trip is ~ms — amortized over a
+    device step that is itself ms-scale, and it replaces an entire Ray
+    actor tree in the reference's multi-host path (ray-cluster.yaml).
+    """
+
+    PREFIX = "pst/step/"
+
+    def __init__(self, window: int = 1024):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "StepBroadcaster requires jax.distributed (call "
+                "multihost.initialize() first)"
+            )
+        self._client = client
+        self._n = 0
+        self._window = window
+
+    def publish(self, payload: dict) -> None:
+        """Host 0: publish the next step descriptor."""
+        import json
+
+        self._client.key_value_set(
+            f"{self.PREFIX}{self._n}", json.dumps(payload)
+        )
+        self._n += 1
+        old = self._n - self._window
+        if old >= 0:
+            try:
+                self._client.key_value_delete(f"{self.PREFIX}{old}")
+            except Exception:  # noqa: BLE001 — GC is best-effort
+                pass
+
+    def next(self, timeout_s: float = 600.0) -> dict:
+        """Follower: block for the next descriptor."""
+        import json
+
+        raw = self._client.blocking_key_value_get(
+            f"{self.PREFIX}{self._n}", int(timeout_s * 1000)
+        )
+        self._n += 1
+        return json.loads(raw)
+
+
 def make_multihost_mesh(tp: int, dp: int = 1) -> Mesh:
     """(dp, tp) mesh: tp packed within a slice (ICI), dp across (DCN).
 

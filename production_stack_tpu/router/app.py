@@ -74,6 +74,20 @@ class RouterApp:
         args = self.args
         initialize_feature_gates(args.feature_gates)
 
+        # tracing/error reporting (reference: app.py:138-145)
+        from production_stack_tpu.router import tracing
+
+        tracing.init_sentry(
+            args.sentry_dsn,
+            traces_sample_rate=args.sentry_traces_sample_rate,
+            profile_session_sample_rate=(
+                args.sentry_profile_session_sample_rate
+            ),
+        )
+        self.tracer = tracing.RequestTracer(
+            getattr(args, "tracing_exporter", "none")
+        )
+
         if args.service_discovery == "static":
             initialize_service_discovery(
                 "static",
@@ -155,6 +169,7 @@ class RouterApp:
             rewriter=rewriter,
             semantic_cache=self.semantic_cache,
             request_timeout_s=args.request_timeout_seconds,
+            tracer=self.tracer,
         )
 
         if args.enable_batch_api:

@@ -137,6 +137,10 @@ def build_parser() -> argparse.ArgumentParser:
     misc.add_argument("--sentry-traces-sample-rate", type=float, default=0.1)
     misc.add_argument("--sentry-profile-session-sample-rate", type=float,
                       default=0.1)
+    misc.add_argument("--tracing-exporter", type=str, default="none",
+                      choices=["none", "log", "memory"],
+                      help="per-request span export: structured JSON log "
+                           "lines, in-memory buffer, or off")
 
     sem = p.add_argument_group("semantic cache")
     sem.add_argument("--semantic-cache-model", type=str,

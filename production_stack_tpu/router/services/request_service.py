@@ -64,11 +64,15 @@ class RequestService:
         rewriter=None,
         semantic_cache=None,
         request_timeout_s: float = 600.0,
+        tracer=None,
     ):
+        from production_stack_tpu.router.tracing import noop_tracer
+
         self.session_key = session_key
         self.callbacks = callbacks
         self.rewriter = rewriter
         self.semantic_cache = semantic_cache
+        self.tracer = tracer or noop_tracer()
         self.timeout = aiohttp.ClientTimeout(
             total=request_timeout_s, sock_connect=10
         )
@@ -198,6 +202,20 @@ class RequestService:
         monitor.on_new_request(
             stats_url, request_id, time.time(), prompt_tokens
         )
+        span = None
+        if self.tracer.enabled:
+            span = self.tracer.start_span(
+                "proxy_request",
+                trace_id=request.headers.get("x-trace-id"),
+                attributes={
+                    "request_id": request_id,
+                    "backend": backend_url,
+                    "endpoint": endpoint_path,
+                    "model": body.get("model"),
+                    "prompt_tokens_est": prompt_tokens,
+                    "stream": bool(body.get("stream")),
+                },
+            )
         self.in_flight += 1
         first_chunk_seen = False
         # store-after-response for the semantic cache (reference:
@@ -230,6 +248,8 @@ class RequestService:
                         monitor.on_request_response(
                             stats_url, request_id, time.time()
                         )
+                        if span is not None:
+                            span.add_event("first_token")
                     else:
                         monitor.on_token(stats_url, request_id)
                     if cache_body and upstream.status == 200:
@@ -248,6 +268,10 @@ class RequestService:
                         pass
                 if self.callbacks is not None:
                     self.callbacks.post_request(request_id, body)
+                if span is not None:
+                    span.set_attribute("http.status", upstream.status)
+                    self.tracer.finish(span)
+                    span = None
                 return resp
         except (aiohttp.ClientError, ConnectionResetError) as e:
             monitor.on_request_complete(stats_url, request_id, time.time())
@@ -261,6 +285,8 @@ class RequestService:
                 status=502,
             )
         finally:
+            if span is not None:
+                self.tracer.finish(span, status="ERROR")
             self.in_flight -= 1
 
     # -- headless execution (batch API worker path) ------------------------

@@ -1,0 +1,81 @@
+"""Worker process for the 2-process multihost engine test.
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+
+Process 0 runs the full LLMEngine (scheduler + sampler + broadcasting
+runner); process 1 runs the follower loop. Both span one tp=4 mesh over
+2 processes x 2 virtual CPU devices.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+
+from production_stack_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(
+    f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, "distributed bring-up failed"
+assert jax.device_count() == 4
+
+from production_stack_tpu.engine.config import EngineConfig  # noqa: E402
+from production_stack_tpu.models import config as mcfg  # noqa: E402
+
+CFG = mcfg.ModelConfig(
+    name="pst-mh-test",
+    vocab_size=512,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=8,
+    num_kv_heads=4,
+    head_dim=8,
+    max_model_len=128,
+    rope_theta=10000.0,
+    tie_word_embeddings=True,
+)
+mcfg._PRESETS[CFG.name] = CFG
+
+ENGINE_CFG = EngineConfig(
+    model=CFG.name,
+    tokenizer="byte",
+    dtype="float32",
+    cache_dtype="float32",
+    block_size=4,
+    num_kv_blocks=64,
+    max_num_seqs=2,
+    max_prefill_chunk=16,
+    tensor_parallel_size=4,
+    multihost=True,
+    seed=0,
+)
+
+PROMPTS = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7, 6, 5]]
+
+if pid == 0:
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    engine = LLMEngine(ENGINE_CFG)
+    outs = engine.generate(
+        PROMPTS,
+        SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+    )
+    engine.shutdown()
+    print("RESULT " + json.dumps([o.token_ids for o in outs]), flush=True)
+else:
+    from production_stack_tpu.engine.model_runner import ModelRunner
+    from production_stack_tpu.engine.multihost_engine import follower_loop
+
+    follower_loop(ModelRunner(ENGINE_CFG), timeout_s=180)
+    print("RESULT follower-done", flush=True)

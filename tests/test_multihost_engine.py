@@ -1,0 +1,97 @@
+"""2-process multihost engine integration test (round-1 verdict item 6:
+the multi-host serving story needs an engine bring-up test across real
+processes, not just mesh-layout unit tests).
+
+Two OS processes form one jax.distributed job (2 x 2 virtual CPU devices
+= one tp=4 mesh). Process 0 runs the full engine (scheduler, sampler,
+HTTP-facing LLMEngine API) with the BroadcastingRunner; process 1 replays
+the step stream via follower_loop. Greedy outputs must equal a
+single-process engine with the same seed."""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(__file__)
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def test_two_process_engine_matches_single_process():
+    env = dict(os.environ)
+    repo = os.path.dirname(HERE)
+    # PYTHONPATH=repo makes the package importable AND drops the axon TPU
+    # plugin site dir the image injects via PYTHONPATH
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), "19741"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=repo,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    result_lines = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT ")
+    ]
+    assert len(result_lines) == 2, "\n---\n".join(outs)
+    tokens = next(
+        json.loads(line[len("RESULT "):]) for line in result_lines
+        if not line.endswith("follower-done")
+    )
+    assert "RESULT follower-done" in result_lines
+
+    # single-process reference with the same config/seed (conftest gives
+    # this process 8 virtual devices; use tp=4 to match shardings)
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+    from production_stack_tpu.models import config as mcfg
+
+    cfg = mcfg.ModelConfig(
+        name="pst-mh-test-ref",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=8,
+        max_model_len=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=True,
+    )
+    mcfg._PRESETS[cfg.name] = cfg
+    try:
+        engine = LLMEngine(EngineConfig(
+            model=cfg.name,
+            tokenizer="byte",
+            dtype="float32",
+            cache_dtype="float32",
+            block_size=4,
+            num_kv_blocks=64,
+            max_num_seqs=2,
+            max_prefill_chunk=16,
+            tensor_parallel_size=4,
+            seed=0,
+        ))
+        ref = engine.generate(
+            [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7, 6, 5]],
+            SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+        )
+    finally:
+        mcfg._PRESETS.pop(cfg.name, None)
+    assert tokens == [o.token_ids for o in ref]

@@ -1,0 +1,90 @@
+"""Sentry init + request tracing tests (reference: app.py:138-145 sentry
+wiring; round-1 verdict items 6/7 — the flags must do what they say)."""
+
+import json
+import sys
+import types
+
+from production_stack_tpu.router import tracing
+
+
+def test_sentry_noop_without_dsn():
+    assert tracing.init_sentry(None) is False
+
+
+def test_sentry_warns_when_sdk_missing(caplog):
+    # sentry_sdk is not installed in this image
+    assert tracing.init_sentry("https://x@sentry.example/1") is False
+
+
+def test_sentry_initializes_with_fake_sdk(monkeypatch):
+    calls = {}
+    fake = types.ModuleType("sentry_sdk")
+    fake.init = lambda **kw: calls.update(kw)
+    monkeypatch.setitem(sys.modules, "sentry_sdk", fake)
+    ok = tracing.init_sentry(
+        "https://x@sentry.example/1",
+        traces_sample_rate=0.5,
+        profile_session_sample_rate=0.25,
+    )
+    assert ok is True
+    assert calls["dsn"] == "https://x@sentry.example/1"
+    assert calls["traces_sample_rate"] == 0.5
+    assert calls["profile_session_sample_rate"] == 0.25
+
+
+def test_memory_tracer_records_spans():
+    t = tracing.RequestTracer("memory")
+    span = t.start_span("proxy_request",
+                        attributes={"request_id": "r1", "backend": "b"})
+    span.add_event("first_token")
+    span.set_attribute("http.status", 200)
+    t.finish(span)
+    assert len(t.spans) == 1
+    d = t.spans[0].to_dict()
+    assert d["name"] == "proxy_request"
+    assert d["attributes"]["http.status"] == 200
+    assert d["events"][0]["name"] == "first_token"
+    assert d["duration_s"] is not None and d["duration_s"] >= 0
+    assert len(d["trace_id"]) == 32 and len(d["span_id"]) == 16
+
+
+def test_log_tracer_emits_json():
+    import logging
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    # the project logger sets propagate=False, so attach directly
+    lg = logging.getLogger("production_stack_tpu.router.tracing")
+    h = Capture()
+    lg.addHandler(h)
+    try:
+        t = tracing.RequestTracer("log")
+        span = t.start_span("proxy_request", attributes={"request_id": "r2"})
+        t.finish(span, status="ERROR")
+    finally:
+        lg.removeHandler(h)
+    lines = [m for m in records if m.startswith("trace ")]
+    assert lines
+    payload = json.loads(lines[-1].split("trace ", 1)[1])
+    assert payload["status"] == "ERROR"
+    assert payload["attributes"]["request_id"] == "r2"
+
+
+def test_noop_tracer_is_cheap():
+    t = tracing.noop_tracer()
+    assert not t.enabled
+    span = t.start_span("x")
+    t.finish(span)
+    assert t.spans == []
+
+
+def test_invalid_exporter_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        tracing.RequestTracer("jaeger")
