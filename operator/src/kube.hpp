@@ -38,6 +38,7 @@ inline const GVR kLoraAdapters{"production-stack.tpu", "v1alpha1",
                                "loraadapters"};
 inline const GVR kCacheServers{"production-stack.tpu", "v1alpha1",
                                "cacheservers"};
+inline const GVR kLeases{"coordination.k8s.io", "v1", "leases"};
 
 class KubeClient {
  public:

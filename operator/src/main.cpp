@@ -33,7 +33,99 @@ struct Options {
   std::string ns = "default";
   int resync_seconds = 10;
   int engine_port = 8000;
-  bool once = false;  // single reconcile pass (tests)
+  bool once = false;         // single reconcile pass (tests)
+  bool leader_elect = false;  // Lease-based election (multi-replica)
+};
+
+// ---- Lease leader election (role of controller-runtime's
+// leaderelection.LeaderElector in the reference manager,
+// reference: operator/cmd/main.go LeaderElection options). One Lease
+// object in the managed namespace; the holder renews every resync tick,
+// non-holders take over when renewTime goes stale past the duration. ----
+
+static std::string now_rfc3339_micro() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  struct tm tmv;
+  gmtime_r(&ts.tv_sec, &tmv);
+  char date[32], out[64];
+  strftime(date, sizeof(date), "%Y-%m-%dT%H:%M:%S", &tmv);
+  snprintf(out, sizeof(out), "%s.%06ldZ", date, ts.tv_nsec / 1000);
+  return out;
+}
+
+static time_t parse_rfc3339(const std::string& s) {
+  struct tm tmv {};
+  if (!strptime(s.c_str(), "%Y-%m-%dT%H:%M:%S", &tmv)) return 0;
+  return timegm(&tmv);
+}
+
+class LeaderElector {
+ public:
+  LeaderElector(KubeClient& kube, std::string ns, int lease_seconds = 30)
+      : kube_(kube), ns_(std::move(ns)), lease_seconds_(lease_seconds) {
+    char host[256] = "pst-operator";
+    gethostname(host, sizeof(host) - 1);
+    id_ = std::string(host) + "-" + std::to_string(getpid());
+  }
+
+  // Returns true iff this process holds the lease after the call.
+  bool acquire_or_renew() {
+    try {
+      auto existing = kube_.get(pstkube::kLeases, ns_, kName);
+      if (!existing) {
+        kube_.create(pstkube::kLeases, ns_, desired(/*acquire=*/true));
+        pstop::log("leader election: acquired lease as " + id_);
+        return true;
+      }
+      const auto& spec = existing->get("spec");
+      const std::string holder = spec.get("holderIdentity").as_string();
+      if (holder == id_) {
+        kube_.merge_patch(pstkube::kLeases, ns_, kName,
+                          desired(/*acquire=*/false));
+        return true;
+      }
+      const time_t renewed = parse_rfc3339(spec.get("renewTime").as_string());
+      const int duration =
+          (int)spec.get("leaseDurationSeconds").as_int(lease_seconds_);
+      if (renewed != 0 && time(nullptr) - renewed <= duration)
+        return false;  // someone else holds a fresh lease
+      // Takeover via PUT carrying the observed resourceVersion: if another
+      // candidate won the race first, the apiserver rejects this write
+      // (409) and we stay follower until the next tick.
+      Json takeover = desired(/*acquire=*/true);
+      takeover["metadata"] = (*existing).get("metadata");
+      kube_.update(pstkube::kLeases, ns_, kName, takeover);
+      pstop::log("leader election: took over stale lease from " + holder);
+      return true;
+    } catch (const std::exception& e) {
+      // apiserver hiccup (or conflicting create): act as non-leader; a
+      // later tick retries
+      pstop::log(std::string("leader election error: ") + e.what());
+      return false;
+    }
+  }
+
+ private:
+  static constexpr const char* kName = "pst-operator-leader";
+
+  Json desired(bool acquire) const {
+    Json lease = Json::object();
+    lease["apiVersion"] = std::string("coordination.k8s.io/v1");
+    lease["kind"] = std::string("Lease");
+    lease["metadata"]["name"] = std::string(kName);
+    Json& spec = lease["spec"];
+    spec["holderIdentity"] = id_;
+    spec["leaseDurationSeconds"] = (double)lease_seconds_;
+    spec["renewTime"] = now_rfc3339_micro();
+    if (acquire) spec["acquireTime"] = now_rfc3339_micro();
+    return lease;
+  }
+
+  KubeClient& kube_;
+  std::string ns_;
+  std::string id_;
+  int lease_seconds_;
 };
 
 static Options parse_args(int argc, char** argv) {
@@ -53,6 +145,7 @@ static Options parse_args(int argc, char** argv) {
     else if (a == "--resync-seconds") o.resync_seconds = std::stoi(next());
     else if (a == "--engine-port") o.engine_port = std::stoi(next());
     else if (a == "--once") o.once = true;
+    else if (a == "--leader-elect") o.leader_elect = true;
     else if (a == "--help" || a == "-h") {
       printf(
           "production-stack-tpu operator\n"
@@ -63,7 +156,9 @@ static Options parse_args(int argc, char** argv) {
           "  --resync-seconds S   full resync interval [10]\n"
           "  --engine-port P      engine pod HTTP port for LoRA calls "
           "[8000]\n"
-          "  --once               one reconcile pass, then exit\n");
+          "  --once               one reconcile pass, then exit\n"
+          "  --leader-elect       Lease-based leader election "
+          "(multi-replica)\n");
       exit(0);
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
@@ -117,12 +212,20 @@ int main(int argc, char** argv) {
     return 0;
   }
 
+  LeaderElector elector(kube, o.ns);
+  bool was_leader = false;
   while (!g_stop) {
     auto t0 = std::chrono::steady_clock::now();
-    try {
-      reconcile_all(kube, o);
-    } catch (const std::exception& e) {
-      pstop::log(std::string("resync error: ") + e.what());
+    const bool is_leader = !o.leader_elect || elector.acquire_or_renew();
+    if (is_leader != was_leader)
+      pstop::log(is_leader ? "became leader" : "lost leadership");
+    was_leader = is_leader;
+    if (is_leader) {
+      try {
+        reconcile_all(kube, o);
+      } catch (const std::exception& e) {
+        pstop::log(std::string("resync error: ") + e.what());
+      }
     }
     // wake early on CR changes: a bounded watch doubles as the sleep
     try {

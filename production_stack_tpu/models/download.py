@@ -14,7 +14,13 @@ from __future__ import annotations
 import os
 import sys
 
-from production_stack_tpu.utils import init_logger
+try:
+    from production_stack_tpu.utils import init_logger
+except ImportError:  # standalone in the sidecar image (docker/Dockerfile.sidecar)
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+    init_logger = logging.getLogger
 
 logger = init_logger(__name__)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 
@@ -28,6 +29,9 @@ class FakeEngine:
         model_label: str | None = None,
     ):
         self.model = model
+        # stamped into responses as system_fingerprint so routing e2e tests
+        # can measure request distribution across engine pods
+        self.engine_id = os.environ.get("HOSTNAME", f"fake-{id(self):x}")
         self.tokens_per_sec = tokens_per_sec
         self.ttft_s = ttft_s
         self.num_tokens = num_tokens
@@ -50,10 +54,10 @@ class FakeEngine:
         self.port: int | None = None
 
     # -- lifecycle ---------------------------------------------------------
-    async def start(self, port: int = 0) -> str:
+    async def start(self, port: int = 0, host: str = "127.0.0.1") -> str:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        site = web.TCPSite(self._runner, host, port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         return self.url
@@ -111,6 +115,7 @@ class FakeEngine:
             if chat:
                 payload = {
                     "id": rid, "object": "chat.completion",
+                    "system_fingerprint": self.engine_id,
                     "model": self.model, "created": int(time.time()),
                     "choices": [{"index": 0, "message":
                                  {"role": "assistant", "content": text},
@@ -121,6 +126,7 @@ class FakeEngine:
             else:
                 payload = {
                     "id": rid, "object": "text_completion",
+                    "system_fingerprint": self.engine_id,
                     "model": self.model, "created": int(time.time()),
                     "choices": [{"index": 0, "text": text,
                                  "finish_reason": "length"}],
@@ -174,3 +180,35 @@ class FakeEngine:
 
     async def is_sleeping(self, request: web.Request):
         return web.json_response({"is_sleeping": self.sleeping})
+
+
+def main(argv: list | None = None) -> None:
+    """Standalone mode for k8s e2e (docker/Dockerfile.fake-engine): runs one
+    fake engine bound to 0.0.0.0 so the router's k8s pod-ip discovery and
+    routing algorithms can be exercised against a real cluster without TPUs
+    (role of the reference's src/tests/perftest/fake-openai-server.py)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="fake-engine")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--model", default="fake-model")
+    p.add_argument("--tokens-per-sec", type=float, default=1000.0)
+    p.add_argument("--ttft-s", type=float, default=0.0)
+    p.add_argument("--model-label", default=None,
+                   help="prefill/decode label for PD-disaggregation tests")
+    args = p.parse_args(argv)
+
+    async def run() -> None:
+        eng = FakeEngine(model=args.model, tokens_per_sec=args.tokens_per_sec,
+                         ttft_s=args.ttft_s, model_label=args.model_label)
+        await eng.start(port=args.port, host=args.host)
+        print(f"fake-engine {eng.engine_id} listening on "
+              f"{args.host}:{eng.port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

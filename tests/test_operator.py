@@ -383,3 +383,68 @@ def test_gateway_picker_kvaware(operator_bin):
             await ctl.stop()
 
     run_in_loop(scenario())
+
+
+def test_leader_election(operator_bin):
+    """--leader-elect: a fresh process acquires the Lease and reconciles;
+    a second process yields to a fresh foreign lease and takes over a
+    stale one (role of the reference manager's LeaderElection option,
+    reference: operator/cmd/main.go)."""
+    import signal
+    import time
+
+    def run_for(port, seconds):
+        proc = subprocess.Popen(
+            [BIN, "--leader-elect", "--resync-seconds", "1",
+             "--apiserver-host", "127.0.0.1",
+             "--apiserver-port", str(port), "--namespace", "default"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        time.sleep(seconds)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=10)
+        return out
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+
+        # 1. no lease yet: acquire + reconcile
+        api = FakeApiServer()
+        await api.start()
+        api.seed("production-stack.tpu/v1alpha1", "tpuruntimes", TPURUNTIME)
+        out = await loop.run_in_executor(None, run_for, api.port, 2.0)
+        assert "became leader" in out, out
+        leases = api.objs("coordination.k8s.io/v1", "leases")
+        assert "pst-operator-leader" in leases
+        assert leases["pst-operator-leader"]["spec"]["holderIdentity"]
+        assert "llama3-engine" in api.objs("apps/v1", "deployments")
+        await api.stop()
+
+        # 2. fresh foreign lease: stay follower, reconcile nothing
+        api = FakeApiServer()
+        await api.start()
+        api.seed("production-stack.tpu/v1alpha1", "tpuruntimes", TPURUNTIME)
+        future = time.strftime(
+            "%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime(time.time() + 300)
+        )
+        api.seed("coordination.k8s.io/v1", "leases", {
+            "metadata": {"name": "pst-operator-leader"},
+            "spec": {"holderIdentity": "other-pod-1",
+                     "leaseDurationSeconds": 30, "renewTime": future},
+        })
+        out = await loop.run_in_executor(None, run_for, api.port, 2.0)
+        assert "became leader" not in out, out
+        assert "llama3-engine" not in api.objs("apps/v1", "deployments")
+
+        # 3. stale lease: take over
+        stale = time.strftime(
+            "%Y-%m-%dT%H:%M:%S.000000Z", time.gmtime(time.time() - 300)
+        )
+        api.objs("coordination.k8s.io/v1", "leases")[
+            "pst-operator-leader"]["spec"]["renewTime"] = stale
+        out = await loop.run_in_executor(None, run_for, api.port, 2.0)
+        assert "took over stale lease from other-pod-1" in out, out
+        assert "llama3-engine" in api.objs("apps/v1", "deployments")
+        await api.stop()
+
+    run_in_loop(scenario())
