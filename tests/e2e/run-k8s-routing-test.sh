@@ -1,0 +1,181 @@
+#!/bin/bash
+# K8s e2e: install the helm chart on a kind/minikube cluster with fake
+# engines, exercise k8s pod-ip discovery + routing algorithms through the
+# real router, and reconcile a CR through the real operator binary.
+#
+# Role of the reference's tests/e2e/run-k8s-routing-test.sh (same coverage:
+# helm install, pod readiness, per-algorithm routing assertions, debug-log
+# collection, cleanup) redesigned around the fake-engine fingerprint checks
+# in tests/e2e/test_routing.py instead of router-log greps.
+#
+# Usage: tests/e2e/run-k8s-routing-test.sh <roundrobin|session|prefixaware|crds|all>
+#   --keep           leave the cluster + release up after the test
+#   --cluster NAME   kind cluster name [pst-e2e]
+#   --skip-build     images already built + loaded
+set -euo pipefail
+
+TEST_TYPE="${1:-all}"; shift || true
+CLUSTER=pst-e2e
+RELEASE=pst
+KEEP=0
+SKIP_BUILD=0
+LOCAL_PORT=30080
+RESULT_DIR=tests/e2e/k8s-results
+NUM_REQUESTS="${NUM_REQUESTS:-20}"
+
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --keep) KEEP=1 ;;
+    --cluster) CLUSTER="$2"; shift ;;
+    --skip-build) SKIP_BUILD=1 ;;
+    *) echo "unknown flag $1" >&2; exit 2 ;;
+  esac
+  shift
+done
+
+info() { echo -e "\033[0;32m[INFO]\033[0m $*"; }
+err()  { echo -e "\033[0;31m[ERROR]\033[0m $*" >&2; }
+
+for bin in docker kubectl helm kind python3; do
+  command -v "$bin" >/dev/null || { err "$bin not found"; exit 1; }
+done
+
+mkdir -p "$RESULT_DIR"
+
+cleanup() {
+  pkill -f "kubectl port-forward.*$RELEASE-router-service" 2>/dev/null || true
+  if [ "$KEEP" = 0 ]; then
+    info "cleaning up release + cluster"
+    helm uninstall "$RELEASE" 2>/dev/null || true
+    kind delete cluster --name "$CLUSTER" 2>/dev/null || true
+  fi
+}
+trap cleanup EXIT
+
+collect_debug() {
+  local tag=$1
+  mkdir -p "$RESULT_DIR/debug-$tag"
+  kubectl get pods -o wide > "$RESULT_DIR/debug-$tag/pods.txt" 2>&1 || true
+  kubectl get events --sort-by=.lastTimestamp \
+    > "$RESULT_DIR/debug-$tag/events.txt" 2>&1 || true
+  kubectl logs -l "app=$RELEASE-router" --tail=200 \
+    > "$RESULT_DIR/debug-$tag/router.log" 2>&1 || true
+  kubectl logs -l "app=$RELEASE-engine" --tail=100 \
+    > "$RESULT_DIR/debug-$tag/engines.log" 2>&1 || true
+}
+
+# ---- cluster + images -----------------------------------------------------
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+  info "creating kind cluster $CLUSTER"
+  kind create cluster --name "$CLUSTER" --wait 120s
+fi
+kubectl config use-context "kind-$CLUSTER"
+
+if [ "$SKIP_BUILD" = 0 ]; then
+  info "building images"
+  docker build -q -f docker/Dockerfile -t production-stack-tpu:ci .
+  docker build -q -f docker/Dockerfile.fake-engine -t pst-fake-engine:ci .
+  docker build -q -f docker/Dockerfile.operator \
+    -t production-stack-tpu-operator:ci .
+  kind load docker-image --name "$CLUSTER" production-stack-tpu:ci \
+    pst-fake-engine:ci production-stack-tpu-operator:ci
+fi
+
+# ---- install --------------------------------------------------------------
+info "installing chart"
+if helm list -q | grep -qx "$RELEASE"; then
+  helm upgrade "$RELEASE" ./helm -f tests/e2e/values-ci.yaml
+else
+  helm install "$RELEASE" ./helm -f tests/e2e/values-ci.yaml
+fi
+
+wait_ready() {
+  info "waiting for pods"
+  kubectl rollout status "deployment/$RELEASE-fake-engine" --timeout=180s
+  kubectl rollout status "deployment/$RELEASE-router" --timeout=180s
+  # k8s discovery needs a scrape cycle to pick the pods up
+  sleep 8
+}
+
+port_forward() {
+  pkill -f "kubectl port-forward.*$RELEASE-router-service" 2>/dev/null || true
+  sleep 1
+  kubectl port-forward "svc/$RELEASE-router-service" "$LOCAL_PORT:80" \
+    >/dev/null 2>&1 &
+  for _ in $(seq 30); do
+    curl -sf "http://localhost:$LOCAL_PORT/health" >/dev/null && return 0
+    sleep 1
+  done
+  err "router port-forward failed"; return 1
+}
+
+run_routing() {
+  local logic=$1; shift
+  info "=== routing test: $logic ==="
+  helm upgrade "$RELEASE" ./helm -f tests/e2e/values-ci.yaml \
+    --set "routerSpec.routingLogic=$logic" "$@"
+  kubectl rollout status "deployment/$RELEASE-router" --timeout=180s
+  sleep 8   # discovery scrape
+  port_forward
+  if python3 tests/e2e/test_routing.py \
+      --router-url "http://localhost:$LOCAL_PORT" \
+      --routing-logic "$logic" --num-requests "$NUM_REQUESTS"; then
+    info "$logic PASSED"
+  else
+    err "$logic FAILED"; collect_debug "$logic"; exit 1
+  fi
+}
+
+run_crds() {
+  info "=== CRD reconcile test (operator) ==="
+  helm upgrade "$RELEASE" ./helm -f tests/e2e/values-ci.yaml \
+    --set operatorSpec.enabled=true \
+    --set operatorSpec.image.repository=production-stack-tpu-operator \
+    --set operatorSpec.image.tag=ci
+  kubectl rollout status "deployment/$RELEASE-operator" --timeout=180s
+  kubectl apply -f - <<EOF
+apiVersion: production-stack.tpu/v1alpha1
+kind: TPURouter
+metadata:
+  name: e2e-router
+spec:
+  replicas: 1
+  image:
+    repository: production-stack-tpu
+    tag: ci
+  port: 8001
+  routingLogic: roundrobin
+  serviceDiscovery: k8s
+EOF
+  info "waiting for operator to reconcile TPURouter -> Deployment"
+  for _ in $(seq 60); do
+    kubectl get deployment e2e-router-router >/dev/null 2>&1 && break
+    sleep 2
+  done
+  kubectl get deployment e2e-router-router >/dev/null 2>&1 || {
+    err "operator never created e2e-router-router"
+    collect_debug crds; exit 1
+  }
+  kubectl delete tpurouter e2e-router
+  for _ in $(seq 30); do
+    kubectl get deployment e2e-router-router >/dev/null 2>&1 || break
+    sleep 2
+  done
+  info "crds PASSED"
+}
+
+wait_ready
+case "$TEST_TYPE" in
+  roundrobin)  run_routing roundrobin ;;
+  session)     run_routing session --set routerSpec.sessionKey=x-user-id ;;
+  prefixaware) run_routing prefixaware ;;
+  crds)        run_crds ;;
+  all)
+    run_routing roundrobin
+    run_routing session --set routerSpec.sessionKey=x-user-id
+    run_routing prefixaware
+    run_crds
+    ;;
+  *) err "unknown test type $TEST_TYPE"; exit 2 ;;
+esac
+info "ALL TESTS PASSED"

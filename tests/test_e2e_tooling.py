@@ -1,0 +1,124 @@
+"""The k8s e2e tooling, exercised locally: the routing checker
+(tests/e2e/test_routing.py) must pass against a real router + live fake
+engines for every algorithm it covers, so the kind/minikube job
+(tests/e2e/run-k8s-routing-test.sh) only adds the cluster layer on top of
+logic already proven here. Role of the reference's
+tests/e2e/run-static-discovery-routing-test.sh + test-routing.py pair."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib.util
+import subprocess
+import sys
+
+import pytest
+from aiohttp import web
+
+sys.path.insert(0, "/root/repo/tests")
+from fake_engine import FakeEngine  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "e2e_test_routing", "/root/repo/tests/e2e/test_routing.py"
+)
+e2e = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(e2e)
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    from production_stack_tpu.router.routing_logic import (
+        _reset_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        _reset_service_discovery,
+    )
+
+    _reset_routing_logic()
+    _reset_service_discovery()
+
+
+async def _start_router(routing: str, engines, extra=()):
+    from production_stack_tpu.router import parsers
+    from production_stack_tpu.router.app import build_app
+
+    argv = [
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", ",".join("fake-model" for _ in engines),
+        "--routing-logic", routing,
+        "--engine-stats-interval", "0.2",
+        *extra,
+    ]
+    ra = build_app(parsers.parse_args(argv))
+    runner = web.AppRunner(ra.app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def _checker_args(url: str, logic: str) -> argparse.Namespace:
+    return argparse.Namespace(
+        router_url=url, routing_logic=logic, model="fake-model",
+        num_requests=12, min_engines=2, session_key="x-user-id",
+        prefix_chunk_size=128,  # the router's PrefixAwareRouter default
+    )
+
+
+def _run(logic: str, extra=()):
+    async def scenario():
+        engines = [FakeEngine(model="fake-model") for _ in range(2)]
+        for e in engines:
+            await e.start()
+        runner, url = await _start_router(logic, engines, extra)
+        try:
+            # the checker is synchronous urllib; push it off the loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, e2e.CHECKS[logic], _checker_args(url, logic)
+            )
+        finally:
+            await runner.cleanup()
+            for e in engines:
+                await e.stop()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_checker_roundrobin(reset_singletons):
+    _run("roundrobin")
+
+
+def test_checker_session(reset_singletons):
+    _run("session", extra=["--session-key", "x-user-id"])
+
+
+def test_checker_prefixaware(reset_singletons):
+    _run("prefixaware")
+
+
+def test_k8s_script_is_valid_bash():
+    subprocess.run(
+        ["bash", "-n", "/root/repo/tests/e2e/run-k8s-routing-test.sh"],
+        check=True,
+    )
+
+
+def test_ci_values_match_chart():
+    """values-ci.yaml must parse and reference deployments the script
+    waits on (names derive from release + modelSpec name)."""
+    import yaml
+
+    with open("/root/repo/tests/e2e/values-ci.yaml") as f:
+        vals = yaml.safe_load(f)
+    ms = vals["servingEngineSpec"]["modelSpec"][0]
+    assert ms["cpuOnly"] is True
+    assert ms["command"][0] == "python"
+    with open("/root/repo/tests/e2e/run-k8s-routing-test.sh") as f:
+        script = f.read()
+    # script waits on $RELEASE-<msname>-engine and $RELEASE-router
+    assert f"-{ms['name']}-engine" in script
+    assert "-router" in script
