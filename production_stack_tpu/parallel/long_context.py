@@ -1,0 +1,172 @@
+"""Long-context prefill: one full prompt, sequence-sharded over the mesh.
+
+Serving role (reference parity): the reference stack's long-context story
+is disaggregated prefill + KV streaming (LMCache/NIXL); its prefill pod
+still has to FIT the prompt on one GPU's HBM. This module removes that
+ceiling the TPU way: activations and KV for a single long prompt are
+sharded over an `sp` mesh axis, attention runs as a ring
+(parallel/ring_attention.py), and max prompt length scales linearly with
+the ring size. The output KV (layer-stacked, sequence-major) feeds either
+the local paged cache or the disaggregated-prefill transfer chain
+(kv/transfer.py) exactly like chunked-prefill KV does.
+
+Composes with tensor parallelism on a 2D ("tp", "sp") mesh: weights stay
+Megatron-sharded over tp (parallel/sharding.py), the sequence over sp,
+and the ring only moves kv-head-width blocks over ICI.
+
+Scope: dense Llama-family decoders, batch=1 (a long prompt is the whole
+batch), no LoRA (adapters target short interactive traffic; chunked
+prefill serves them).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.layers import (
+    apply_rope,
+    rms_norm,
+    rope_cos_sin,
+    swiglu,
+)
+from production_stack_tpu.parallel.ring_attention import (
+    ring_attention_local,
+)
+from production_stack_tpu.parallel import sharding as sharding_rules
+
+SP_AXIS = "sp"
+
+
+def make_sp_mesh(tp_size: int, sp_size: int, devices=None) -> Mesh:
+    """("tp", "sp") mesh: heads over tp, sequence over sp."""
+    import numpy as np
+
+    devs = devices if devices is not None else jax.devices()
+    need = tp_size * sp_size
+    if need > len(devs):
+        raise ValueError(f"tp*sp={need} > available devices {len(devs)}")
+    return Mesh(
+        np.asarray(devs[:need]).reshape(tp_size, sp_size), ("tp", SP_AXIS)
+    )
+
+
+def _forward(cfg: ModelConfig, params: dict, token_ids: jax.Array,
+             last: jax.Array, mesh: Mesh
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Full-prompt forward. token_ids: (S,), S divisible by sp size;
+    `last` is the row of the final REAL token (padding sits after it).
+
+    Returns (that row's logits (V,) f32, k (L, S, nkv, d), v likewise).
+    """
+    S = token_ids.shape[0]
+    dtype = params["embed"].dtype
+    scale = cfg.head_dim**-0.5
+    has_tp = "tp" in mesh.axis_names and mesh.shape["tp"] > 1
+    seq = NamedSharding(mesh, P(SP_AXIS, None))
+    heads = NamedSharding(
+        mesh,
+        P(SP_AXIS, "tp", None) if has_tp else P(SP_AXIS, None, None),
+    )
+    constrain = jax.lax.with_sharding_constraint
+
+    positions = jnp.arange(S, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    h = constrain(params["embed"][token_ids].astype(dtype), seq)
+
+    ring = functools.partial(ring_attention_local, axis_name=SP_AXIS,
+                             causal=True, scale=scale)
+    spec4 = (P(None, SP_AXIS, "tp", None) if has_tp
+             else P(None, SP_AXIS, None, None))
+    ring_sharded = jax.shard_map(
+        ring, mesh=mesh, in_specs=(spec4, spec4, spec4), out_specs=spec4,
+    )
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+
+        def proj(x, target, bias):
+            out = jnp.dot(x, lp[target],
+                          preferred_element_type=jnp.float32)
+            if bias is not None:
+                out = out + bias.astype(jnp.float32)
+            return out
+
+        q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
+        k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
+        v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
+        q = q.astype(dtype).reshape(S, cfg.num_heads, cfg.head_dim)
+        k = k.astype(dtype).reshape(S, cfg.num_kv_heads, cfg.head_dim)
+        v = v.astype(dtype).reshape(S, cfg.num_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, k, cos, sin)
+        q, k, v = (constrain(t, heads) for t in (q, k, v))
+
+        attn = ring_sharded(q[None], k[None], v[None])[0]  # (S, nh, d)
+        h = h + proj(
+            attn.reshape(S, cfg.q_size).astype(dtype), "wo", None
+        ).astype(dtype)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return constrain(h, seq), (k, v)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+
+    h_last = rms_norm(h[last], params["final_norm"], cfg.rms_norm_eps)
+    lm_head = (params["embed"].T if cfg.tie_word_embeddings
+               else params["lm_head"])
+    logits = jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
+    return logits, ks, vs
+
+
+class LongContextPrefiller:
+    """Jitted sequence-parallel prefill over a fixed mesh.
+
+    Pad prompts to a multiple of the sp size (use `pad_to`); KV rows for
+    the padding are garbage and must be dropped by the caller — token
+    count is returned alongside so downstream paged-cache insertion
+    (engine) or PD transfer (kv/transfer.py) slices `k[:, :n]`.
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
+        if SP_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh must carry an '{SP_AXIS}' axis")
+        if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+            sharding_rules.validate_tp(cfg, mesh.shape["tp"])
+            params = jax.device_put(
+                params, sharding_rules.param_shardings(mesh, cfg)
+            )
+        else:
+            params = jax.device_put(
+                params,
+                jax.tree.map(lambda _: NamedSharding(mesh, P()), params),
+            )
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.sp = mesh.shape[SP_AXIS]
+        kv_spec = NamedSharding(mesh, P(None, SP_AXIS, None, None))
+        rep = NamedSharding(mesh, P())
+        self._fn = jax.jit(
+            functools.partial(_forward, cfg, mesh=mesh),
+            out_shardings=(rep, kv_spec, kv_spec),
+        )
+
+    def pad_to(self, n: int) -> int:
+        return -(-n // self.sp) * self.sp
+
+    def prefill(self, token_ids) -> tuple[jax.Array, jax.Array, jax.Array, int]:
+        """token_ids: list/array of ints. Returns (logits, k, v, n) with
+        k/v (L, S_pad, nkv, d) sp-sharded; rows >= n are padding."""
+        n = len(token_ids)
+        S = self.pad_to(n)
+        ids = jnp.zeros((S,), jnp.int32).at[:n].set(
+            jnp.asarray(token_ids, jnp.int32)
+        )
+        logits, k, v = self._fn(
+            self.params, ids, jnp.asarray(n - 1, jnp.int32)
+        )
+        return logits, k, v, n

@@ -63,6 +63,9 @@ collect_debug() {
   kubectl logs -l "app=$RELEASE-engine" --tail=100 \
     > "$RESULT_DIR/debug-$tag/engines.log" 2>&1 || true
 }
+# set -e aborts on pod-readiness / port-forward failures before the
+# per-test debug hooks run; make sure CI still gets artifacts
+trap 'collect_debug "err-line-$LINENO"' ERR
 
 # ---- cluster + images -----------------------------------------------------
 if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then

@@ -27,11 +27,14 @@ class FakeEngine:
         ttft_s: float = 0.0,
         num_tokens: int = 8,
         model_label: str | None = None,
+        engine_id: str | None = None,
     ):
         self.model = model
         # stamped into responses as system_fingerprint so routing e2e tests
-        # can measure request distribution across engine pods
-        self.engine_id = os.environ.get("HOSTNAME", f"fake-{id(self):x}")
+        # can measure request distribution; unique per instance by default
+        # (in-process tests may share one HOSTNAME), pod hostname in the
+        # standalone k8s mode (see main())
+        self.engine_id = engine_id or f"fake-{id(self):x}"
         self.tokens_per_sec = tokens_per_sec
         self.ttft_s = ttft_s
         self.num_tokens = num_tokens
@@ -201,7 +204,8 @@ def main(argv: list | None = None) -> None:
 
     async def run() -> None:
         eng = FakeEngine(model=args.model, tokens_per_sec=args.tokens_per_sec,
-                         ttft_s=args.ttft_s, model_label=args.model_label)
+                         ttft_s=args.ttft_s, model_label=args.model_label,
+                         engine_id=os.environ.get("HOSTNAME"))
         await eng.start(port=args.port, host=args.host)
         print(f"fake-engine {eng.engine_id} listening on "
               f"{args.host}:{eng.port}", flush=True)

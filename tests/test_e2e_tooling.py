@@ -10,17 +10,20 @@ from __future__ import annotations
 import argparse
 import asyncio
 import importlib.util
+import os
 import subprocess
 import sys
 
 import pytest
 from aiohttp import web
 
-sys.path.insert(0, "/root/repo/tests")
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+sys.path.insert(0, TESTS_DIR)
 from fake_engine import FakeEngine  # noqa: E402
 
 _spec = importlib.util.spec_from_file_location(
-    "e2e_test_routing", "/root/repo/tests/e2e/test_routing.py"
+    "e2e_test_routing", os.path.join(TESTS_DIR, "e2e", "test_routing.py")
 )
 e2e = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(e2e)
@@ -102,7 +105,7 @@ def test_checker_prefixaware(reset_singletons):
 
 def test_k8s_script_is_valid_bash():
     subprocess.run(
-        ["bash", "-n", "/root/repo/tests/e2e/run-k8s-routing-test.sh"],
+        ["bash", "-n", os.path.join(TESTS_DIR, "e2e", "run-k8s-routing-test.sh")],
         check=True,
     )
 
@@ -112,12 +115,12 @@ def test_ci_values_match_chart():
     waits on (names derive from release + modelSpec name)."""
     import yaml
 
-    with open("/root/repo/tests/e2e/values-ci.yaml") as f:
+    with open(os.path.join(TESTS_DIR, "e2e", "values-ci.yaml")) as f:
         vals = yaml.safe_load(f)
     ms = vals["servingEngineSpec"]["modelSpec"][0]
     assert ms["cpuOnly"] is True
     assert ms["command"][0] == "python"
-    with open("/root/repo/tests/e2e/run-k8s-routing-test.sh") as f:
+    with open(os.path.join(TESTS_DIR, "e2e", "run-k8s-routing-test.sh")) as f:
         script = f.read()
     # script waits on $RELEASE-<msname>-engine and $RELEASE-router
     assert f"-{ms['name']}-engine" in script

@@ -9,7 +9,8 @@ import re
 
 import yaml
 
-HELM = "/root/repo/helm"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELM = os.path.join(REPO, "helm")
 
 
 def test_chart_and_values_parse():
@@ -80,7 +81,7 @@ def test_operator_template_consumes_operator_spec():
     assert ".Values.operatorSpec.enabled" in text
     assert ".Values.operatorSpec.image.repository" in text
     # every --flag the template emits is parsed by operator/src/main.cpp
-    with open("/root/repo/operator/src/main.cpp") as f:
+    with open(os.path.join(REPO, "operator", "src", "main.cpp")) as f:
         cpp = f.read()
     for flag in re.findall(r'"(--[a-z][a-z0-9-]*)"', text):
         assert f'"{flag}"' in cpp, f"template emits unknown flag {flag}"
@@ -93,7 +94,7 @@ def test_helm_crds_match_operator_crds():
     not drift from the canonical operator/config/crd/crds.yaml."""
     with open(f"{HELM}/crds/crds.yaml") as f:
         chart_crds = f.read()
-    with open("/root/repo/operator/config/crd/crds.yaml") as f:
+    with open(os.path.join(REPO, "operator", "config", "crd", "crds.yaml")) as f:
         op_crds = f.read()
     assert chart_crds == op_crds
 
@@ -115,17 +116,17 @@ def test_dockerfiles_reference_real_paths():
     console scripts they invoke must be defined in pyproject.toml."""
     import glob
 
-    with open("/root/repo/pyproject.toml") as f:
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
         pyproject = f.read()
     for script in ("pst-router", "pst-engine", "pst-cache-server",
                    "pst-download"):
         assert script in pyproject
-    for df in glob.glob("/root/repo/docker/Dockerfile*"):
+    for df in glob.glob(os.path.join(REPO, "docker", "Dockerfile*")):
         with open(df) as f:
             for line in f:
                 if line.startswith("COPY") and "--from" not in line:
                     src = line.split()[1]
-                    assert os.path.exists(f"/root/repo/{src}"), (
+                    assert os.path.exists(os.path.join(REPO, src)), (
                         f"{df}: COPY source {src} missing"
                     )
 
@@ -134,7 +135,7 @@ def test_pyproject_console_scripts_resolve():
     """Each [project.scripts] entry must import and be callable."""
     import importlib
 
-    with open("/root/repo/pyproject.toml") as f:
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
         text = f.read()
     block = text.split("[project.scripts]")[1].split("[")[0]
     for line in block.strip().splitlines():

@@ -6,6 +6,7 @@ binary, assert the Deployments/Services/status it produced."""
 from __future__ import annotations
 
 import asyncio
+import os
 import json
 import subprocess
 import threading
@@ -13,7 +14,9 @@ import threading
 import pytest
 from aiohttp import web
 
-OPERATOR_DIR = "/root/repo/operator"
+OPERATOR_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "operator"
+)
 BIN = f"{OPERATOR_DIR}/build/pst-operator"
 
 
