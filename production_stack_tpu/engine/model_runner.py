@@ -54,6 +54,18 @@ class ModelRunner:
         self.max_model_len = config.resolved_max_model_len()
 
         mc = self.model_config
+        if mc.is_moe and mc.moe_capacity_factor > 0:
+            # serving steps pad decode lanes / prefill buckets, and the
+            # GShard capacity path has no per-row validity inside
+            # llama.forward — padded rows would steal expert capacity
+            # from real tokens (ops/moe.py:moe_capacity). Serving always
+            # uses the exact dense path; the capacity path is for
+            # offline/bulk callers that manage their own padding.
+            raise ValueError(
+                f"model {mc.name}: moe_capacity_factor="
+                f"{mc.moe_capacity_factor} is not servable; the engine "
+                "requires the exact dense MoE path (capacity_factor=0)"
+            )
         tp = config.tensor_parallel_size
         if mesh is None and tp > 1:
             mesh = sharding_rules.make_mesh(tp)

@@ -33,6 +33,16 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention bias
+    # MoE (Mixtral family): 0 experts = dense MLP. capacity_factor 0
+    # selects the exact all-experts einsum path; > 0 the GShard
+    # static-capacity dispatch (ops/moe.py)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 0.0
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @property
     def q_size(self) -> int:
@@ -45,11 +55,14 @@ class ModelConfig:
     def num_params(self) -> int:
         """Approximate parameter count (for memory budgeting)."""
         h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        mlp = 3 * h * i * max(1, self.num_experts)
+        if self.is_moe:
+            mlp += h * self.num_experts  # router
         per_layer = (
             h * self.q_size
             + 2 * h * self.kv_size
             + self.q_size * h
-            + 3 * h * i
+            + mlp
             + 2 * h
         )
         embed = v * h * (1 if self.tie_word_embeddings else 2)
@@ -80,6 +93,16 @@ TINY_DEBUG = _register(
         max_model_len=256,
         rope_theta=10000.0,
         tie_word_embeddings=True,
+    )
+)
+
+TINY_MOE_DEBUG = _register(
+    dataclasses.replace(
+        TINY_DEBUG,
+        name="pst-tiny-moe-debug",
+        num_kv_heads=4,  # ep tests shard experts one-per-chip at tp=4
+        num_experts=4,
+        num_experts_per_tok=2,
     )
 )
 
@@ -182,6 +205,23 @@ QWEN2_7B = _register(
     )
 )
 
+MIXTRAL_8X7B = _register(
+    ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_model_len=32768,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+    )
+)
+
 
 def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
     """Build a ModelConfig from a HuggingFace `config.json` on local disk."""
@@ -192,6 +232,7 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         "LlamaForCausalLM",
         "MistralForCausalLM",
         "Qwen2ForCausalLM",
+        "MixtralForCausalLM",
     ):
         raise ValueError(f"unsupported architecture {arch!r} at {path}")
     num_heads = hf["num_attention_heads"]
@@ -210,6 +251,8 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
         qkv_bias=(arch == "Qwen2ForCausalLM"),
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
     )
 
 

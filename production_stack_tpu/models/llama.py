@@ -28,6 +28,7 @@ from production_stack_tpu.ops.layers import (
     rope_cos_sin,
     swiglu,
 )
+from production_stack_tpu.ops.moe import moe_block
 
 # attn_fn(q_rope, layer_idx, k_cache, v_cache) -> attn_out
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], jax.Array]
@@ -54,10 +55,17 @@ def init_params(
         "wk": w(next(keys), (L, h, cfg.kv_size), h),
         "wv": w(next(keys), (L, h, cfg.kv_size), h),
         "wo": w(next(keys), (L, cfg.q_size, h), cfg.q_size),
-        "w_gate": w(next(keys), (L, h, i), h),
-        "w_up": w(next(keys), (L, h, i), h),
-        "w_down": w(next(keys), (L, i, h), i),
     }
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["moe_gate"] = w(next(keys), (L, h, E), h)
+        layers["w_gate"] = w(next(keys), (L, E, h, i), h)
+        layers["w_up"] = w(next(keys), (L, E, h, i), h)
+        layers["w_down"] = w(next(keys), (L, E, i, h), i)
+    else:
+        layers["w_gate"] = w(next(keys), (L, h, i), h)
+        layers["w_up"] = w(next(keys), (L, h, i), h)
+        layers["w_down"] = w(next(keys), (L, i, h), i)
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, cfg.q_size), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_size), dtype)
@@ -170,7 +178,14 @@ def forward(
         ).astype(dtype)
 
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        if cfg.is_moe:
+            h = h + moe_block(
+                x, lp["moe_gate"], lp["w_gate"], lp["w_up"],
+                lp["w_down"], cfg.num_experts_per_tok,
+                cfg.moe_capacity_factor,
+            ).astype(dtype)
+        else:
+            h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
         return (h, kc, vc), None
 
     xs = (

@@ -104,10 +104,18 @@ def load_hf_weights(
         "wk": alloc((L, h, cfg.kv_size)),
         "wv": alloc((L, h, cfg.kv_size)),
         "wo": alloc((L, cfg.q_size, h)),
-        "w_gate": alloc((L, h, cfg.intermediate_size)),
-        "w_up": alloc((L, h, cfg.intermediate_size)),
-        "w_down": alloc((L, cfg.intermediate_size, h)),
     }
+    i_sz = cfg.intermediate_size
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["moe_gate"] = alloc((L, h, E))
+        layers["w_gate"] = alloc((L, E, h, i_sz))
+        layers["w_up"] = alloc((L, E, h, i_sz))
+        layers["w_down"] = alloc((L, E, i_sz, h))
+    else:
+        layers["w_gate"] = alloc((L, h, i_sz))
+        layers["w_up"] = alloc((L, h, i_sz))
+        layers["w_down"] = alloc((L, i_sz, h))
     if cfg.qkv_bias:
         layers["bq"] = alloc((L, cfg.q_size))
         layers["bk"] = alloc((L, cfg.kv_size))
@@ -148,6 +156,28 @@ def load_hf_weights(
             continue
         _, idx, *rest = key.split(".", 2)
         suffix = rest[0]
+        # Mixtral MoE block (HF MixtralSparseMoeBlock):
+        #   block_sparse_moe.gate.weight            [E, h]
+        #   block_sparse_moe.experts.{e}.w1.weight  [f, h] -> w_gate
+        #   block_sparse_moe.experts.{e}.w3.weight  [f, h] -> w_up
+        #   block_sparse_moe.experts.{e}.w2.weight  [h, f] -> w_down
+        if cfg.is_moe and suffix.startswith("block_sparse_moe."):
+            arr = np.asarray(tensor, np.float32)
+            if suffix == "block_sparse_moe.gate.weight":
+                layers["moe_gate"][int(idx)] = arr.T.astype(np_dtype)
+                n_loaded += 1
+                continue
+            parts = suffix.split(".")  # [...,'experts', e, w1, 'weight']
+            if len(parts) == 5 and parts[1] == "experts":
+                ours = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}.get(
+                    parts[3]
+                )
+                if ours is not None:
+                    layers[ours][int(idx), int(parts[2])] = arr.T.astype(
+                        np_dtype
+                    )
+                    n_loaded += 1
+            continue
         mapping = per_layer.get(suffix)
         if mapping is None:
             continue
@@ -164,9 +194,16 @@ def load_hf_weights(
         raise ValueError(f"checkpoint at {model_dir} has no embed_tokens")
     # completeness: a partial shard set must never load as zero-filled
     # layers (n per-layer tensors + embed + final_norm [+ lm_head])
-    expected = L * len(
-        [k for k, (ours, _) in per_layer.items() if ours in layers]
-    ) + 2 + (0 if cfg.tie_word_embeddings else 1)
+    dense_mlp = {"w_gate", "w_up", "w_down"}
+    per_layer_count = len([
+        k for k, (ours, _) in per_layer.items()
+        if ours in layers and not (cfg.is_moe and ours in dense_mlp)
+    ])
+    if cfg.is_moe:
+        per_layer_count += 1 + 3 * cfg.num_experts  # router + experts
+    expected = (
+        L * per_layer_count + 2 + (0 if cfg.tie_word_embeddings else 1)
+    )
     if n_loaded < expected:
         raise ValueError(
             f"checkpoint at {model_dir} is incomplete: loaded {n_loaded} "

@@ -1,0 +1,135 @@
+"""Mixture-of-experts ops: top-k gating + two MXU-friendly compute paths.
+
+Role parity: the reference stack serves Mixtral-class MoE models through
+vLLM's fused-MoE CUDA kernels (grouped GEMM over expert-sorted tokens).
+The TPU-native equivalents here are einsum formulations XLA tiles onto
+the MXU, chosen per batch regime:
+
+- `moe_dense` — "dropless dense": every token runs every expert as ONE
+  batched einsum [n,d]x[E,d,f], weighted by the sparse gate matrix.
+  Exact (no token dropping), no gather/scatter, no load-balance concern.
+  FLOP cost is E/k x the routed ideal, which is the right trade at
+  serving batch sizes: decode batches (n <= max_num_seqs) and prefill
+  chunks are far too small to amortize a dispatch permutation, while the
+  single dense einsum keeps the MXU at full tilt (MaxText makes the same
+  call for small batches via capacity_factor=-1).
+
+- `moe_capacity` — GShard-style static dispatch for LARGE token counts:
+  each expert gets a fixed-capacity [E, C, d] slice gathered by one-hot
+  einsums (static shapes; no dynamic control flow under jit). Tokens
+  over an expert's capacity are dropped (classic GShard semantics) —
+  callers pick the capacity factor; `capacity_needed` reports the
+  no-drop bound for a gate matrix. With expert weights sharded over the
+  mesh ("ep"), XLA lowers dispatch/combine into all_to_alls over ICI —
+  expert parallelism without a single hand-written collective.
+
+Gating follows Mixtral semantics (HF MixtralSparseMoeBlock): softmax over
+the top-k logits only, renormalized.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k_gating(x: jax.Array, gate_w: jax.Array, k: int) -> jax.Array:
+    """x [n,d] @ gate_w [d,E] -> sparse gates [n,E] f32, rows sum to 1
+    over each token's top-k experts, zero elsewhere."""
+    n = x.shape[0]
+    logits = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
+    top_v, top_i = lax.top_k(logits, k)  # [n,k]
+    probs = jax.nn.softmax(top_v, axis=-1)
+    gates = jnp.zeros_like(logits)
+    return gates.at[jnp.arange(n)[:, None], top_i].set(probs)
+
+
+def moe_dense(
+    x: jax.Array,
+    gates: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+) -> jax.Array:
+    """Exact all-experts path. x [n,d]; w_gate/w_up [E,d,f]; w_down
+    [E,f,d]; gates [n,E]. Returns [n,d] f32."""
+    g = jnp.einsum("nd,edf->nef", x, w_gate,
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("nd,edf->nef", x, w_up,
+                   preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(g) * u).astype(x.dtype)
+    y = jnp.einsum("nef,efd->ned", a, w_down,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("ned,ne->nd", y, gates)
+
+
+def capacity_needed(gates: jax.Array) -> jax.Array:
+    """Max tokens routed to any one expert (the no-drop capacity)."""
+    return (gates > 0).sum(axis=0).max()
+
+
+def moe_capacity(
+    x: jax.Array,
+    gates: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    capacity: int,
+    valid: jax.Array | None = None,
+) -> jax.Array:
+    """GShard static-capacity path; tokens beyond `capacity` per expert
+    are dropped (their combine weight is zero, so they contribute their
+    residual stream unchanged). Shapes as in moe_dense; capacity static.
+
+    `valid` ([n] bool): rows that are real tokens. Padding/idle-lane rows
+    MUST be masked out here — unlike the dense path (where garbage rows
+    only produce garbage outputs that the caller discards), a padded row
+    would otherwise consume expert capacity slots ahead of real tokens
+    and silently drop their expert outputs."""
+    n, E = gates.shape
+    if valid is not None:
+        gates = gates * valid[:, None].astype(gates.dtype)
+    mask = gates > 0
+    # rank of each token within its expert's arrival order
+    pos = jnp.cumsum(mask.astype(jnp.int32), axis=0) - 1  # [n,E]
+    keep = mask & (pos < capacity)
+    # dispatch [n,E,C]: one-hot of pos where kept
+    disp = keep[..., None] & (
+        pos[..., None] == jnp.arange(capacity)[None, None, :]
+    )
+    disp_f = disp.astype(x.dtype)
+    xe = jnp.einsum("nec,nd->ecd", disp_f, x)  # [E,C,d]
+    g = jnp.einsum("ecd,edf->ecf", xe, w_gate,
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("ecd,edf->ecf", xe, w_up,
+                   preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(g) * u).astype(x.dtype)
+    ye = jnp.einsum("ecf,efd->ecd", a, w_down,
+                    preferred_element_type=jnp.float32)
+    comb = disp_f * gates[..., None]  # [n,E,C]
+    return jnp.einsum("nec,ecd->nd", comb, ye)
+
+
+def moe_block(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    num_experts_per_tok: int,
+    capacity_factor: float = 0.0,
+    valid: jax.Array | None = None,
+) -> jax.Array:
+    """Full MoE MLP block: gate + compute. capacity_factor 0 selects the
+    exact dense path (serving default); > 0 selects GShard dispatch with
+    C = ceil(k * n * factor / E) — bulk/offline callers only, and they
+    must pass `valid` when rows include padding (see moe_capacity)."""
+    gates = top_k_gating(x, gate_w, num_experts_per_tok)
+    if capacity_factor <= 0:
+        out = moe_dense(x, gates, w_gate, w_up, w_down)
+    else:
+        n, E = gates.shape
+        cap = max(1, int(-(-num_experts_per_tok * n * capacity_factor // E)))
+        out = moe_capacity(x, gates, w_gate, w_up, w_down, cap, valid)
+    return out

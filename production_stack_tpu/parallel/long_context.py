@@ -10,13 +10,23 @@ the ring size. The output KV (layer-stacked, sequence-major) feeds either
 the local paged cache or the disaggregated-prefill transfer chain
 (kv/transfer.py) exactly like chunked-prefill KV does.
 
+The model math is NOT re-implemented here: the forward is
+models/llama.forward — the same function serving uses — with the ring
+supplied through its `attn_fn` extension point and a full-sequence
+"cache" (slots 0..S-1) standing in for the paged one, so every model
+feature (qkv bias, MoE blocks, future changes) has exactly one
+implementation. Only the sharding is this module's business: the KV
+cache is pinned to P(None, sp, None, None) via jit out_shardings, and
+the ring's shard_map in_specs re-anchor q/k/v to the sp layout at every
+layer, which is what keeps XLA from gathering the sequence anywhere.
+
 Composes with tensor parallelism on a 2D ("tp", "sp") mesh: weights stay
 Megatron-sharded over tp (parallel/sharding.py), the sequence over sp,
 and the ring only moves kv-head-width blocks over ICI.
 
-Scope: dense Llama-family decoders, batch=1 (a long prompt is the whole
-batch), no LoRA (adapters target short interactive traffic; chunked
-prefill serves them).
+Scope: Llama-family decoders (dense and MoE/Mixtral), batch=1 (a long
+prompt is the whole batch), no LoRA (adapters target short interactive
+traffic; chunked prefill serves them).
 """
 
 from __future__ import annotations
@@ -27,13 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from production_stack_tpu.models import llama
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.ops.layers import (
-    apply_rope,
-    rms_norm,
-    rope_cos_sin,
-    swiglu,
-)
 from production_stack_tpu.parallel.ring_attention import (
     ring_attention_local,
 )
@@ -58,68 +63,37 @@ def make_sp_mesh(tp_size: int, sp_size: int, devices=None) -> Mesh:
 def _forward(cfg: ModelConfig, params: dict, token_ids: jax.Array,
              last: jax.Array, mesh: Mesh
              ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Full-prompt forward. token_ids: (S,), S divisible by sp size;
-    `last` is the row of the final REAL token (padding sits after it).
+    """Full-prompt forward via llama.forward + ring attn_fn.
 
-    Returns (that row's logits (V,) f32, k (L, S, nkv, d), v likewise).
+    token_ids: (S,), S divisible by sp size; `last` is the row of the
+    final REAL token (padding sits after it). Returns (that row's logits
+    (V,) f32, k (L, S, nkv, d), v likewise).
     """
     S = token_ids.shape[0]
-    dtype = params["embed"].dtype
-    scale = cfg.head_dim**-0.5
     has_tp = "tp" in mesh.axis_names and mesh.shape["tp"] > 1
-    seq = NamedSharding(mesh, P(SP_AXIS, None))
-    heads = NamedSharding(
-        mesh,
-        P(SP_AXIS, "tp", None) if has_tp else P(SP_AXIS, None, None),
-    )
-    constrain = jax.lax.with_sharding_constraint
-
-    positions = jnp.arange(S, dtype=jnp.int32)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
-    h = constrain(params["embed"][token_ids].astype(dtype), seq)
-
-    ring = functools.partial(ring_attention_local, axis_name=SP_AXIS,
-                             causal=True, scale=scale)
     spec4 = (P(None, SP_AXIS, "tp", None) if has_tp
              else P(None, SP_AXIS, None, None))
-    ring_sharded = jax.shard_map(
-        ring, mesh=mesh, in_specs=(spec4, spec4, spec4), out_specs=spec4,
+    ring = jax.shard_map(
+        functools.partial(
+            ring_attention_local, axis_name=SP_AXIS, causal=True,
+            scale=llama.attention_scale(cfg),
+        ),
+        mesh=mesh, in_specs=(spec4, spec4, spec4), out_specs=spec4,
     )
 
-    def layer(h, lp):
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    def attn_fn(q, layer, kc, vc):
+        # the full-sequence cache rows ARE the sequence: ring over them
+        return ring(q[None], kc[layer][None], vc[layer][None])[0]
 
-        def proj(x, target, bias):
-            out = jnp.dot(x, lp[target],
-                          preferred_element_type=jnp.float32)
-            if bias is not None:
-                out = out + bias.astype(jnp.float32)
-            return out
-
-        q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
-        k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
-        v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
-        q = q.astype(dtype).reshape(S, cfg.num_heads, cfg.head_dim)
-        k = k.astype(dtype).reshape(S, cfg.num_kv_heads, cfg.head_dim)
-        v = v.astype(dtype).reshape(S, cfg.num_kv_heads, cfg.head_dim)
-        q, k = apply_rope(q, k, cos, sin)
-        q, k, v = (constrain(t, heads) for t in (q, k, v))
-
-        attn = ring_sharded(q[None], k[None], v[None])[0]  # (S, nh, d)
-        h = h + proj(
-            attn.reshape(S, cfg.q_size).astype(dtype), "wo", None
-        ).astype(dtype)
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return constrain(h, seq), (k, v)
-
-    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
-
-    h_last = rms_norm(h[last], params["final_norm"], cfg.rms_norm_eps)
-    lm_head = (params["embed"].T if cfg.tie_word_embeddings
-               else params["lm_head"])
-    logits = jnp.dot(h_last, lm_head, preferred_element_type=jnp.float32)
-    return logits, ks, vs
+    dtype = params["embed"].dtype
+    kc = jnp.zeros((cfg.num_layers, S, cfg.num_kv_heads, cfg.head_dim),
+                   dtype)
+    positions = jnp.arange(S, dtype=jnp.int32)
+    logits, kc, vc = llama.forward(
+        cfg, params, token_ids, positions, kc, jnp.zeros_like(kc),
+        write_slots=positions, attn_fn=attn_fn, logits_rows=last[None],
+    )
+    return logits[0], kc, vc
 
 
 class LongContextPrefiller:

@@ -48,7 +48,13 @@ def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
             f"model {cfg.name}: heads ({cfg.num_heads}/{cfg.num_kv_heads}) "
             f"not divisible by tp={tp_size}"
         )
-    if cfg.intermediate_size % tp_size:
+    if cfg.is_moe:
+        if cfg.num_experts % tp_size:
+            raise ValueError(
+                f"model {cfg.name}: num_experts {cfg.num_experts} not "
+                f"divisible by tp={tp_size} (experts shard whole)"
+            )
+    elif cfg.intermediate_size % tp_size:
         raise ValueError(
             f"model {cfg.name}: intermediate_size "
             f"{cfg.intermediate_size} not divisible by tp={tp_size}"
@@ -73,10 +79,19 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
         "wk": ns(None, None, TP_AXIS),
         "wv": ns(None, None, TP_AXIS),
         "wo": ns(None, TP_AXIS, None),  # row: psum after
-        "w_gate": ns(None, None, TP_AXIS),
-        "w_up": ns(None, None, TP_AXIS),
-        "w_down": ns(None, TP_AXIS, None),
     }
+    if cfg.is_moe:
+        # expert parallelism over the same mesh axis: each chip holds
+        # E/tp whole experts ((L, E, h, f) split on E); the router stays
+        # replicated and XLA turns dispatch/combine into all_to_alls
+        layers["moe_gate"] = ns(None, None, None)
+        layers["w_gate"] = ns(None, TP_AXIS, None, None)
+        layers["w_up"] = ns(None, TP_AXIS, None, None)
+        layers["w_down"] = ns(None, TP_AXIS, None, None)
+    else:
+        layers["w_gate"] = ns(None, None, TP_AXIS)
+        layers["w_up"] = ns(None, None, TP_AXIS)
+        layers["w_down"] = ns(None, TP_AXIS, None)
     if cfg.qkv_bias:
         layers["bq"] = ns(None, TP_AXIS)
         layers["bk"] = ns(None, TP_AXIS)

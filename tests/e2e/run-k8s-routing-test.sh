@@ -12,7 +12,9 @@
 #   --keep           leave the cluster + release up after the test
 #   --cluster NAME   kind cluster name [pst-e2e]
 #   --skip-build     images already built + loaded
-set -euo pipefail
+# -E: the ERR trap (debug-artifact collection) must fire inside
+# functions too (wait_ready/port_forward), not just at top level
+set -Eeuo pipefail
 
 TEST_TYPE="${1:-all}"; shift || true
 CLUSTER=pst-e2e

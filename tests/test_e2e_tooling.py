@@ -18,7 +18,6 @@ import pytest
 from aiohttp import web
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
-REPO = os.path.dirname(TESTS_DIR)
 sys.path.insert(0, TESTS_DIR)
 from fake_engine import FakeEngine  # noqa: E402
 

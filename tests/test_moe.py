@@ -1,0 +1,295 @@
+"""Mixture-of-experts: gating/compute-path parity, Mixtral checkpoint
+loading, engine integration, and expert parallelism on the CPU mesh.
+
+Role parity: the reference stack serves Mixtral through vLLM's fused-MoE
+kernels; ours routes through the einsum paths in ops/moe.py. The oracle
+for every compute path is a per-token python loop over the selected
+experts (the textbook definition)."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.models import llama
+from production_stack_tpu.models.config import get_model_config
+from production_stack_tpu.models.weights import load_hf_weights
+from production_stack_tpu.ops import moe
+
+N, D, F, E, K = 12, 16, 32, 4, 2
+
+
+@pytest.fixture(scope="module")
+def tensors():
+    rng = np.random.RandomState(0)
+    return (
+        jnp.asarray(rng.randn(N, D).astype(np.float32) * 0.3),   # x
+        jnp.asarray(rng.randn(D, E).astype(np.float32)),          # gate_w
+        jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.2),  # w_gate
+        jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.2),  # w_up
+        jnp.asarray(rng.randn(E, F, D).astype(np.float32) * 0.2),  # w_down
+    )
+
+
+def _oracle(x, gate_w, w_gate, w_up, w_down, k):
+    """Per-token loop over the top-k experts (Mixtral semantics)."""
+    x = np.asarray(x, np.float64)
+    logits = x @ np.asarray(gate_w, np.float64)
+    out = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        top = np.argsort(logits[t])[::-1][:k]
+        w = np.exp(logits[t][top] - logits[t][top].max())
+        w /= w.sum()
+        for expert, weight in zip(top, w):
+            g = x[t] @ np.asarray(w_gate[expert], np.float64)
+            u = x[t] @ np.asarray(w_up[expert], np.float64)
+            a = g / (1 + np.exp(-g)) * u  # silu(g) * u
+            out[t] += weight * (a @ np.asarray(w_down[expert], np.float64))
+    return out
+
+
+def test_gating_topk_rows(tensors):
+    x, gate_w, *_ = tensors
+    gates = moe.top_k_gating(x, gate_w, K)
+    assert gates.shape == (N, E)
+    nz = (np.asarray(gates) > 0).sum(axis=1)
+    assert (nz == K).all()
+    np.testing.assert_allclose(np.asarray(gates).sum(axis=1), 1.0,
+                               rtol=1e-5)
+
+
+def test_dense_path_matches_oracle(tensors):
+    x, gate_w, w_gate, w_up, w_down = tensors
+    got = moe.moe_block(x, gate_w, w_gate, w_up, w_down, K)
+    want = _oracle(x, gate_w, w_gate, w_up, w_down, K)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_capacity_path_matches_dense_when_no_drop(tensors):
+    x, gate_w, w_gate, w_up, w_down = tensors
+    gates = moe.top_k_gating(x, gate_w, K)
+    cap = int(moe.capacity_needed(gates))
+    dense = moe.moe_dense(x, gates, w_gate, w_up, w_down)
+    capd = moe.moe_capacity(x, gates, w_gate, w_up, w_down, cap)
+    np.testing.assert_allclose(np.asarray(capd), np.asarray(dense),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_capacity_path_drops_overflow(tensors):
+    """capacity=1: only each expert's first token contributes; later
+    tokens routed to a full expert lose that expert's weight."""
+    x, gate_w, w_gate, w_up, w_down = tensors
+    gates = moe.top_k_gating(x, gate_w, K)
+    out = moe.moe_capacity(x, gates, w_gate, w_up, w_down, 1)
+    dense = moe.moe_dense(x, gates, w_gate, w_up, w_down)
+    assert not np.allclose(np.asarray(out), np.asarray(dense))
+    # token 0 holds rank 0 in both its experts -> exact
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(dense[0]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_forward_in_model():
+    """llama.forward with a MoE config must equal the same forward with
+    the MoE block hand-applied via the oracle."""
+    cfg = get_model_config("pst-tiny-moe-debug")
+    params = llama.init_params(cfg, jax.random.key(0), jnp.float32)
+    assert params["layers"]["w_gate"].shape == (
+        cfg.num_layers, cfg.num_experts, cfg.hidden_size,
+        cfg.intermediate_size,
+    )
+    n = 6
+    ids = jnp.asarray(np.arange(1, n + 1), jnp.int32)
+    kc = jnp.zeros((cfg.num_layers, n, cfg.num_kv_heads, cfg.head_dim),
+                   jnp.float32)
+    from production_stack_tpu.parallel.ring_attention import (
+        attention_reference,
+    )
+
+    def attn(q, layer, k_cache, v_cache):
+        return attention_reference(
+            q[None], k_cache[layer][None], v_cache[layer][None],
+            causal=True,
+        )[0]
+
+    logits, _, _ = llama.forward(
+        cfg, params, ids, jnp.arange(n, dtype=jnp.int32), kc,
+        jnp.zeros_like(kc), jnp.arange(n, dtype=jnp.int32), attn,
+        logits_rows=jnp.asarray([n - 1], jnp.int32),
+    )
+    assert logits.shape == (1, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+# -- engine integration -----------------------------------------------------
+
+def _engine(tp=1):
+    return LLMEngine(EngineConfig(
+        model="pst-tiny-moe-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=4, num_kv_blocks=64,
+        max_num_seqs=2, max_prefill_chunk=16, tensor_parallel_size=tp,
+        seed=0,
+    ))
+
+
+def test_engine_serves_moe_model():
+    eng = _engine()
+    outs = eng.generate(
+        [[1, 2, 3, 4, 5], [7, 8, 9]],
+        SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+    )
+    assert all(len(o.token_ids) == 4 for o in outs)
+
+
+def test_expert_parallel_matches_single_chip():
+    """tp=4 shards the 4 experts one-per-chip; greedy outputs must be
+    identical to tp=1."""
+    single = _engine(tp=1).generate(
+        [[1, 2, 3, 4, 5, 6, 7]],
+        SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True),
+    )[0].token_ids
+    ep = _engine(tp=4)
+    wg = ep.runner.params["layers"]["w_gate"]
+    assert len(wg.sharding.device_set) == 4
+    got = ep.generate(
+        [[1, 2, 3, 4, 5, 6, 7]],
+        SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True),
+    )[0].token_ids
+    assert got == single
+
+
+def test_ep_rejects_bad_divisibility():
+    import dataclasses
+
+    from production_stack_tpu.models import config as mcfg
+    from production_stack_tpu.parallel.sharding import validate_tp
+
+    bad = dataclasses.replace(
+        mcfg.get_model_config("pst-tiny-moe-debug"), num_experts=3
+    )
+    with pytest.raises(ValueError, match="num_experts"):
+        validate_tp(bad, 2)
+
+
+# -- Mixtral checkpoint loading --------------------------------------------
+
+def test_load_mixtral_checkpoint(tmp_path):
+    cfg = get_model_config("pst-tiny-moe-debug")
+    h, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    rng = np.random.RandomState(3)
+    tensors = {
+        "model.embed_tokens.weight": rng.randn(v, h).astype(np.float32),
+        "model.norm.weight": np.ones(h, np.float32),
+    }
+    for layer in range(cfg.num_layers):
+        p = f"model.layers.{layer}."
+        tensors[p + "input_layernorm.weight"] = np.ones(h, np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(
+            h, np.float32)
+        for nm, rows in (("q", cfg.q_size), ("k", cfg.kv_size),
+                         ("v", cfg.kv_size)):
+            tensors[p + f"self_attn.{nm}_proj.weight"] = rng.randn(
+                rows, h).astype(np.float32)
+        tensors[p + "self_attn.o_proj.weight"] = rng.randn(
+            h, cfg.q_size).astype(np.float32)
+        tensors[p + "block_sparse_moe.gate.weight"] = rng.randn(
+            cfg.num_experts, h).astype(np.float32)
+        for e in range(cfg.num_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            tensors[ep + "w1.weight"] = rng.randn(f, h).astype(np.float32)
+            tensors[ep + "w3.weight"] = rng.randn(f, h).astype(np.float32)
+            tensors[ep + "w2.weight"] = rng.randn(h, f).astype(np.float32)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    with open(tmp_path / "config.json", "w") as fp:
+        json.dump({"architectures": ["MixtralForCausalLM"]}, fp)
+
+    params = load_hf_weights(cfg, str(tmp_path), jnp.float32)
+    lyr = params["layers"]
+    assert lyr["moe_gate"].shape == (cfg.num_layers, h, cfg.num_experts)
+    np.testing.assert_array_equal(
+        np.asarray(lyr["moe_gate"][1]),
+        tensors["model.layers.1.block_sparse_moe.gate.weight"].T,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lyr["w_gate"][0, 2]),
+        tensors["model.layers.0.block_sparse_moe.experts.2.w1.weight"].T,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lyr["w_down"][1, 3]),
+        tensors["model.layers.1.block_sparse_moe.experts.3.w2.weight"].T,
+    )
+
+
+def test_load_rejects_partial_mixtral(tmp_path):
+    cfg = get_model_config("pst-tiny-moe-debug")
+    h, v = cfg.hidden_size, cfg.vocab_size
+    rng = np.random.RandomState(0)
+    save_file(
+        {"model.embed_tokens.weight": rng.randn(v, h).astype(np.float32),
+         "model.norm.weight": np.ones(h, np.float32)},
+        str(tmp_path / "model.safetensors"),
+    )
+    with pytest.raises(ValueError, match="incomplete"):
+        load_hf_weights(cfg, str(tmp_path), jnp.float32)
+
+
+def test_moe_long_context_prefill():
+    """Ring-attention prefill handles MoE layers (experts replicated on
+    an sp-only mesh)."""
+    from production_stack_tpu.parallel.long_context import (
+        LongContextPrefiller,
+        make_sp_mesh,
+    )
+
+    cfg = get_model_config("pst-tiny-moe-debug")
+    params = llama.init_params(cfg, jax.random.key(0), jnp.float32)
+    pre = LongContextPrefiller(cfg, params, make_sp_mesh(1, 4))
+    logits, k, v, n = pre.prefill(list(range(1, 22)))
+    assert n == 21 and k.shape[1] == 24
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_capacity_valid_mask_protects_real_tokens(tensors):
+    """Padded rows must not steal expert capacity from real tokens."""
+    x, gate_w, w_gate, w_up, w_down = tensors
+    # rows 0..3 are padding (identical garbage), rows 4.. are real
+    valid = jnp.asarray([False] * 4 + [True] * (N - 4))
+    gates = moe.top_k_gating(x, gate_w, K)
+    cap = int(moe.capacity_needed(gates * valid[:, None]))
+    masked = moe.moe_capacity(x, gates, w_gate, w_up, w_down, cap,
+                              valid=valid)
+    dense = moe.moe_dense(x, gates, w_gate, w_up, w_down)
+    np.testing.assert_allclose(np.asarray(masked[4:]),
+                               np.asarray(dense[4:]),
+                               rtol=1e-4, atol=1e-4)
+    # masked rows contribute nothing
+    assert np.allclose(np.asarray(masked[:4]), 0.0)
+
+
+def test_engine_refuses_capacity_factor_serving():
+    import dataclasses
+
+    from production_stack_tpu.models import config as mcfg
+
+    bad = dataclasses.replace(
+        mcfg.get_model_config("pst-tiny-moe-debug"),
+        name="pst-tiny-moe-cap", moe_capacity_factor=1.25,
+    )
+    mcfg._PRESETS[bad.name] = bad
+    try:
+        with pytest.raises(ValueError, match="not servable"):
+            LLMEngine(EngineConfig(
+                model=bad.name, tokenizer="byte", dtype="float32",
+                cache_dtype="float32", block_size=4, num_kv_blocks=16,
+                max_num_seqs=2, seed=0,
+            ))
+    finally:
+        mcfg._PRESETS.pop(bad.name, None)
