@@ -26,15 +26,20 @@ os.environ.setdefault("PST_LOG_LEVEL", "WARNING")  # keep stdout JSON-only
 
 import numpy as np  # noqa: E402
 
-MODEL = os.environ.get("PST_BENCH_MODEL", "llama-3.2-1b")
+MODEL = os.environ.get("PST_BENCH_MODEL", "llama-3.2-3b")
 # north-star config is Llama-3-8B tp=8 on a v5e-8; the driver exposes one
-# chip, so the default serves the largest family member that fits it.
+# chip, so the default serves the largest family member that fits it with
+# the Pallas kernels engaged (3B, head_dim 128 — the 1B's head_dim 64
+# falls back to the XLA path, see engine/model_runner.py).
 # On a full slice: PST_BENCH_MODEL=llama-3-8b PST_BENCH_TP=8 python bench.py
 TP = int(os.environ.get("PST_BENCH_TP", "1"))
 NUM_USERS = int(os.environ.get("PST_BENCH_USERS", "16"))
 SYSTEM_PROMPT_TOK = int(os.environ.get("PST_BENCH_SYS_TOK", "512"))
 HISTORY_TOK = int(os.environ.get("PST_BENCH_HISTORY_TOK", "1024"))
 ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
+# fused decode iterations per dispatch (amortises the host<->device RTT,
+# which dominates through the tunneled chip; see engine/model_runner.py)
+SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
 HBM_BW_GBPS = float(os.environ.get("PST_BENCH_HBM_BW", "819"))  # v5e
 
 
@@ -109,6 +114,7 @@ def main() -> None:
         max_num_seqs=NUM_USERS,
         max_prefill_chunk=512,
         tensor_parallel_size=TP,
+        num_scheduler_steps=SCHED_STEPS,
         seed=0,
     )
     engine = LLMEngine(config)

@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode-interleave", type=int, default=1,
                    help="max consecutive prefill chunks while decodes "
                         "wait (0 = prefill always wins)")
+    p.add_argument("--num-scheduler-steps", type=int, default=1,
+                   help="fused decode+sample iterations per dispatch "
+                        "(on-device sampling; amortises host RTT)")
     p.add_argument("--enable-prefix-caching", action="store_true",
                    default=True)
     p.add_argument("--no-enable-prefix-caching",
@@ -52,6 +55,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-loras", type=int, default=4)
     p.add_argument("--enable-sleep-mode", action="store_true",
                    help="advertise sleep/wake support (endpoints always on)")
+    p.add_argument("--enable-auto-tool-choice", action="store_true",
+                   help="honor OpenAI `tools` with tool_choice=auto "
+                        "(engine/tools.py)")
+    p.add_argument("--tool-call-parser", default="hermes",
+                   choices=["hermes"],
+                   help="tool-call output format to parse")
+    p.add_argument("--api-key", default=None,
+                   help="require `Authorization: Bearer <key>` on /v1/*")
     p.add_argument("--attention-impl", default="auto",
                    choices=["auto", "xla", "pallas"])
     # disaggregated prefill / KV transfer
@@ -101,6 +112,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_prefill_chunk=args.max_prefill_chunk,
         enable_chunked_prefill=args.enable_chunked_prefill,
         decode_interleave=args.decode_interleave,
+        num_scheduler_steps=args.num_scheduler_steps,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
         multihost=args.multihost,
@@ -108,6 +120,9 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         enable_lora=args.enable_lora,
         max_loras=args.max_loras,
         max_lora_rank=args.max_lora_rank,
+        enable_auto_tool_choice=args.enable_auto_tool_choice,
+        tool_call_parser=args.tool_call_parser,
+        api_key=args.api_key,
         attention_impl=args.attention_impl,
         kv_role=role,
         kv_transfer_config={

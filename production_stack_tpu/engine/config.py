@@ -29,6 +29,12 @@ class EngineConfig:
     # max consecutive prefill chunks while decodes wait (bounded ITL);
     # 0 = prefill always wins (round-1 behavior)
     decode_interleave: int = 1
+    # fused decode iterations per dispatch (vLLM --num-scheduler-steps):
+    # sampling runs on device and K tokens come back in ONE host fetch,
+    # amortising the dispatch/fetch RTT. A decode batch containing ANY
+    # sequence with logit penalties falls back to single-step for that
+    # batch (penalties are host-side edits). Must be <= block_size.
+    num_scheduler_steps: int = 1
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1
@@ -41,6 +47,12 @@ class EngineConfig:
     enable_lora: bool = False
     max_loras: int = 4
     max_lora_rank: int = 16
+    # OpenAI tool calling (engine/tools.py; vLLM flag names, reference
+    # tutorial 13): auto tool choice requires the explicit opt-in
+    enable_auto_tool_choice: bool = False
+    tool_call_parser: str = "hermes"
+    # require `Authorization: Bearer <key>` on /v1/* (vLLM --api-key)
+    api_key: str | None = None
 
     # attention implementation: "auto" | "xla" | "pallas"
     attention_impl: str = "auto"

@@ -69,6 +69,7 @@ class LLMEngine:
                 max_model_len=config.resolved_max_model_len(),
                 enable_chunked_prefill=config.enable_chunked_prefill,
                 decode_interleave=config.decode_interleave,
+                decode_lookahead=max(0, config.num_scheduler_steps - 1),
             ),
             self.block_manager,
         )
@@ -288,15 +289,37 @@ class LLMEngine:
             positions = [s.num_tokens - 1 for s in seqs]
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
-            logits = self.runner.decode(
-                tokens, positions, tables, ctx_lens,
-                lora_slots=[self._lora_slot(s) for s in seqs],
-            )
-            sampled = self._sample(seqs, logits[: len(seqs)])
-            for seq, token in zip(seqs, sampled):
-                seq.num_computed_tokens = seq.num_tokens
-                self._append_token(seq, int(token))
-                stepped.append(seq)
+            k_steps = self.config.num_scheduler_steps
+            multi = None
+            if k_steps > 1:
+                multi = self._sampling_arrays(seqs)
+            if multi is not None and not multi[4]:
+                temps, top_ps, top_ks, keys, _ = multi
+                # fused on-device decode+sample loop: K tokens per
+                # dispatch, ONE device->host fetch (the per-step RTT is
+                # the serving bottleneck through remote/tunneled chips)
+                toks = np.asarray(self.runner.decode_multi(
+                    tokens, positions, tables, ctx_lens, k_steps,
+                    temps, top_ps, top_ks, keys,
+                    lora_slots=[self._lora_slot(s) for s in seqs],
+                ))  # (k, b)
+                for i in range(k_steps):
+                    for j, seq in enumerate(seqs):
+                        if seq.finished:
+                            continue  # overshoot tokens are discarded
+                        seq.num_computed_tokens = seq.num_tokens
+                        self._append_token(seq, int(toks[i, j]))
+                stepped.extend(seqs)
+            else:
+                logits = self.runner.decode(
+                    tokens, positions, tables, ctx_lens,
+                    lora_slots=[self._lora_slot(s) for s in seqs],
+                )
+                sampled = self._sample(seqs, logits[: len(seqs)])
+                for seq, token in zip(seqs, sampled):
+                    seq.num_computed_tokens = seq.num_tokens
+                    self._append_token(seq, int(token))
+                    stepped.append(seq)
 
         for seq in stepped:
             self._register_full_blocks(seq)
@@ -310,8 +333,15 @@ class LLMEngine:
         return outputs
 
     # -- internals ---------------------------------------------------------
-    def _sample(self, seqs: list[Sequence], logits) -> np.ndarray:
-        b = logits.shape[0]
+    def _sampling_arrays(
+        self, seqs: list[Sequence], b: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
+        """Per-lane sampling parameter arrays + whether any sequence
+        needs logit penalties (which force the single-step host path).
+
+        Key = (seed, generated_len): multi-step derives iteration i's key
+        as (seed, generated_len + i), bit-identical to i single steps."""
+        b = b if b is not None else len(seqs)
         temps = np.zeros((b,), np.float32)
         top_ps = np.ones((b,), np.float32)
         top_ks = np.full((b,), -1, np.int32)
@@ -337,6 +367,13 @@ class LLMEngine:
                 np.uint32(seed & 0xFFFFFFFF),
                 np.uint32(len(s.generated_token_ids)),
             )
+        return temps, top_ps, top_ks, keys, needs_penalties
+
+    def _sample(self, seqs: list[Sequence], logits) -> np.ndarray:
+        b = logits.shape[0]
+        temps, top_ps, top_ks, keys, needs_penalties = (
+            self._sampling_arrays(seqs, b)
+        )
         if needs_penalties:
             logits = self._apply_penalties(seqs, np.asarray(logits))
         out = sample_tokens(logits, temps, top_ps, top_ks, keys)
@@ -375,7 +412,15 @@ class LLMEngine:
         new_text = self.tokenizer.decode(seq.generated_token_ids)
         prev_len = len(seq.output_text)
         seq.output_text = new_text
-        seq._last_delta = new_text[prev_len:]  # type: ignore[attr-defined]
+        # deltas ACCUMULATE until _make_output drains them: a multi-step
+        # dispatch appends K tokens before one output is built, and a
+        # last-token-only delta would stream 1/K of the text
+        seq._pending_delta = (
+            getattr(seq, "_pending_delta", "") + new_text[prev_len:]
+        )  # type: ignore[attr-defined]
+        seq._pending_ids = (
+            getattr(seq, "_pending_ids", []) + [int(token)]
+        )  # type: ignore[attr-defined]
         seq.check_stop(new_text)
         # hard cap: the KV layout cannot hold more than max_model_len
         # positions, so stop at the context limit regardless of max_tokens
@@ -402,14 +447,17 @@ class LLMEngine:
             seq.block_hashes.append(h)
 
     def _make_output(self, seq: Sequence) -> RequestOutput:
-        new_ids = seq.output_token_ids[-1:] if seq.output_token_ids else []
+        new_ids = getattr(seq, "_pending_ids", [])
+        delta = getattr(seq, "_pending_delta", "")
+        seq._pending_ids = []  # type: ignore[attr-defined]
+        seq._pending_delta = ""  # type: ignore[attr-defined]
         return RequestOutput(
             request_id=seq.request_id,
             prompt_token_ids=seq.prompt_token_ids[: seq.orig_prompt_len],
             token_ids=list(seq.generated_token_ids),
             new_token_ids=list(new_ids),
             text=seq.output_text,
-            delta_text=getattr(seq, "_last_delta", ""),
+            delta_text=delta,
             finished=seq.finished,
             finish_reason=seq.finish_reason,
             metrics=seq.metrics,

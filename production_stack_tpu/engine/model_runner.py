@@ -66,6 +66,15 @@ class ModelRunner:
                 f"{mc.moe_capacity_factor} is not servable; the engine "
                 "requires the exact dense MoE path (capacity_factor=0)"
             )
+        if not (1 <= config.num_scheduler_steps <= config.block_size):
+            # validate at boot: a mid-serving ValueError from decode_multi
+            # would kill the engine step-loop thread and hang every
+            # in-flight request instead of failing fast here
+            raise ValueError(
+                f"num_scheduler_steps={config.num_scheduler_steps} must "
+                f"be in [1, block_size={config.block_size}] (idle decode "
+                "lanes park inside the trash block)"
+            )
         tp = config.tensor_parallel_size
         if mesh is None and tp > 1:
             mesh = sharding_rules.make_mesh(tp)
@@ -105,8 +114,10 @@ class ModelRunner:
         self.num_blocks = self._resolve_num_blocks()
         self.block_size = config.block_size
         num_slots = self.num_blocks * self.block_size
+        # head-major (L, nkv, slots, d): the layout the Pallas kernels
+        # and the MXU want (see ops/pallas_attention.py docstring)
         cache_shape = (
-            mc.num_layers, num_slots, mc.num_kv_heads, mc.head_dim
+            mc.num_layers, mc.num_kv_heads, num_slots, mc.head_dim
         )
         logger.info(
             "allocating KV cache: %d blocks x %d slots (%.2f GiB)",
@@ -133,6 +144,19 @@ class ModelRunner:
             raise ValueError(
                 f"attention_impl must be auto|xla|pallas, got {impl!r}"
             )
+        if impl == "pallas" and jax.default_backend() == "tpu" and (
+            mc.head_dim % 128
+        ):
+            # Mosaic requires DMA slices aligned to the (8, 128) lane
+            # tiling: a head_dim below 128 (e.g. Llama-3.2-1B's 64) pads
+            # the cache's lane dim and every page slice becomes a partial
+            # tile ("must be aligned to tiling (128)" compile error).
+            # Standard TPU-serving constraint; the XLA path serves these.
+            logger.warning(
+                "pallas attention requires head_dim %% 128 == 0 (got %d);"
+                " using the XLA gather path", mc.head_dim,
+            )
+            impl = "xla"
         if impl == "pallas" and jax.default_backend() == "tpu":
             # compile-check the kernel on tiny shapes before committing:
             # if this TPU generation/toolchain rejects it, serve on the
@@ -166,6 +190,7 @@ class ModelRunner:
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
+        self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
         self._embed_fns: dict[tuple[int, int], object] = {}
 
         self.max_ctx_bucket = self._ctx_bucket(self.max_model_len)
@@ -214,7 +239,7 @@ class ModelRunner:
 
         bs = self.block_size
         d, nkv = mc.head_dim, mc.num_kv_heads
-        kc = jnp.zeros((1, 4 * bs, nkv, d), self.cache_dtype)
+        kc = jnp.zeros((1, nkv, 4 * bs, d), self.cache_dtype)
         q = jnp.zeros((1, mc.num_heads, d), self.dtype)
         tables = jnp.zeros((1, 2), jnp.int32)
         lens = jnp.ones((1,), jnp.int32)
@@ -268,6 +293,24 @@ class ModelRunner:
             next_pow2(self.config.max_prefill_chunk),
         )
 
+    def _pin_cache_layout(self, kc, vc):
+        """Pin the KV caches to the row-major physical layout the Pallas
+        custom calls constrain their operands to.
+
+        Without this, XLA may pick a different layout for the scan body's
+        scatter (observed on v5e: {3,1,2,0} vs the kernel's {3,2,1,0})
+        and insert a FULL-CACHE layout-conversion copy per step — 2 x
+        3.8 GiB per step for the 3B model, which OOMed HBM outright."""
+        if self.attention_impl != "pallas" or (
+            jax.default_backend() != "tpu"
+        ):
+            return kc, vc
+        from jax.experimental.layout import Layout, with_layout_constraint
+
+        fmt = Layout((0, 1, 2, 3))
+        return (with_layout_constraint(kc, fmt),
+                with_layout_constraint(vc, fmt))
+
     # -- jitted step builders ---------------------------------------------
     def _build_prefill(self, t_pad: int, c_pad: int):
         mc = self.model_config
@@ -299,8 +342,11 @@ class ModelRunner:
         else:
 
             def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
-                k_ctx = kc[l, gather_slots]  # (c, nkv, d)
-                v_ctx = vc[l, gather_slots]
+                # head-major cache + traced `l`: [l, :, slots] has two
+                # advanced indices split by a slice, so numpy hoists them
+                # to the front — the result is ALREADY (c, nkv, d)
+                k_ctx = kc[l, :, gather_slots]
+                v_ctx = vc[l, :, gather_slots]
                 return xla_attn.context_attention_prefill(
                     q, k_ctx, v_ctx, q_positions, total_len, scale
                 )
@@ -308,6 +354,7 @@ class ModelRunner:
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, last_row, lora=None,
                  lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
                 gather_slots=gather_slots,
@@ -337,7 +384,7 @@ class ModelRunner:
 
             # `tables` = padded per-sequence block tables (b, pages)
             def attn(q, l, kc, vc, tables, context_lens):
-                # q: (b, nq, d); kc/vc: full (L, slots, nkv, d) — the
+                # q: (b, nq, d); kc/vc: full (L, nkv, slots, d) — the
                 # kernel DMAs pages straight from HBM, no gathered copy.
                 # Under TP the kernel is shard_mapped: each chip runs it
                 # on its local kv-head shard (GQA groups are chip-local)
@@ -354,14 +401,16 @@ class ModelRunner:
 
             # `tables` = per-position gather slots (b, c_pad)
             def attn(q, l, kc, vc, tables, context_lens):
-                k_ctx = kc[l, tables]  # (b, c, nkv, d)
-                v_ctx = vc[l, tables]
+                # advanced-index hoisting (see prefill): (b, c, nkv, d)
+                k_ctx = kc[l, :, tables]
+                v_ctx = vc[l, :, tables]
                 return xla_attn.context_attention_decode(
                     q, k_ctx, v_ctx, context_lens, scale
                 )
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  tables, context_lens, lora=None, lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn, tables=tables, context_lens=context_lens
             )
@@ -372,6 +421,91 @@ class ModelRunner:
                 lora=lora, lora_slots=lora_slots,
             )
             return logits, kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
+
+    def _build_decode_multi(self, b: int, c_pad: int, k_steps: int):
+        """K fused decode+sample iterations per dispatch.
+
+        The serving loop's per-step cost is dominated by the
+        device-to-host fetch of the sampled token (one tunnel/PCIe RTT —
+        measured 143 ms through the axon relay, ~100x the 3B decode
+        compute). Sampling on device and chaining K iterations inside
+        one jitted scan amortises that RTT over K tokens (vLLM's
+        --num-scheduler-steps semantics; MaxText's on-device sampling
+        loop is the same idea). The per-iteration sampling keys are
+        (seed, generated_len + i) — bit-identical to K single steps, so
+        multi-step changes throughput, never outputs.
+        """
+        mc = self.model_config
+        scale = self._scale
+        bs = self.block_size
+        from production_stack_tpu.engine.sampler import sample_tokens
+
+        if self.attention_impl == "pallas":
+            from production_stack_tpu.ops import pallas_attention
+
+            interpret = jax.default_backend() != "tpu"
+            mesh = self.mesh
+
+            def attn(q, l, kc, vc, page_tables, context_lens):
+                if mesh is not None:
+                    return pallas_attention.paged_decode_attention_tp(
+                        q, kc, vc, l, page_tables, context_lens,
+                        mesh=mesh, block_size=bs, scale=scale,
+                        interpret=interpret,
+                    )
+                return pallas_attention.paged_decode_attention(
+                    q, kc, vc, l, page_tables, context_lens,
+                    block_size=bs, scale=scale, interpret=interpret,
+                )
+        else:
+
+            def attn(q, l, kc, vc, gather_tables, context_lens):
+                k_ctx = kc[l, :, gather_tables]
+                v_ctx = vc[l, :, gather_tables]
+                return xla_attn.context_attention_decode(
+                    q, k_ctx, v_ctx, context_lens, scale
+                )
+
+        use_pages = self.attention_impl == "pallas"
+
+        def step(params, kc, vc, tokens, positions, page_tables,
+                 gather_tables, context_lens, temps, top_ps, top_ks,
+                 base_keys, lora=None, lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            lane = jnp.arange(b)
+
+            def one(carry, i):
+                kc, vc, tokens, positions, ctx = carry
+                # slot for each lane's current position from its block
+                # table (idle lanes carry the zero table -> trash block 0;
+                # K <= block_size keeps them inside it)
+                write_slots = (
+                    page_tables[lane, positions // bs] * bs
+                    + positions % bs
+                )
+                attn_tables = page_tables if use_pages else gather_tables
+                attn_fn = functools.partial(
+                    attn, page_tables=attn_tables, context_lens=ctx,
+                ) if use_pages else functools.partial(
+                    attn, gather_tables=attn_tables, context_lens=ctx,
+                )
+                logits, kc, vc = llama.forward(
+                    mc, params, tokens, positions, kc, vc, write_slots,
+                    lambda q, l, k, v: attn_fn(q, l, k, v),
+                    logits_rows=lane,
+                    lora=lora, lora_slots=lora_slots,
+                )
+                keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
+                nxt = sample_tokens(logits, temps, top_ps, top_ks, keys)
+                return (kc, vc, nxt, positions + 1, ctx + 1), nxt
+
+            (kc, vc, *_), toks = jax.lax.scan(
+                one, (kc, vc, tokens, positions, context_lens),
+                jnp.arange(k_steps),
+            )
+            return toks, kc, vc  # toks: (k_steps, b)
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
@@ -543,6 +677,103 @@ class ModelRunner:
         )
         return logits
 
+    def decode_multi(
+        self,
+        token_ids: list[int],
+        positions: list[int],
+        block_tables: list[list[int]],
+        context_lens: list[int],
+        steps: int,
+        temps: np.ndarray,      # (b_actual,) float32
+        top_ps: np.ndarray,
+        top_ks: np.ndarray,
+        keys: np.ndarray,       # (b_actual, 2) uint32
+        lora_slots: list[int] | None = None,
+    ) -> jax.Array:
+        """`steps` fused decode+sample iterations (one dispatch, one
+        fetch); returns (steps, b) int32 sampled tokens on device. The
+        caller must have grown each block table to cover
+        context_len + steps - 1 positions (scheduler lookahead)."""
+        if steps > self.block_size:
+            raise ValueError(
+                f"num_scheduler_steps={steps} > block_size="
+                f"{self.block_size}: idle lanes would overrun the trash "
+                "block"
+            )
+        b_actual = len(token_ids)
+        b = self.config.max_num_seqs
+        c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
+
+        tokens = np.zeros((b,), dtype=np.int32)
+        tokens[:b_actual] = token_ids
+        pos = np.zeros((b,), dtype=np.int32)
+        pos[:b_actual] = positions
+        ctx = np.ones((b,), dtype=np.int32)
+        ctx[:b_actual] = context_lens
+
+        n_pages = c_pad // self.block_size
+        page_tables = np.stack(
+            [
+                self._padded_block_table(
+                    block_tables[i] if i < b_actual else [], n_pages
+                )
+                for i in range(b)
+            ]
+        )
+        if self.attention_impl == "pallas":
+            gather_tables = np.zeros((1, 1), dtype=np.int32)  # unused
+        else:
+            gather_tables = np.zeros((b, c_pad), dtype=np.int32)
+            for i in range(b_actual):
+                gather_tables[i] = self._gather_slots_for_table(
+                    block_tables[i], c_pad
+                )
+
+        t_full = np.zeros((b,), np.float32)
+        t_full[:b_actual] = temps
+        p_full = np.ones((b,), np.float32)
+        p_full[:b_actual] = top_ps
+        k_full = np.full((b,), -1, np.int32)
+        k_full[:b_actual] = top_ks
+        key_full = np.zeros((b, 2), np.uint32)
+        key_full[:b_actual] = keys
+
+        cache_key = (b, c_pad, steps)
+        if cache_key not in self._decode_multi_fns:
+            logger.info(
+                "compiling multi-step decode b=%d ctx=%d k=%d",
+                b, c_pad, steps,
+            )
+            self._decode_multi_fns[cache_key] = self._build_decode_multi(
+                b, c_pad, steps
+            )
+        fn = self._decode_multi_fns[cache_key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            slots = np.zeros((b,), dtype=np.int32)
+            if lora_slots is not None:
+                slots[:b_actual] = lora_slots
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.asarray(slots),
+            }
+        toks, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(pos),
+            jnp.asarray(page_tables),
+            jnp.asarray(gather_tables),
+            jnp.asarray(ctx),
+            jnp.asarray(t_full),
+            jnp.asarray(p_full),
+            jnp.asarray(k_full),
+            jnp.asarray(key_full),
+            **lora_kw,
+        )
+        return toks
+
     # -- embeddings (stateless, /v1/embeddings) ----------------------------
     def _build_embed(self, t_pad: int, c_pad: int):
         """One chunked-prefill embed step over a caller-owned scratch KV
@@ -557,7 +788,8 @@ class ModelRunner:
                  lora=None, lora_slots=None):
             def attn(q, l, kcache, vcache):
                 return xla_attn.context_attention_prefill(
-                    q, kcache[l], vcache[l], positions, total_len, scale
+                    q, kcache[l].swapaxes(0, 1), vcache[l].swapaxes(0, 1),
+                    positions, total_len, scale
                 )
 
             # scratch cache row == absolute position; padded chunk rows
@@ -591,7 +823,7 @@ class ModelRunner:
         # c_pad + 1 rows: the last row is the trash slot padded chunk rows
         # write into (they carry position c_pad)
         kc = jnp.zeros(
-            (mc.num_layers, c_pad + 1, mc.num_kv_heads, mc.head_dim),
+            (mc.num_layers, mc.num_kv_heads, c_pad + 1, mc.head_dim),
             self.cache_dtype,
         )
         vc = jnp.zeros_like(kc)
@@ -630,22 +862,25 @@ class ModelRunner:
     def export_blocks(self, block_ids: list[int]) -> np.ndarray:
         """Device->host copy of whole KV blocks.
 
-        Returns (2, num_layers, len(block_ids), block_size, nkv, d)."""
+        Returns (2, num_layers, len(block_ids), nkv, block_size, d) —
+        block count stays at dim 2, so offload/transfer consumers that
+        slice or count blocks (`data[:, :, i]`, `data.shape[2]`) are
+        layout-agnostic."""
         idx = jnp.asarray(
             xla_attn.block_table_slots(
                 jnp.asarray(block_ids, jnp.int32), self.block_size
             )
         )
-        k = self.k_cache[:, idx]  # (L, n*bs, nkv, d)
-        v = self.v_cache[:, idx]
+        k = self.k_cache[:, :, idx]  # (L, nkv, n*bs, d)
+        v = self.v_cache[:, :, idx]
+        mc = self.model_config
         n = len(block_ids)
-        shape = (
-            self.model_config.num_layers, n, self.block_size,
-            self.model_config.num_kv_heads, self.model_config.head_dim,
-        )
-        return np.stack(
-            [np.asarray(k).reshape(shape), np.asarray(v).reshape(shape)]
-        )
+        shape = (mc.num_layers, mc.num_kv_heads, n, self.block_size,
+                 mc.head_dim)
+        return np.stack([
+            np.asarray(k).reshape(shape).swapaxes(1, 2),
+            np.asarray(v).reshape(shape).swapaxes(1, 2),
+        ])
 
     def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
         """Host->device restore of whole KV blocks (inverse of export)."""
@@ -655,10 +890,12 @@ class ModelRunner:
             )
         )
         L = self.model_config.num_layers
-        flat = data.reshape(2, L, -1, *data.shape[-2:])
-        self.k_cache = self.k_cache.at[:, idx].set(
+        # (2, L, n, nkv, bs, d) -> head-major rows (L, nkv, n*bs, d)
+        hm = data.swapaxes(2, 3)
+        flat = hm.reshape(2, L, hm.shape[2], -1, data.shape[-1])
+        self.k_cache = self.k_cache.at[:, :, idx].set(
             jnp.asarray(flat[0], self.cache_dtype)
         )
-        self.v_cache = self.v_cache.at[:, idx].set(
+        self.v_cache = self.v_cache.at[:, :, idx].set(
             jnp.asarray(flat[1], self.cache_dtype)
         )

@@ -23,6 +23,8 @@ broadcast/addressability story).
 
 from __future__ import annotations
 
+import numpy as np
+
 from production_stack_tpu.parallel import multihost
 from production_stack_tpu.utils import init_logger
 
@@ -92,6 +94,26 @@ class BroadcastingRunner:
             lora_slots=lora_slots,
         )
 
+    def decode_multi(self, token_ids, positions, block_tables,
+                     context_lens, steps, temps, top_ps, top_ks, keys,
+                     lora_slots=None):
+        self._bc.publish({
+            "kind": "decode_multi",
+            "token_ids": [int(t) for t in token_ids],
+            "positions": [int(p) for p in positions],
+            "block_tables": [[int(b) for b in t] for t in block_tables],
+            "context_lens": [int(c) for c in context_lens],
+            "steps": int(steps),
+            "temps": np.asarray(temps).tolist(),
+            "top_ps": np.asarray(top_ps).tolist(),
+            "top_ks": np.asarray(top_ks).tolist(),
+            "keys": np.asarray(keys, np.uint32).tolist(),
+        })
+        return self._runner.decode_multi(
+            token_ids, positions, block_tables, context_lens, steps,
+            temps, top_ps, top_ks, keys, lora_slots=lora_slots,
+        )
+
     def embed(self, *a, **kw):
         raise NotImplementedError(
             "/v1/embeddings is not yet supported in multihost mode"
@@ -129,5 +151,11 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
             runner.prefill(**msg)
         elif kind == "decode":
             runner.decode(**msg)
+        elif kind == "decode_multi":
+            for arr in ("temps", "top_ps", "top_ks"):
+                msg[arr] = np.asarray(msg[arr], np.float32
+                                      if arr != "top_ks" else np.int32)
+            msg["keys"] = np.asarray(msg["keys"], np.uint32)
+            runner.decode_multi(**msg)
         else:  # future step kinds must fail loudly, not silently desync
             raise RuntimeError(f"unknown multihost step kind {kind!r}")

@@ -113,7 +113,13 @@ def completion_chunk(
 def chat_response(
     request_id: str, model: str, text: str, finish_reason: str | None,
     prompt_tokens: int, completion_tokens: int,
+    tool_calls: list[dict] | None = None,
 ) -> dict:
+    message: dict = {"role": "assistant", "content": text}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = text or None
+        finish_reason = "tool_calls"
     return {
         "id": request_id,
         "object": "chat.completion",
@@ -122,7 +128,7 @@ def chat_response(
         "choices": [
             {
                 "index": 0,
-                "message": {"role": "assistant", "content": text},
+                "message": message,
                 "logprobs": None,
                 "finish_reason": finish_reason,
             }

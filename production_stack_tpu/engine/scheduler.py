@@ -79,6 +79,10 @@ class SchedulerConfig:
     # max consecutive prefill chunks while decode-ready sequences wait;
     # 0 disables interleaving (prefill runs to completion first)
     decode_interleave: int = 1
+    # extra decode positions to reserve per scheduled sequence so a
+    # multi-step dispatch (num_scheduler_steps - 1 lookahead) never runs
+    # off the end of its block table mid-scan
+    decode_lookahead: int = 0
 
 
 class Scheduler:
@@ -211,7 +215,8 @@ class Scheduler:
             if not seq.prefill_done:
                 continue
             while not self.block_manager.ensure_capacity(
-                seq.num_tokens, seq.block_table
+                seq.num_tokens + self.config.decode_lookahead,
+                seq.block_table,
             ):
                 victim = self._pick_preemption_victim(exclude=seq)
                 if victim is None:

@@ -34,6 +34,7 @@ from production_stack_tpu.engine.async_engine import (
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.metrics import EngineMetrics
 from production_stack_tpu.engine import protocol as proto
+from production_stack_tpu.engine import tools
 from production_stack_tpu.engine.sampling_params import SamplingParams
 from production_stack_tpu.utils import init_logger
 
@@ -55,7 +56,12 @@ class EngineServer:
 
     # -- app wiring --------------------------------------------------------
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 2**20)
+        middlewares = []
+        if self.config.api_key:
+            middlewares.append(self._auth_middleware)
+        app = web.Application(
+            client_max_size=64 * 2**20, middlewares=middlewares
+        )
         r = app.router
         r.add_post("/v1/completions", self.handle_completions)
         r.add_post("/v1/chat/completions", self.handle_chat)
@@ -74,6 +80,27 @@ class EngineServer:
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        """Bearer-token auth on the OpenAI surface (vLLM --api-key,
+        reference tutorial 11-secure-vllm-serve). /health and /metrics
+        stay open for probes and Prometheus."""
+        if request.path.startswith("/v1/") or request.path in (
+            "/tokenize", "/detokenize", "/sleep", "/wake_up",
+        ):
+            import hmac
+
+            auth = request.headers.get("Authorization", "")
+            if not hmac.compare_digest(
+                auth, f"Bearer {self.config.api_key}"
+            ):
+                return web.json_response(
+                    proto.error_json("invalid API key",
+                                     "authentication_error", 401),
+                    status=401,
+                )
+        return await handler(request)
 
     async def _on_startup(self, app: web.Application) -> None:
         self.engine.start(asyncio.get_running_loop())
@@ -183,10 +210,28 @@ class EngineServer:
             return web.json_response(
                 proto.error_json("missing 'messages'"), status=400
             )
+        req_tools = body.get("tools")
+        tool_choice = body.get("tool_choice",
+                               "auto" if req_tools else "none")
+        use_tools = bool(req_tools) and tool_choice != "none"
+        if use_tools and tool_choice == "auto" and not (
+            self.config.enable_auto_tool_choice
+        ):
+            return web.json_response(
+                proto.error_json(
+                    "tools require --enable-auto-tool-choice (or a "
+                    "named tool_choice)"
+                ),
+                status=400,
+            )
         try:
+            if use_tools:
+                messages = tools.inject_tools(
+                    messages, req_tools, tool_choice
+                )
             prompt = self.engine.tokenizer.apply_chat_template(messages)
             sp = proto.sampling_params_from_request(body)
-        except proto.ProtocolError as e:
+        except (proto.ProtocolError, ValueError) as e:
             return web.json_response(proto.error_json(str(e)), status=400)
         except Exception as e:
             return web.json_response(
@@ -197,6 +242,8 @@ class EngineServer:
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
         if body.get("stream"):
+            # streamed responses pass tool-call text through verbatim
+            # (parsing happens client-side); blocking mode parses
             return await self._stream_completion(
                 request, request_id, sp, {"prompt": prompt}, lora_name,
                 chat=True,
@@ -204,12 +251,14 @@ class EngineServer:
         return await self._blocking_completion(
             request_id, sp, {"prompt": prompt}, lora_name, chat=True,
             model=body.get("model") or self.model_name,
+            parse_tools=use_tools,
         )
 
     # -- shared generation paths ------------------------------------------
     async def _blocking_completion(
         self, request_id: str, sp: SamplingParams, kwargs: dict,
         lora_name: str | None, chat: bool, model: str,
+        parse_tools: bool = False,
     ) -> web.Response:
         arrival = time.time()
         final = None
@@ -228,13 +277,19 @@ class EngineServer:
             return web.json_response(proto.error_json(str(e)), status=400)
         assert final is not None
         self._observe_finish(final, arrival)
-        build = proto.chat_response if chat else proto.completion_response
-        return web.json_response(
-            build(
-                request_id, model, final.text, final.finish_reason,
+        if chat:
+            text, tool_calls = final.text, None
+            if parse_tools:
+                text, tool_calls = tools.parse_tool_calls(final.text)
+            return web.json_response(proto.chat_response(
+                request_id, model, text, final.finish_reason,
                 len(final.prompt_token_ids), len(final.token_ids),
-            )
-        )
+                tool_calls=tool_calls,
+            ))
+        return web.json_response(proto.completion_response(
+            request_id, model, final.text, final.finish_reason,
+            len(final.prompt_token_ids), len(final.token_ids),
+        ))
 
     async def _stream_completion(
         self, request: web.Request, request_id: str, sp: SamplingParams,

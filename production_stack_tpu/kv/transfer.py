@@ -48,7 +48,7 @@ class KVTransferServer:
         self.blocks_served = 0
 
     def _export_chain(self, hashes: list[int]) -> np.ndarray | None:
-        """Longest available run of `hashes` -> (2, L, n, bs, nkv, d)."""
+        """Longest available run of `hashes` -> (2, L, n, nkv, bs, d)."""
         eng = self.async_engine.engine
         with self.async_engine._lock:
             bm = eng.block_manager
@@ -127,7 +127,7 @@ class KVTransferClient:
     def get_chain(self, hashes: list[int]) -> np.ndarray | None:
         """Longest run of `hashes` the peer holds, or None.
 
-        Returns (2, L, n, bs, nkv, d) with n <= len(hashes)."""
+        Returns (2, L, n, nkv, bs, d) with n <= len(hashes)."""
         if not hashes:
             return None
         with self._lock:

@@ -4,20 +4,26 @@ This is the hot op of the serving engine (the capability the reference
 stack gets from vLLM's PagedAttention CUDA kernels; our TPU-first design
 replaces the gather-based XLA path in ops/attention.py on TPU):
 
-- The KV cache stays in HBM (`memory_space=ANY`); the kernel DMAs one
-  whole page (block_size, num_kv_heads, head_dim) at a time into VMEM,
-  double-buffered so the next page streams in while the current one is
-  on the MXU. The gathered (batch, ctx, ...) context copy the XLA path
-  materialises is never built — decode reads each KV byte exactly once.
+- The KV cache is HEAD-MAJOR: (L, nkv, slots, d). This is the layout
+  the hardware wants twice over: (a) a page slice
+  `cache[layer, :, row0:row0+bs]` lands in VMEM as (nkv, bs, d) in ONE
+  strided DMA with the tiled (slots, d) dims sliced tile-aligned, and
+  (b) the attention dots batch over kv heads with batch dims at
+  matching operand positions — Mosaic rejects the slot-major layout's
+  mismatched-batch matmul outright ("batch dims must be equal" on v5e)
+  and slot-major per-head slices break (nkv, d) tiling.
+- The cache stays in HBM (`memory_space=ANY`); the kernel DMAs one page
+  at a time into VMEM, double-buffered so the next page streams in
+  while the current one is on the MXU. The gathered (batch, ctx, ...)
+  context copy the XLA path materialises is never built — decode reads
+  each KV byte exactly once.
 - The block table rides in scalar-prefetch SMEM (PrefetchScalarGridSpec)
   so page addresses are known before the body runs — this is the "dense
   tiling, not gather-heavy layout" recipe for TPU paged attention.
 - Online softmax (running max / sum / accumulator in f32) over pages,
-  one grid program per sequence; all KV heads of a page are processed
-  together since a page is contiguous in HBM as (bs, nkv, d).
-- The layer index is a scalar argument indexing the full
-  (L, slots, nkv, d) cache, so jit never slices (= copies) a per-layer
-  cache to feed the kernel.
+  one grid program per sequence.
+- The layer index is a scalar argument indexing the full cache, so jit
+  never slices (= copies) a per-layer cache to feed the kernel.
 
 Numerics match ops/attention.py (f32 softmax, same masking); parity is
 enforced by tests/test_pallas_attention.py in interpret mode on CPU.
@@ -42,12 +48,12 @@ def _decode_kernel(
     context_lens_ref,   # (b,) int32
     # array inputs
     q_ref,              # (1, nq, d) VMEM — this program's query
-    k_cache_ref,        # (L, slots, nkv, d) ANY/HBM
+    k_cache_ref,        # (L, nkv, slots, d) ANY/HBM — head-major
     v_cache_ref,
     # outputs
     out_ref,            # (1, nq, d) VMEM
     # scratch
-    k_buf,              # (2, bs, nkv, d) VMEM
+    k_buf,              # (2, nkv, bs, d) VMEM
     v_buf,
     sem,                # DMA sems (2, 2)
     *,
@@ -59,7 +65,7 @@ def _decode_kernel(
     layer = layer_ref[0]
     ctx_len = context_lens_ref[i]
     nq, d = q_ref.shape[1], q_ref.shape[2]
-    nkv = k_buf.shape[2]
+    nkv = k_buf.shape[1]
     g = nq // nkv
     bs = block_size
 
@@ -68,10 +74,12 @@ def _decode_kernel(
         (ctx_len + bs - 1) // bs, jnp.int32(num_pages)
     )
 
+    # one strided DMA per page: all heads' rows for the page's slot
+    # range (the head-major cache makes this a tile-aligned slice)
     def page_dma(slot, page_idx, buf, cache_ref, which):
         row0 = block_tables_ref[i, page_idx] * bs
         return pltpu.make_async_copy(
-            cache_ref.at[layer, pl.ds(row0, bs)],
+            cache_ref.at[layer, :, pl.ds(row0, bs)],
             buf.at[slot],
             sem.at[slot, which],
         )
@@ -96,12 +104,12 @@ def _decode_kernel(
         page_dma(slot, j, k_buf, k_cache_ref, 0).wait()
         page_dma(slot, j, v_buf, v_cache_ref, 1).wait()
 
-        k = k_buf[slot].astype(jnp.float32)  # (bs, nkv, d)
+        k = k_buf[slot].astype(jnp.float32)  # (nkv, bs, d)
         v = v_buf[slot].astype(jnp.float32)
-        # (nkv, g, d) x (bs, nkv, d) -> (nkv, g, bs), batched over kv heads
+        # (nkv, g, d) x (nkv, bs, d) -> (nkv, g, bs), batched over kv heads
         s = jax.lax.dot_general(
             q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
@@ -111,10 +119,10 @@ def _decode_kernel(
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)  # (nkv, g, bs)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # (nkv, g, bs) x (bs, nkv, d) -> (nkv, g, d)
+        # (nkv, g, bs) x (nkv, bs, d) -> (nkv, g, d)
         pv = jax.lax.dot_general(
             p, v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         acc_new = acc * corr + pv
@@ -135,12 +143,12 @@ def _prefill_kernel(
     block_table_ref,    # (P,) int32 — this sequence's pages
     # array inputs
     q_ref,              # (Tq, nq, d) VMEM — this program's query tile
-    k_cache_ref,        # (L, slots, nkv, d) ANY/HBM
+    k_cache_ref,        # (L, nkv, slots, d) ANY/HBM — head-major
     v_cache_ref,
     # outputs
     out_ref,            # (Tq, nq, d) VMEM
     # scratch
-    k_buf,              # (2, bs, nkv, d) VMEM
+    k_buf,              # (2, nkv, bs, d) VMEM
     v_buf,
     sem,                # DMA sems (2, 2)
     *,
@@ -164,7 +172,7 @@ def _prefill_kernel(
     layer = meta_ref[0]
     q_start = meta_ref[1]
     tq, nq, d = q_ref.shape
-    nkv = k_buf.shape[2]
+    nkv = k_buf.shape[1]
     g = nq // nkv
     bs = block_size
 
@@ -177,7 +185,7 @@ def _prefill_kernel(
     def page_dma(slot, page_idx, buf, cache_ref, which):
         row0 = block_table_ref[page_idx] * bs
         return pltpu.make_async_copy(
-            cache_ref.at[layer, pl.ds(row0, bs)],
+            cache_ref.at[layer, :, pl.ds(row0, bs)],
             buf.at[slot],
             sem.at[slot, which],
         )
@@ -211,12 +219,12 @@ def _prefill_kernel(
         page_dma(slot, j, k_buf, k_cache_ref, 0).wait()
         page_dma(slot, j, v_buf, v_cache_ref, 1).wait()
 
-        k = k_buf[slot].astype(jnp.float32)  # (bs, nkv, d)
+        k = k_buf[slot].astype(jnp.float32)  # (nkv, bs, d)
         v = v_buf[slot].astype(jnp.float32)
-        # (nkv, Tq*g, d) x (bs, nkv, d) -> (nkv, Tq*g, bs)
+        # (nkv, Tq*g, d) x (nkv, bs, d) -> (nkv, Tq*g, bs)
         s = jax.lax.dot_general(
             q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         k_pos = j * bs + jax.lax.broadcasted_iota(
@@ -230,7 +238,7 @@ def _prefill_kernel(
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc * corr + pv
@@ -268,7 +276,7 @@ def _prefill_q_tile(t: int, nq: int, d: int) -> int:
 )
 def paged_prefill_attention(
     q: jax.Array,            # (t, nq, d) — one chunk, contiguous positions
-    k_cache: jax.Array,      # (L, num_slots, nkv, d)
+    k_cache: jax.Array,      # (L, nkv, num_slots, d) — head-major
     v_cache: jax.Array,
     layer: jax.Array,        # scalar int32
     block_table: jax.Array,  # (P,) int32 — pages of THIS sequence
@@ -280,7 +288,7 @@ def paged_prefill_attention(
 ) -> jax.Array:
     """Chunked-prefill paged attention for one sequence. -> (t, nq, d)."""
     t, nq, d = q.shape
-    nkv = k_cache.shape[2]
+    nkv = k_cache.shape[1]
     num_pages = block_table.shape[0]
     tq = _prefill_q_tile(t, nq, d)
 
@@ -292,15 +300,15 @@ def paged_prefill_attention(
                 (tq, nq, d), lambda i, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         out_specs=pl.BlockSpec(
             (tq, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, nkv, d), k_cache.dtype),
-            pltpu.VMEM((2, block_size, nkv, d), v_cache.dtype),
+            pltpu.VMEM((2, nkv, block_size, d), k_cache.dtype),
+            pltpu.VMEM((2, nkv, block_size, d), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -320,6 +328,9 @@ def paged_prefill_attention(
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
+            # large f32 q/accumulator tiles exceed the default 16 MiB
+            # scoped-vmem stack; v5e has 128 MiB — allow half of it
+            vmem_limit_bytes=64 * 2**20,
         ),
     )(
         meta,
@@ -332,7 +343,7 @@ def paged_prefill_attention(
 
 def paged_prefill_attention_tp(
     q: jax.Array,            # (t, nq, d) — heads sharded over tp
-    k_cache: jax.Array,      # (L, num_slots, nkv, d) — kv heads sharded
+    k_cache: jax.Array,      # (L, nkv, num_slots, d) — head-major — kv heads sharded
     v_cache: jax.Array,
     layer: jax.Array,
     block_table: jax.Array,  # (P,) replicated
@@ -357,8 +368,8 @@ def paged_prefill_attention_tp(
         mesh=mesh,
         in_specs=(
             P(None, tp, None),
-            P(None, None, tp, None),
-            P(None, None, tp, None),
+            P(None, tp, None, None),
+            P(None, tp, None, None),
             P(),
             P(None),
             P(),
@@ -384,7 +395,7 @@ def _resolve_tp_axis(mesh: jax.sharding.Mesh) -> str:
 
 def paged_decode_attention_tp(
     q: jax.Array,             # (b, nq, d) — heads sharded over tp
-    k_cache: jax.Array,       # (L, num_slots, nkv, d) — kv heads sharded
+    k_cache: jax.Array,       # (L, nkv, num_slots, d) — kv heads sharded
     v_cache: jax.Array,
     layer: jax.Array,
     block_tables: jax.Array,  # (b, P) replicated
@@ -402,7 +413,7 @@ def paged_decode_attention_tp(
     local: the kernel body needs zero cross-chip communication — the psum
     stays where GSPMD already puts it, after the wo row-parallel projection.
     shard_map hands each chip its (b, nq/tp, d) query slice and
-    (L, slots, nkv/tp, d) cache shard; block tables and context lens ride
+    (L, nkv/tp, slots, d) cache shard; block tables and context lens ride
     replicated. check_vma=False because pallas_call does not participate in
     varying-axes inference.
     """
@@ -417,8 +428,8 @@ def paged_decode_attention_tp(
         mesh=mesh,
         in_specs=(
             P(None, tp, None),
-            P(None, None, tp, None),
-            P(None, None, tp, None),
+            P(None, tp, None, None),
+            P(None, tp, None, None),
             P(),
             P(None, None),
             P(None),
@@ -434,7 +445,7 @@ def paged_decode_attention_tp(
 )
 def paged_decode_attention(
     q: jax.Array,             # (b, nq, d)
-    k_cache: jax.Array,       # (L, num_slots, nkv, d)
+    k_cache: jax.Array,       # (L, nkv, num_slots, d) — head-major
     v_cache: jax.Array,
     layer: jax.Array,         # scalar int32
     block_tables: jax.Array,  # (b, P) int32 — page ids per sequence
@@ -446,7 +457,7 @@ def paged_decode_attention(
 ) -> jax.Array:
     """One decode step of paged attention. Returns (b, nq, d) in q.dtype."""
     b, nq, d = q.shape
-    nkv = k_cache.shape[2]
+    nkv = k_cache.shape[1]
     num_pages = block_tables.shape[1]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -457,15 +468,15 @@ def paged_decode_attention(
                 (1, nq, d), lambda i, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         out_specs=pl.BlockSpec(
             (1, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, nkv, d), k_cache.dtype),
-            pltpu.VMEM((2, block_size, nkv, d), v_cache.dtype),
+            pltpu.VMEM((2, nkv, block_size, d), k_cache.dtype),
+            pltpu.VMEM((2, nkv, block_size, d), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -482,6 +493,9 @@ def paged_decode_attention(
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
+            # large f32 q/accumulator tiles exceed the default 16 MiB
+            # scoped-vmem stack; v5e has 128 MiB — allow half of it
+            vmem_limit_bytes=64 * 2**20,
         ),
     )(
         jnp.asarray(layer, jnp.int32).reshape(1),

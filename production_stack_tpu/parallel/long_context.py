@@ -6,7 +6,7 @@ still has to FIT the prompt on one GPU's HBM. This module removes that
 ceiling the TPU way: activations and KV for a single long prompt are
 sharded over an `sp` mesh axis, attention runs as a ring
 (parallel/ring_attention.py), and max prompt length scales linearly with
-the ring size. The output KV (layer-stacked, sequence-major) feeds either
+the ring size. The output KV (layer-stacked, head-major) feeds either
 the local paged cache or the disaggregated-prefill transfer chain
 (kv/transfer.py) exactly like chunked-prefill KV does.
 
@@ -16,7 +16,7 @@ supplied through its `attn_fn` extension point and a full-sequence
 "cache" (slots 0..S-1) standing in for the paged one, so every model
 feature (qkv bias, MoE blocks, future changes) has exactly one
 implementation. Only the sharding is this module's business: the KV
-cache is pinned to P(None, sp, None, None) via jit out_shardings, and
+cache is pinned to P(None, None, sp, None) via jit out_shardings, and
 the ring's shard_map in_specs re-anchor q/k/v to the sp layout at every
 layer, which is what keeps XLA from gathering the sequence anywhere.
 
@@ -67,7 +67,8 @@ def _forward(cfg: ModelConfig, params: dict, token_ids: jax.Array,
 
     token_ids: (S,), S divisible by sp size; `last` is the row of the
     final REAL token (padding sits after it). Returns (that row's logits
-    (V,) f32, k (L, S, nkv, d), v likewise).
+    (V,) f32, k (L, nkv, S, d) head-major — the engine cache layout —
+    v likewise).
     """
     S = token_ids.shape[0]
     has_tp = "tp" in mesh.axis_names and mesh.shape["tp"] > 1
@@ -82,11 +83,13 @@ def _forward(cfg: ModelConfig, params: dict, token_ids: jax.Array,
     )
 
     def attn_fn(q, layer, kc, vc):
-        # the full-sequence cache rows ARE the sequence: ring over them
-        return ring(q[None], kc[layer][None], vc[layer][None])[0]
+        # the full-sequence cache rows ARE the sequence (head-major:
+        # (nkv, S, d) per layer); the ring wants (1, S, nkv, d)
+        return ring(q[None], kc[layer].swapaxes(0, 1)[None],
+                    vc[layer].swapaxes(0, 1)[None])[0]
 
     dtype = params["embed"].dtype
-    kc = jnp.zeros((cfg.num_layers, S, cfg.num_kv_heads, cfg.head_dim),
+    kc = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, S, cfg.head_dim),
                    dtype)
     positions = jnp.arange(S, dtype=jnp.int32)
     logits, kc, vc = llama.forward(
@@ -102,7 +105,7 @@ class LongContextPrefiller:
     Pad prompts to a multiple of the sp size (use `pad_to`); KV rows for
     the padding are garbage and must be dropped by the caller — token
     count is returned alongside so downstream paged-cache insertion
-    (engine) or PD transfer (kv/transfer.py) slices `k[:, :n]`.
+    (engine) or PD transfer (kv/transfer.py) slices `k[:, :, :n]`.
     """
 
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
@@ -122,7 +125,7 @@ class LongContextPrefiller:
         self.params = params
         self.mesh = mesh
         self.sp = mesh.shape[SP_AXIS]
-        kv_spec = NamedSharding(mesh, P(None, SP_AXIS, None, None))
+        kv_spec = NamedSharding(mesh, P(None, None, SP_AXIS, None))
         rep = NamedSharding(mesh, P())
         self._fn = jax.jit(
             functools.partial(_forward, cfg, mesh=mesh),
@@ -134,7 +137,8 @@ class LongContextPrefiller:
 
     def prefill(self, token_ids) -> tuple[jax.Array, jax.Array, jax.Array, int]:
         """token_ids: list/array of ints. Returns (logits, k, v, n) with
-        k/v (L, S_pad, nkv, d) sp-sharded; rows >= n are padding."""
+        k/v (L, nkv, S_pad, d) head-major, sp-sharded on the sequence
+        dim; rows >= n are padding (slice `k[:, :, :n]`)."""
         n = len(token_ids)
         S = self.pad_to(n)
         ids = jnp.zeros((S,), jnp.int32).at[:n].set(

@@ -107,8 +107,11 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV cache (layers, slots, kv_heads, head_dim): split kv heads."""
-    return NamedSharding(mesh, P(None, None, TP_AXIS, None))
+    """KV cache (layers, kv_heads, slots, head_dim): split kv heads.
+
+    Head-major layout — see ops/pallas_attention.py module docstring for
+    why the hardware wants the slot run contiguous per head."""
+    return NamedSharding(mesh, P(None, TP_AXIS, None, None))
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:

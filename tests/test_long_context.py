@@ -30,13 +30,14 @@ CFG = ModelConfig(
 def _oracle(cfg, params, ids):
     """Full-sequence forward through the paged-cache code path."""
     n = len(ids)
-    k_cache = jnp.zeros((cfg.num_layers, n, cfg.num_kv_heads,
+    k_cache = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, n,
                          cfg.head_dim), jnp.float32)
     v_cache = jnp.zeros_like(k_cache)
 
     def attn(q, layer, kc, vc):
         return attention_reference(
-            q[None], kc[layer][None], vc[layer][None], causal=True
+            q[None], kc[layer].swapaxes(0, 1)[None],
+            vc[layer].swapaxes(0, 1)[None], causal=True,
         )[0]
 
     logits, kc, vc = llama.forward(
@@ -68,10 +69,10 @@ def test_prefill_matches_paged_forward(setup, tp, sp):
                                np.asarray(want_logits),
                                rtol=2e-4, atol=2e-4)
     # KV beyond n is padding; real rows must match the paged layout
-    np.testing.assert_allclose(np.asarray(k[:, :n]), np.asarray(want_k),
-                               rtol=2e-4, atol=2e-4)
-    np.testing.assert_allclose(np.asarray(v[:, :n]), np.asarray(want_v),
-                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(k[:, :, :n]),
+                               np.asarray(want_k), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(v[:, :, :n]),
+                               np.asarray(want_v), rtol=2e-4, atol=2e-4)
 
 
 def test_prefill_pads_to_ring(setup):
@@ -79,7 +80,7 @@ def test_prefill_pads_to_ring(setup):
     pre = LongContextPrefiller(CFG, params, make_sp_mesh(1, 8))
     assert pre.pad_to(50) == 56
     logits, k, v, n = pre.prefill(ids[:3])
-    assert k.shape[1] == 8 and n == 3
+    assert k.shape[2] == 8 and n == 3
 
 
 def test_kv_is_sequence_sharded(setup):
@@ -90,8 +91,8 @@ def test_kv_is_sequence_sharded(setup):
     pre = LongContextPrefiller(CFG, params, mesh)
     _, k, _, _ = pre.prefill(ids)
     assert len(k.sharding.device_set) == 8
-    shard_rows = {s.data.shape[1] for s in k.addressable_shards}
-    assert shard_rows == {k.shape[1] // 8}
+    shard_rows = {s.data.shape[2] for s in k.addressable_shards}
+    assert shard_rows == {k.shape[2] // 8}
 
 
 def test_requires_sp_axis(setup):

@@ -108,7 +108,7 @@ def test_moe_forward_in_model():
     )
     n = 6
     ids = jnp.asarray(np.arange(1, n + 1), jnp.int32)
-    kc = jnp.zeros((cfg.num_layers, n, cfg.num_kv_heads, cfg.head_dim),
+    kc = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, n, cfg.head_dim),
                    jnp.float32)
     from production_stack_tpu.parallel.ring_attention import (
         attention_reference,
@@ -116,8 +116,8 @@ def test_moe_forward_in_model():
 
     def attn(q, layer, k_cache, v_cache):
         return attention_reference(
-            q[None], k_cache[layer][None], v_cache[layer][None],
-            causal=True,
+            q[None], k_cache[layer].swapaxes(0, 1)[None],
+            v_cache[layer].swapaxes(0, 1)[None], causal=True,
         )[0]
 
     logits, _, _ = llama.forward(
@@ -253,7 +253,7 @@ def test_moe_long_context_prefill():
     params = llama.init_params(cfg, jax.random.key(0), jnp.float32)
     pre = LongContextPrefiller(cfg, params, make_sp_mesh(1, 4))
     logits, k, v, n = pre.prefill(list(range(1, 22)))
-    assert n == 21 and k.shape[1] == 24
+    assert n == 21 and k.shape[2] == 24
     assert np.isfinite(np.asarray(logits)).all()
 
 

@@ -1,0 +1,133 @@
+"""Multi-step decode (--num-scheduler-steps): K fused on-device
+decode+sample iterations per dispatch must be BIT-IDENTICAL to K single
+steps — greedy and stochastic — because the per-iteration sampling keys
+are the same (seed, generated_len + i) the single-step path uses.
+
+Role: the TPU answer to per-step host RTT (vLLM multi-step scheduling /
+MaxText on-device sampling loop); measured 143 ms per device->host fetch
+through the tunneled chip vs ~10 ms of 3B decode compute."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+
+
+def _engine(k_steps=1, **kw):
+    cfg = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=64,
+        max_num_seqs=3, max_prefill_chunk=16, seed=0,
+        num_scheduler_steps=k_steps,
+    )
+    cfg.update(kw)
+    return LLMEngine(EngineConfig(**cfg))
+
+
+PROMPTS = [
+    list(range(1, 12)),
+    [50, 60, 70, 80, 90],
+    [7, 8, 9, 10, 11, 12, 13, 14, 15],
+]
+
+
+@pytest.mark.parametrize("k", [4, 8])
+def test_greedy_parity_vs_single_step(k):
+    sp = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    single = [o.token_ids for o in _engine(1).generate(PROMPTS, sp)]
+    multi = [o.token_ids for o in _engine(k).generate(PROMPTS, sp)]
+    assert multi == single
+
+
+def test_sampled_parity_vs_single_step():
+    sp = SamplingParams(max_tokens=9, temperature=0.8, top_p=0.9, seed=7,
+                        ignore_eos=True)
+    single = [o.token_ids for o in _engine(1).generate(PROMPTS, sp)]
+    multi = [o.token_ids for o in _engine(4).generate(PROMPTS, sp)]
+    assert multi == single
+
+
+def test_max_tokens_not_multiple_of_k():
+    """Stop conditions land mid-dispatch; overshoot must be discarded."""
+    sp = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    outs = _engine(4).generate(PROMPTS, sp)
+    assert all(len(o.token_ids) == 5 for o in outs)
+
+
+def test_eos_mid_dispatch():
+    """A sequence hitting EOS inside a multi-step window stops there."""
+    sp1 = SamplingParams(max_tokens=12, temperature=0.0)
+    single = [o.token_ids for o in _engine(1).generate(PROMPTS, sp1)]
+    multi = [o.token_ids for o in _engine(4).generate(PROMPTS, sp1)]
+    assert multi == single
+
+
+def test_penalties_fall_back_to_single_step():
+    """Penalty sampling needs host-side logit edits; outputs must still
+    match the single-step engine exactly."""
+    sp = SamplingParams(max_tokens=6, temperature=0.7, seed=3,
+                        repetition_penalty=1.3, ignore_eos=True)
+    single = [o.token_ids for o in _engine(1).generate(PROMPTS, sp)]
+    multi = [o.token_ids for o in _engine(8).generate(PROMPTS, sp)]
+    assert multi == single
+
+
+def test_mixed_sampling_batch():
+    """Greedy + sampled sequences share one multi-step dispatch."""
+    eng = _engine(4)
+    sps = [
+        SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True),
+        SamplingParams(max_tokens=7, temperature=1.0, seed=11,
+                       ignore_eos=True),
+        SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True),
+    ]
+    outs = [
+        eng.generate([p], sp)[0].token_ids
+        for p, sp in zip(PROMPTS, sps)
+    ]
+    want = [
+        _engine(1).generate([p], sp)[0].token_ids
+        for p, sp in zip(PROMPTS, sps)
+    ]
+    assert outs == want
+
+
+def test_rejects_k_above_block_size():
+    """Validated at BOOT: a mid-serving failure would kill the step-loop
+    thread and hang all in-flight requests."""
+    with pytest.raises(ValueError, match="block_size"):
+        _engine(16)  # block_size 8
+
+
+def test_tp_multistep_parity():
+    """Multi-step under tensor parallelism matches tp=1."""
+    sp = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    base = [o.token_ids for o in _engine(4).generate(PROMPTS[:2], sp)]
+    tp = [o.token_ids for o in
+          _engine(4, tensor_parallel_size=2).generate(PROMPTS[:2], sp)]
+    assert tp == base
+
+
+def test_streaming_deltas_cover_all_tokens():
+    """Multi-step appends K tokens before one output is built; the
+    drained delta must carry ALL of them (review finding: last-token-only
+    deltas streamed 1/K of the text)."""
+    eng = _engine(4)
+    sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    rid = "stream-1"
+    eng.add_request(rid, prompt_token_ids=PROMPTS[0], sampling_params=sp)
+    deltas, ids = [], []
+    while True:
+        outs = eng.step()
+        for o in outs:
+            deltas.append(o.delta_text)
+            ids.extend(o.new_token_ids)
+        if outs and outs[-1].finished:
+            final = outs[-1]
+            break
+    assert ids == final.token_ids
+    assert "".join(deltas) == final.text

@@ -18,8 +18,8 @@ def make_case(seed, b=4, layers=2, pages_per_seq=4, bs=8, nkv=2, g=2, d=128,
     nq = nkv * g
     num_blocks = 1 + b * pages_per_seq  # block 0 is the null/trash block
     num_slots = num_blocks * bs
-    k_cache = rng.randn(layers, num_slots, nkv, d).astype(np.float32)
-    v_cache = rng.randn(layers, num_slots, nkv, d).astype(np.float32)
+    k_cache = rng.randn(layers, nkv, num_slots, d).astype(np.float32)
+    v_cache = rng.randn(layers, nkv, num_slots, d).astype(np.float32)
     q = rng.randn(b, nq, d).astype(np.float32)
     # each sequence owns `pages_per_seq` distinct pages, shuffled order
     all_pages = rng.permutation(np.arange(1, num_blocks))
@@ -37,8 +37,8 @@ def make_case(seed, b=4, layers=2, pages_per_seq=4, bs=8, nkv=2, g=2, d=128,
 def reference(q, k_cache, v_cache, layer, block_tables, context_lens, bs,
               scale):
     slots = xla_attn.block_table_slots(block_tables, bs)  # (b, P*bs)
-    k_ctx = k_cache[layer][slots]  # (b, c, nkv, d)
-    v_ctx = v_cache[layer][slots]
+    k_ctx = k_cache[layer][:, slots].transpose(1, 2, 0, 3)  # (b,c,nkv,d)
+    v_ctx = v_cache[layer][:, slots].transpose(1, 2, 0, 3)
     return xla_attn.context_attention_decode(
         q, k_ctx, v_ctx, context_lens, scale
     )
@@ -168,8 +168,8 @@ def make_prefill_case(seed, t=16, prefix_pages=3, bs=8, nkv=2, g=2, d=128,
     num_pages = num_real_pages + 2  # padded table tail -> null page 0
     num_blocks = 1 + num_real_pages
     num_slots = num_blocks * bs
-    k_cache = rng.randn(2, num_slots, nkv, d).astype(np.float32)
-    v_cache = rng.randn(2, num_slots, nkv, d).astype(np.float32)
+    k_cache = rng.randn(2, nkv, num_slots, d).astype(np.float32)
+    v_cache = rng.randn(2, nkv, num_slots, d).astype(np.float32)
     q = rng.randn(t, nq, d).astype(np.float32)
     table = np.zeros((num_pages,), np.int32)
     table[:num_real_pages] = rng.permutation(
@@ -185,8 +185,8 @@ def make_prefill_case(seed, t=16, prefix_pages=3, bs=8, nkv=2, g=2, d=128,
 def prefill_reference(q, kc, vc, layer, table, q_start, total_len, bs,
                       scale):
     slots = xla_attn.block_table_slots(table, bs)  # (P*bs,)
-    k_ctx = kc[layer][slots]  # (c, nkv, d)
-    v_ctx = vc[layer][slots]
+    k_ctx = kc[layer][:, slots].transpose(1, 0, 2)  # (c, nkv, d)
+    v_ctx = vc[layer][:, slots].transpose(1, 0, 2)
     t = q.shape[0]
     q_positions = jnp.arange(q_start, q_start + t)
     return xla_attn.context_attention_prefill(

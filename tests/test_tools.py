@@ -1,0 +1,272 @@
+"""OpenAI tool calling (engine/tools.py + server wiring) and API-key auth.
+
+Role parity: reference tutorial 13-tool-enabled-installation.md (vLLM
+--enable-auto-tool-choice --tool-call-parser) and tutorial
+11-secure-vllm-serve.md (--api-key). The server paths run against the
+real EngineServer app with the generation loop stubbed to emit canned
+Hermes-format text, so the protocol surface is exercised without
+weights."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine import tools
+
+WEATHER = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get current weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+TIME_TOOL = {
+    "type": "function",
+    "function": {"name": "get_time", "parameters": {"type": "object"}},
+}
+
+
+class TestParse:
+    def test_hermes_block(self):
+        text = ('I will check.\n<tool_call>{"name": "get_weather", '
+                '"arguments": {"city": "Paris"}}</tool_call>')
+        content, calls = tools.parse_tool_calls(text)
+        assert content == "I will check."
+        assert len(calls) == 1
+        c = calls[0]
+        assert c["type"] == "function"
+        assert c["id"].startswith("call_")
+        assert c["function"]["name"] == "get_weather"
+        assert json.loads(c["function"]["arguments"]) == {"city": "Paris"}
+
+    def test_multiple_calls(self):
+        text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+                '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>')
+        content, calls = tools.parse_tool_calls(text)
+        assert content == ""
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+    def test_bare_json(self):
+        content, calls = tools.parse_tool_calls(
+            '{"name": "get_time", "arguments": {}}'
+        )
+        assert calls and calls[0]["function"]["name"] == "get_time"
+        assert content == ""
+
+    def test_plain_text_no_calls(self):
+        content, calls = tools.parse_tool_calls("just an answer")
+        assert content == "just an answer" and calls == []
+
+    def test_malformed_json_ignored(self):
+        content, calls = tools.parse_tool_calls(
+            "<tool_call>{not json}</tool_call>trailing"
+        )
+        assert calls == [] and "trailing" in content
+
+
+class TestInject:
+    def test_appends_to_system(self):
+        msgs = tools.inject_tools(
+            [{"role": "system", "content": "Be helpful."},
+             {"role": "user", "content": "weather?"}],
+            [WEATHER],
+        )
+        assert msgs[0]["role"] == "system"
+        assert "Be helpful." in msgs[0]["content"]
+        assert "get_weather" in msgs[0]["content"]
+
+    def test_creates_system_when_missing(self):
+        msgs = tools.inject_tools([{"role": "user", "content": "hi"}],
+                                  [WEATHER])
+        assert msgs[0]["role"] == "system"
+        assert "get_weather" in msgs[0]["content"]
+
+    def test_named_tool_choice_narrows(self):
+        msgs = tools.inject_tools(
+            [{"role": "user", "content": "hi"}], [WEATHER, TIME_TOOL],
+            tool_choice={"type": "function",
+                         "function": {"name": "get_time"}},
+        )
+        assert "get_time" in msgs[0]["content"]
+        assert "get_weather" not in msgs[0]["content"]
+
+    def test_unknown_named_tool_raises(self):
+        with pytest.raises(ValueError, match="unknown tool"):
+            tools.inject_tools([{"role": "user", "content": "hi"}],
+                               [WEATHER],
+                               tool_choice={"type": "function",
+                                            "function": {"name": "nope"}})
+
+    def test_tool_round_trip_messages(self):
+        msgs = tools.inject_tools(
+            [
+                {"role": "user", "content": "weather?"},
+                {"role": "assistant", "content": None, "tool_calls": [
+                    {"id": "call_1", "type": "function",
+                     "function": {"name": "get_weather",
+                                  "arguments": '{"city": "Paris"}'}},
+                ]},
+                {"role": "tool", "tool_call_id": "call_1",
+                 "content": '{"temp": 21}'},
+            ],
+            [WEATHER],
+        )
+        roles = [m["role"] for m in msgs]
+        assert roles == ["system", "user", "assistant", "user"]
+        assert "<tool_call>" in msgs[2]["content"]
+        assert "tool_calls" not in msgs[2]
+        assert "<tool_response>" in msgs[3]["content"]
+        assert all(m["content"] is not None for m in msgs)
+
+
+# -- server wiring ----------------------------------------------------------
+
+class _FakeOut:
+    def __init__(self, text):
+        self.text = text
+        self.finish_reason = "stop"
+        self.prompt_token_ids = [1, 2, 3]
+        self.token_ids = [4, 5]
+        self.metrics = None
+
+
+def _make_server(canned_text, **cfg_kw):
+    """EngineServer with the engine's generate loop stubbed out."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import EngineServer
+
+    srv = EngineServer.__new__(EngineServer)
+    srv.config = EngineConfig(model="pst-tiny-debug", tokenizer="byte",
+                              **cfg_kw)
+    srv.model_name = "pst-tiny-debug"
+    srv.lora_adapters = {}
+    srv._stats_task = None
+
+    class _Tok:
+        def apply_chat_template(self, messages):
+            return "".join(m["content"] for m in messages)
+
+    class _Eng:
+        tokenizer = _Tok()
+
+        async def generate(self, request_id, sampling_params, lora_name,
+                           **kw):
+            yield _FakeOut(canned_text)
+
+    srv.engine = _Eng()
+    srv._observe_finish = lambda out, arrival: None
+    srv.app = srv._build_app()
+    return srv
+
+
+def _post(srv, path, payload, headers=None):
+    async def run():
+        client = TestClient(TestServer(srv.app))
+        # bypass on_startup (no real engine loop)
+        srv.app.on_startup.clear()
+        srv.app.on_cleanup.clear()
+        await client.start_server()
+        r = await client.post(path, json=payload, headers=headers or {})
+        body = await r.json()
+        await client.close()
+        return r.status, body
+
+    return asyncio.new_event_loop().run_until_complete(run())
+
+
+CHAT = "/v1/chat/completions"
+
+
+class TestServerTools:
+    def test_tool_call_response(self):
+        srv = _make_server(
+            '<tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "Oslo"}}</tool_call>',
+            enable_auto_tool_choice=True,
+        )
+        status, body = _post(srv, CHAT, {
+            "messages": [{"role": "user", "content": "weather in oslo"}],
+            "tools": [WEATHER],
+        })
+        assert status == 200, body
+        msg = body["choices"][0]["message"]
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+        assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) \
+            == {"city": "Oslo"}
+        assert body["choices"][0]["finish_reason"] == "tool_calls"
+        assert msg["content"] is None
+
+    def test_plain_answer_with_tools_available(self):
+        srv = _make_server("The weather is nice.",
+                           enable_auto_tool_choice=True)
+        status, body = _post(srv, CHAT, {
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [WEATHER],
+        })
+        assert status == 200
+        msg = body["choices"][0]["message"]
+        assert msg["content"] == "The weather is nice."
+        assert "tool_calls" not in msg
+        assert body["choices"][0]["finish_reason"] == "stop"
+
+    def test_auto_requires_flag(self):
+        srv = _make_server("x")  # enable_auto_tool_choice defaults False
+        status, body = _post(srv, CHAT, {
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [WEATHER],
+        })
+        assert status == 400
+        assert "enable-auto-tool-choice" in body["error"]["message"]
+
+    def test_tool_choice_none_ignores_tools(self):
+        srv = _make_server("plain")
+        status, body = _post(srv, CHAT, {
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [WEATHER], "tool_choice": "none",
+        })
+        assert status == 200
+        assert body["choices"][0]["message"]["content"] == "plain"
+
+
+class TestApiKeyAuth:
+    def test_rejects_missing_and_wrong_key(self):
+        # fresh server per request: aiohttp apps freeze after first start
+        status, body = _post(_make_server("hi", api_key="sk-secret"),
+                             CHAT,
+                             {"messages": [{"role": "user", "content": "x"}]})
+        assert status == 401
+        status, _ = _post(_make_server("hi", api_key="sk-secret"), CHAT,
+                          {"messages": [{"role": "user", "content": "x"}]},
+                          headers={"Authorization": "Bearer wrong"})
+        assert status == 401
+
+    def test_accepts_correct_key(self):
+        srv = _make_server("hi", api_key="sk-secret")
+        status, body = _post(
+            srv, CHAT, {"messages": [{"role": "user", "content": "x"}]},
+            headers={"Authorization": "Bearer sk-secret"},
+        )
+        assert status == 200
+        assert body["choices"][0]["message"]["content"] == "hi"
+
+    def test_health_stays_open(self):
+        async def run():
+            srv = _make_server("hi", api_key="sk-secret")
+            srv.app.on_startup.clear()
+            srv.app.on_cleanup.clear()
+            client = TestClient(TestServer(srv.app))
+            await client.start_server()
+            r = await client.get("/health")
+            await client.close()
+            return r.status
+
+        assert asyncio.new_event_loop().run_until_complete(run()) == 200
