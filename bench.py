@@ -41,6 +41,7 @@ ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
 # which dominates through the tunneled chip; see engine/model_runner.py)
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
 HBM_BW_GBPS = float(os.environ.get("PST_BENCH_HBM_BW", "819"))  # v5e
+QPS = float(os.environ.get("PST_BENCH_QPS", "2.0"))  # arrival pacing
 
 
 def _init_backend_or_die(timeout_s: float = 60.0, retries: int = 1):
@@ -147,17 +148,30 @@ def main() -> None:
     print(f"# warmup/compile {time.time() - t0:.1f}s", file=sys.stderr)
 
     # -- timed run ---------------------------------------------------------
+    # QPS-paced arrivals, like the reference harness (multi-round-qa.py
+    # drives a target QPS): TTFT is measured from each request's own
+    # arrival, not from the start of a burst
     ttfts: dict[str, float] = {}
     t_start = time.time()
-    for i, p in enumerate(prompts):
-        engine.add_request(f"u{i}", prompt_token_ids=p, sampling_params=sp)
-    submit_t = {f"u{i}": t_start for i in range(NUM_USERS)}
+    arrivals = [(f"u{i}", t_start + i / QPS, p)
+                for i, p in enumerate(prompts)]
+    submit_t: dict[str, float] = {}
+    pending = list(arrivals)
 
     gen_tokens = 0
     decode_time = 0.0
     last_token_t: dict[str, float] = {}
     itls: list[float] = []  # inter-token gaps across all streams
-    while engine.has_unfinished():
+    while pending or engine.has_unfinished():
+        now = time.time()
+        while pending and pending[0][1] <= now:
+            rid, due, p = pending.pop(0)
+            engine.add_request(rid, prompt_token_ids=p, sampling_params=sp)
+            submit_t[rid] = max(due, now)
+        if not engine.has_unfinished():
+            if pending:
+                time.sleep(max(0.0, pending[0][1] - time.time()))
+            continue
         st = time.time()
         outs = engine.step()
         dt = time.time() - st
@@ -209,6 +223,8 @@ def main() -> None:
         "vs_baseline": round(decode_tps / roofline_tps, 3),
         "detail": {
             "tensor_parallel_size": TP,
+            "arrival_qps": QPS,
+            "num_scheduler_steps": SCHED_STEPS,
             "decode_tokens_per_s_aggregate": round(decode_tps, 1),
             "p50_ttft_s": round(p50_ttft, 3),
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)

@@ -67,6 +67,10 @@ class EngineServer:
         r.add_post("/v1/chat/completions", self.handle_chat)
         r.add_get("/v1/models", self.handle_models)
         r.add_post("/v1/embeddings", self.handle_embeddings)
+        r.add_post("/v1/rerank", self.handle_rerank)
+        r.add_post("/rerank", self.handle_rerank)
+        r.add_post("/v1/score", self.handle_score)
+        r.add_post("/score", self.handle_score)
         r.add_post("/tokenize", self.handle_tokenize)
         r.add_post("/detokenize", self.handle_detokenize)
         r.add_get("/health", self.handle_health)
@@ -88,12 +92,16 @@ class EngineServer:
         stay open for probes and Prometheus."""
         if request.path.startswith("/v1/") or request.path in (
             "/tokenize", "/detokenize", "/sleep", "/wake_up",
+            "/rerank", "/score",
         ):
             import hmac
 
             auth = request.headers.get("Authorization", "")
+            # compare as bytes: compare_digest raises TypeError on
+            # non-ASCII str input (reachable via latin-1 header bytes)
             if not hmac.compare_digest(
-                auth, f"Bearer {self.config.api_key}"
+                auth.encode("utf-8", "surrogateescape"),
+                f"Bearer {self.config.api_key}".encode(),
             ):
                 return web.json_response(
                     proto.error_json("invalid API key",
@@ -132,6 +140,15 @@ class EngineServer:
             await asyncio.sleep(STATS_UPDATE_INTERVAL_S)
 
     # -- helpers -----------------------------------------------------------
+    async def _json_body(self, request: web.Request):
+        """-> (body, None) or (None, 400-response)."""
+        try:
+            return await request.json(), None
+        except json.JSONDecodeError:
+            return None, web.json_response(
+                proto.error_json("invalid JSON"), status=400
+            )
+
     def _check_model(self, body: dict) -> web.Response | None:
         model = body.get("model")
         if model and model not in (self.model_name, self.config.model) and (
@@ -389,34 +406,135 @@ class EngineServer:
                                  "list of strings"), status=400
             )
 
-        # one text per lock acquisition: an in-flight decode batch only
-        # ever waits for ONE embedding forward (or its first-bucket
-        # compile), never the whole list
-        def run_one(text: str):
-            with self.engine._lock:
-                return self.engine.engine.embed_one(text, lora_name)
-
         loop = asyncio.get_running_loop()
-        data = []
-        n_tokens = 0
-        for i, text in enumerate(inputs):
-            try:
-                vec, count = await loop.run_in_executor(
-                    None, run_one, text
-                )
-            except ValueError as e:
-                return web.json_response(
-                    proto.error_json(str(e)), status=400
-                )
-            data.append({"object": "embedding", "index": i,
-                         "embedding": vec.tolist()})
-            n_tokens += count
+        try:
+            vecs, n_tokens = await loop.run_in_executor(
+                None, self._embed_texts, inputs, lora_name
+            )
+        except ValueError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+        data = [
+            {"object": "embedding", "index": i, "embedding": v.tolist()}
+            for i, v in enumerate(vecs)
+        ]
         return web.json_response({
             "object": "list",
             "model": model,
             "data": data,
             "usage": {"prompt_tokens": n_tokens,
                       "total_tokens": n_tokens},
+        })
+
+    # -- rerank / score (router proxies these; reference engines serve
+    # them for reranker/scorer models via cross-encoders. A decoder
+    # engine scores by embedding-space cosine — the same decoder-as-
+    # embedder pooling /v1/embeddings uses — which preserves the API
+    # contract and ordering semantics; plug a cross-encoder family in
+    # for calibrated absolute scores.) --------------------------------
+    def _embed_texts(self, texts: list[str], lora_name):
+        """One text per lock acquisition: an in-flight decode batch only
+        ever waits for ONE embedding forward (or its first-bucket
+        compile), never the whole list. Shared by /v1/embeddings,
+        /v1/rerank, and /v1/score."""
+        import numpy as np
+
+        vecs = []
+        n_tokens = 0
+        for t in texts:
+            with self.engine._lock:
+                vec, count = self.engine.engine.embed_one(t, lora_name)
+            vecs.append(np.asarray(vec))
+            n_tokens += count
+        return vecs, n_tokens
+
+    async def handle_rerank(self, request: web.Request) -> web.Response:
+        """Jina/Cohere-style rerank: query + documents -> sorted scores."""
+        body, err = await self._json_body(request)
+        if err is not None:
+            return err
+        if err := self._check_model(body):
+            return err
+        query = body.get("query")
+        docs = body.get("documents")
+        if not isinstance(query, str) or not isinstance(docs, list) or (
+            not docs
+        ) or not all(isinstance(d, str) for d in docs):
+            return web.json_response(
+                proto.error_json("'query' must be a string and "
+                                 "'documents' a non-empty list of "
+                                 "strings"), status=400
+            )
+        model = body.get("model", self.model_name)
+        lora_name = model if model in self.lora_adapters else None
+        top_n = body.get("top_n", len(docs))
+        if not isinstance(top_n, int) or top_n < 0:
+            return web.json_response(
+                proto.error_json("'top_n' must be a non-negative integer"),
+                status=400,
+            )
+
+        loop = asyncio.get_running_loop()
+        try:
+            vecs, n_tokens = await loop.run_in_executor(
+                None, self._embed_texts, [query] + docs, lora_name
+            )
+        except ValueError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+        q = vecs[0]
+        scored = sorted(
+            (
+                {"index": i, "relevance_score": float(q @ v),
+                 "document": {"text": docs[i]}}
+                for i, v in enumerate(vecs[1:])
+            ),
+            key=lambda r: -r["relevance_score"],
+        )[:top_n]
+        return web.json_response({
+            "id": proto.make_id("rerank"),
+            "model": model,
+            "results": scored,
+            "usage": {"total_tokens": n_tokens},
+        })
+
+    async def handle_score(self, request: web.Request) -> web.Response:
+        """vLLM-style /v1/score: text_1 x text_2 similarity scores."""
+        body, err = await self._json_body(request)
+        if err is not None:
+            return err
+        if err := self._check_model(body):
+            return err
+        t1 = body.get("text_1")
+        t2 = body.get("text_2")
+        if isinstance(t2, str):
+            t2 = [t2]
+        if not isinstance(t1, str) or not isinstance(t2, list) or (
+            not t2
+        ) or not all(isinstance(x, str) for x in t2):
+            return web.json_response(
+                proto.error_json("'text_1' must be a string and 'text_2' "
+                                 "a string or list of strings"),
+                status=400,
+            )
+        model = body.get("model", self.model_name)
+        lora_name = model if model in self.lora_adapters else None
+        loop = asyncio.get_running_loop()
+        try:
+            vecs, n_tokens = await loop.run_in_executor(
+                None, self._embed_texts, [t1] + t2, lora_name
+            )
+        except ValueError as e:
+            return web.json_response(proto.error_json(str(e)), status=400)
+        q = vecs[0]
+        data = [
+            {"object": "score", "index": i, "score": float(q @ v)}
+            for i, v in enumerate(vecs[1:])
+        ]
+        return web.json_response({
+            "id": proto.make_id("score"),
+            "object": "list",
+            "model": model,
+            "data": data,
+            "usage": {"total_tokens": n_tokens},
         })
 
     # -- misc endpoints ----------------------------------------------------

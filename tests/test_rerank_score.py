@@ -1,0 +1,168 @@
+"""/v1/rerank + /v1/score engine endpoints (router already proxies both;
+reference engines serve them for reranker/scorer models — ours scores by
+decoder-as-embedder cosine, same pooling as /v1/embeddings).
+
+Server-level tests with embed_one stubbed to canned unit vectors, so the
+ranking/score math and protocol shapes are pinned without weights; an
+end-to-end real-model pass rides on test_engine_edge_cases'
+embeddings coverage."""
+
+from __future__ import annotations
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+
+def _vec(angle: float) -> np.ndarray:
+    return np.asarray([math.cos(angle), math.sin(angle)], np.float32)
+
+
+TEXT_VECS = {
+    "query": _vec(0.0),
+    "close": _vec(0.1),
+    "mid": _vec(0.8),
+    "far": _vec(2.5),
+}
+
+
+def _make_server():
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import EngineServer
+
+    srv = EngineServer.__new__(EngineServer)
+    srv.config = EngineConfig(model="pst-tiny-debug", tokenizer="byte")
+    srv.model_name = "pst-tiny-debug"
+    srv.lora_adapters = {}
+    srv._stats_task = None
+
+    class _Inner:
+        def embed_one(self, text, lora_name):
+            return TEXT_VECS[text], len(text)
+
+    class _Eng:
+        engine = _Inner()
+
+        class _lock:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        _lock = _lock()
+
+    srv.engine = _Eng()
+    srv.app = srv._build_app()
+    return srv
+
+
+def _post(path, payload):
+    async def run():
+        srv = _make_server()
+        srv.app.on_startup.clear()
+        srv.app.on_cleanup.clear()
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        r = await client.post(path, json=payload)
+        body = await r.json()
+        await client.close()
+        return r.status, body
+
+    return asyncio.new_event_loop().run_until_complete(run())
+
+
+class TestRerank:
+    def test_sorted_by_relevance(self):
+        status, body = _post("/v1/rerank", {
+            "query": "query", "documents": ["mid", "close", "far"],
+        })
+        assert status == 200, body
+        results = body["results"]
+        assert [r["document"]["text"] for r in results] == [
+            "close", "mid", "far"
+        ]
+        # original indices preserved
+        assert [r["index"] for r in results] == [1, 0, 2]
+        scores = [r["relevance_score"] for r in results]
+        assert scores == sorted(scores, reverse=True)
+        assert body["usage"]["total_tokens"] == sum(
+            len(t) for t in ("query", "mid", "close", "far")
+        )
+
+    def test_top_n(self):
+        status, body = _post("/rerank", {
+            "query": "query", "documents": ["mid", "close", "far"],
+            "top_n": 1,
+        })
+        assert status == 200
+        assert len(body["results"]) == 1
+        assert body["results"][0]["document"]["text"] == "close"
+
+    def test_validation(self):
+        status, _ = _post("/v1/rerank", {"query": "query",
+                                         "documents": []})
+        assert status == 400
+        status, _ = _post("/v1/rerank", {"documents": ["a"]})
+        assert status == 400
+
+
+class TestScore:
+    def test_single_and_batch(self):
+        status, body = _post("/v1/score", {
+            "text_1": "query", "text_2": "close",
+        })
+        assert status == 200, body
+        assert len(body["data"]) == 1
+        assert body["data"][0]["score"] == pytest.approx(
+            math.cos(0.1), abs=1e-5
+        )
+        status, body = _post("/score", {
+            "text_1": "query", "text_2": ["close", "far"],
+        })
+        assert status == 200
+        scores = [d["score"] for d in body["data"]]
+        assert scores[0] > scores[1]
+        assert [d["index"] for d in body["data"]] == [0, 1]
+
+    def test_identical_text_scores_one(self):
+        status, body = _post("/v1/score", {
+            "text_1": "query", "text_2": "query",
+        })
+        assert body["data"][0]["score"] == pytest.approx(1.0, abs=1e-6)
+
+    def test_validation(self):
+        status, _ = _post("/v1/score", {"text_1": "query", "text_2": []})
+        assert status == 400
+
+
+def test_unversioned_aliases_require_api_key():
+    """Review finding: /rerank and /score (unversioned aliases) must sit
+    behind --api-key exactly like /v1/*."""
+    from production_stack_tpu.engine.config import EngineConfig
+
+    async def run():
+        srv = _make_server()
+        srv.config = EngineConfig(model="pst-tiny-debug",
+                                  tokenizer="byte", api_key="sk-x")
+        srv.app = srv._build_app()
+        srv.app.on_startup.clear()
+        srv.app.on_cleanup.clear()
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        out = {}
+        for path in ("/rerank", "/v1/rerank", "/score", "/v1/score"):
+            r = await client.post(path, json={})
+            out[path] = r.status
+        # non-ASCII header must 401, not 500 (bytes compare_digest)
+        r = await client.post("/v1/score", json={},
+                              headers={"Authorization": "Bearer caf\xe9"})
+        out["non-ascii"] = r.status
+        await client.close()
+        return out
+
+    statuses = asyncio.new_event_loop().run_until_complete(run())
+    assert all(s == 401 for s in statuses.values()), statuses
