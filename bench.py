@@ -167,7 +167,10 @@ def main() -> None:
         while pending and pending[0][1] <= now:
             rid, due, p = pending.pop(0)
             engine.add_request(rid, prompt_token_ids=p, sampling_params=sp)
-            submit_t[rid] = max(due, now)
+            # TTFT counts from the SCHEDULED arrival: admission delay past
+            # `due` is queueing the system caused and must stay in the
+            # measurement (avoiding coordinated omission)
+            submit_t[rid] = due
         if not engine.has_unfinished():
             if pending:
                 time.sleep(max(0.0, pending[0][1] - time.time()))

@@ -141,13 +141,17 @@ class EngineServer:
 
     # -- helpers -----------------------------------------------------------
     async def _json_body(self, request: web.Request):
-        """-> (body, None) or (None, 400-response)."""
+        """-> (body, None) or (None, 400-response); body is a dict."""
         try:
-            return await request.json(), None
+            body = await request.json()
         except json.JSONDecodeError:
+            body = None
+        if not isinstance(body, dict):
             return None, web.json_response(
-                proto.error_json("invalid JSON"), status=400
+                proto.error_json("request body must be a JSON object"),
+                status=400,
             )
+        return body, None
 
     def _check_model(self, body: dict) -> web.Response | None:
         model = body.get("model")
@@ -174,12 +178,9 @@ class EngineServer:
 
     # -- completions -------------------------------------------------------
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response(
-                proto.error_json("invalid JSON"), status=400
-            )
+        body, err = await self._json_body(request)
+        if err is not None:
+            return err
         if err := self._check_model(body):
             return err
         prompt = body.get("prompt")
@@ -214,12 +215,9 @@ class EngineServer:
 
     # -- chat --------------------------------------------------------------
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
-        try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response(
-                proto.error_json("invalid JSON"), status=400
-            )
+        body, err = await self._json_body(request)
+        if err is not None:
+            return err
         if err := self._check_model(body):
             return err
         messages = body.get("messages")
@@ -383,12 +381,9 @@ class EngineServer:
 
     # -- embeddings (reference engines serve /v1/embeddings too) -----------
     async def handle_embeddings(self, request: web.Request) -> web.Response:
-        try:
-            body = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response(
-                proto.error_json("invalid JSON"), status=400
-            )
+        body, err = await self._json_body(request)
+        if err is not None:
+            return err
         if err := self._check_model(body):
             return err
         model = body.get("model", self.model_name)
@@ -505,29 +500,44 @@ class EngineServer:
             return err
         t1 = body.get("text_1")
         t2 = body.get("text_2")
+        if isinstance(t1, str):
+            t1 = [t1]
         if isinstance(t2, str):
             t2 = [t2]
-        if not isinstance(t1, str) or not isinstance(t2, list) or (
-            not t2
-        ) or not all(isinstance(x, str) for x in t2):
+        ok = (
+            isinstance(t1, list) and isinstance(t2, list) and t1 and t2
+            and all(isinstance(x, str) for x in t1 + t2)
+            and (len(t1) == 1 or len(t2) == 1 or len(t1) == len(t2))
+        )
+        if not ok:
             return web.json_response(
-                proto.error_json("'text_1' must be a string and 'text_2' "
-                                 "a string or list of strings"),
+                proto.error_json(
+                    "'text_1'/'text_2' must be strings or lists of "
+                    "strings with broadcastable lengths (1xM, Nx1, NxN)"
+                ),
                 status=400,
             )
+        if len(t1) == 1:
+            pairs = [(t1[0], x) for x in t2]
+        elif len(t2) == 1:
+            pairs = [(x, t2[0]) for x in t1]
+        else:
+            pairs = list(zip(t1, t2))
         model = body.get("model", self.model_name)
         lora_name = model if model in self.lora_adapters else None
         loop = asyncio.get_running_loop()
+        uniq = list(dict.fromkeys(t for p in pairs for t in p))
         try:
             vecs, n_tokens = await loop.run_in_executor(
-                None, self._embed_texts, [t1] + t2, lora_name
+                None, self._embed_texts, uniq, lora_name
             )
         except ValueError as e:
             return web.json_response(proto.error_json(str(e)), status=400)
-        q = vecs[0]
+        by_text = dict(zip(uniq, vecs))
         data = [
-            {"object": "score", "index": i, "score": float(q @ v)}
-            for i, v in enumerate(vecs[1:])
+            {"object": "score", "index": i,
+             "score": float(by_text[a] @ by_text[b])}
+            for i, (a, b) in enumerate(pairs)
         ]
         return web.json_response({
             "id": proto.make_id("score"),

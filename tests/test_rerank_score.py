@@ -166,3 +166,28 @@ def test_unversioned_aliases_require_api_key():
 
     statuses = asyncio.new_event_loop().run_until_complete(run())
     assert all(s == 401 for s in statuses.values()), statuses
+
+
+def test_score_broadcast_pairing():
+    """vLLM pairing semantics: 1xM, Nx1, NxN; mismatched lengths 400."""
+    status, body = _post("/v1/score", {
+        "text_1": ["query", "mid"], "text_2": ["close", "far"],
+    })
+    assert status == 200
+    assert [d["index"] for d in body["data"]] == [0, 1]
+    assert body["data"][0]["score"] == pytest.approx(
+        math.cos(0.1), abs=1e-5)  # query x close
+    status, body = _post("/v1/score", {
+        "text_1": ["query", "mid"], "text_2": "far",
+    })
+    assert status == 200 and len(body["data"]) == 2
+    status, _ = _post("/v1/score", {
+        "text_1": ["query", "mid"], "text_2": ["close", "far", "mid"],
+    })
+    assert status == 400
+
+
+def test_non_dict_body_is_400():
+    for path in ("/v1/rerank", "/v1/score"):
+        status, body = _post(path, [1, 2, 3])
+        assert status == 400, (path, body)
