@@ -30,10 +30,10 @@ class EngineConfig:
     # 0 = prefill always wins (round-1 behavior)
     decode_interleave: int = 1
     # fused decode iterations per dispatch (vLLM --num-scheduler-steps):
-    # sampling runs on device and K tokens come back in ONE host fetch,
-    # amortising the dispatch/fetch RTT. A decode batch containing ANY
-    # sequence with logit penalties falls back to single-step for that
-    # batch (penalties are host-side edits). Must be <= block_size.
+    # sampling (incl. presence/frequency/repetition penalties, whose
+    # token counts ride on device through the scan) runs on device and K
+    # tokens come back in ONE host fetch, amortising the dispatch/fetch
+    # RTT. Must be <= block_size.
     num_scheduler_steps: int = 1
 
     # parallelism (tensor-parallel size over the ICI mesh)

@@ -290,11 +290,25 @@ class LLMEngine:
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
             k_steps = self.config.num_scheduler_steps
-            multi = None
             if k_steps > 1:
-                multi = self._sampling_arrays(seqs)
-            if multi is not None and not multi[4]:
-                temps, top_ps, top_ks, keys, _ = multi
+                temps, top_ps, top_ks, keys, needs_pen = (
+                    self._sampling_arrays(seqs)
+                )
+                penalties = None
+                if needs_pen:
+                    # token-count state rides on device through the scan;
+                    # only the compact generated-id lists cross the bus
+                    pres = np.zeros((len(seqs),), np.float32)
+                    freq = np.zeros((len(seqs),), np.float32)
+                    rep = np.ones((len(seqs),), np.float32)
+                    for i, s in enumerate(seqs):
+                        pres[i] = s.sampling_params.presence_penalty
+                        freq[i] = s.sampling_params.frequency_penalty
+                        rep[i] = s.sampling_params.repetition_penalty
+                    penalties = (
+                        [list(s.generated_token_ids) for s in seqs],
+                        pres, freq, rep,
+                    )
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
@@ -302,6 +316,7 @@ class LLMEngine:
                     tokens, positions, tables, ctx_lens, k_steps,
                     temps, top_ps, top_ks, keys,
                     lora_slots=[self._lora_slot(s) for s in seqs],
+                    penalties=penalties,
                 ))  # (k, b)
                 for i in range(k_steps):
                     for j, seq in enumerate(seqs):
@@ -337,7 +352,8 @@ class LLMEngine:
         self, seqs: list[Sequence], b: int | None = None
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
         """Per-lane sampling parameter arrays + whether any sequence
-        needs logit penalties (which force the single-step host path).
+        needs logit penalties (multi-step then carries token counts on
+        device; single-step applies them host-side in _apply_penalties).
 
         Key = (seed, generated_len): multi-step derives iteration i's key
         as (seed, generated_len + i), bit-identical to i single steps."""

@@ -424,7 +424,8 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
-    def _build_decode_multi(self, b: int, c_pad: int, k_steps: int):
+    def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
+                            use_penalties: bool = False):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -440,7 +441,10 @@ class ModelRunner:
         mc = self.model_config
         scale = self._scale
         bs = self.block_size
-        from production_stack_tpu.engine.sampler import sample_tokens
+        from production_stack_tpu.engine.sampler import (
+            apply_penalties,
+            sample_tokens,
+        )
 
         if self.attention_impl == "pallas":
             from production_stack_tpu.ops import pallas_attention
@@ -472,12 +476,24 @@ class ModelRunner:
 
         def step(params, kc, vc, tokens, positions, page_tables,
                  gather_tables, context_lens, temps, top_ps, top_ks,
-                 base_keys, lora=None, lora_slots=None):
+                 base_keys, gen_ids=None, presence=None, frequency=None,
+                 repetition=None, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             lane = jnp.arange(b)
 
+            if use_penalties:
+                # per-lane generated-token counts, maintained ON DEVICE
+                # across the scan so penalty sampling needs no host round
+                # trip (gen_ids: (b, c_pad) int32, -1 padded)
+                valid = (gen_ids >= 0).astype(jnp.float32)
+                counts0 = jnp.zeros(
+                    (b, mc.vocab_size), jnp.float32
+                ).at[lane[:, None], jnp.maximum(gen_ids, 0)].add(valid)
+            else:
+                counts0 = jnp.zeros((b, 1), jnp.float32)  # unused carry
+
             def one(carry, i):
-                kc, vc, tokens, positions, ctx = carry
+                kc, vc, tokens, positions, ctx, counts = carry
                 # slot for each lane's current position from its block
                 # table (idle lanes carry the zero table -> trash block 0;
                 # K <= block_size keeps them inside it)
@@ -497,12 +513,20 @@ class ModelRunner:
                     logits_rows=lane,
                     lora=lora, lora_slots=lora_slots,
                 )
+                if use_penalties:
+                    logits = apply_penalties(
+                        logits, counts > 0, counts, presence, frequency,
+                        repetition,
+                    )
                 keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
                 nxt = sample_tokens(logits, temps, top_ps, top_ks, keys)
-                return (kc, vc, nxt, positions + 1, ctx + 1), nxt
+                if use_penalties:
+                    counts = counts.at[lane, nxt].add(1.0)
+                return (kc, vc, nxt, positions + 1, ctx + 1, counts), nxt
 
             (kc, vc, *_), toks = jax.lax.scan(
-                one, (kc, vc, tokens, positions, context_lens),
+                one,
+                (kc, vc, tokens, positions, context_lens, counts0),
                 jnp.arange(k_steps),
             )
             return toks, kc, vc  # toks: (k_steps, b)
@@ -689,11 +713,18 @@ class ModelRunner:
         top_ks: np.ndarray,
         keys: np.ndarray,       # (b_actual, 2) uint32
         lora_slots: list[int] | None = None,
+        penalties: tuple | None = None,
     ) -> jax.Array:
         """`steps` fused decode+sample iterations (one dispatch, one
         fetch); returns (steps, b) int32 sampled tokens on device. The
         caller must have grown each block table to cover
-        context_len + steps - 1 positions (scheduler lookahead)."""
+        context_len + steps - 1 positions (scheduler lookahead).
+
+        `penalties`: optional (gen_ids_list, presence, frequency,
+        repetition) — generated-token history per lane (list of int
+        lists) + (b_actual,) penalty arrays; token counts are then
+        maintained on device through the scan (sampler.apply_penalties
+        semantics, bit-identical to the host single-step path)."""
         if steps > self.block_size:
             raise ValueError(
                 f"num_scheduler_steps={steps} > block_size="
@@ -738,14 +769,37 @@ class ModelRunner:
         key_full = np.zeros((b, 2), np.uint32)
         key_full[:b_actual] = keys
 
-        cache_key = (b, c_pad, steps)
+        pen_kw = {}
+        if penalties is not None:
+            gen_lists, presence, frequency, repetition = penalties
+            # pad the generated-id history to c_pad (generated tokens are
+            # part of the context, so it always fits): gen shape then
+            # varies only with the existing ctx bucket — a separate pow2
+            # gen bucket would multiply the compile space mid-serving
+            gen_full = np.full((b, c_pad), -1, np.int32)
+            for i, g in enumerate(gen_lists):
+                gen_full[i, : len(g)] = g
+            pres_full = np.zeros((b,), np.float32)
+            pres_full[:b_actual] = presence
+            freq_full = np.zeros((b,), np.float32)
+            freq_full[:b_actual] = frequency
+            rep_full = np.ones((b,), np.float32)
+            rep_full[:b_actual] = repetition
+            pen_kw = {
+                "gen_ids": jnp.asarray(gen_full),
+                "presence": jnp.asarray(pres_full),
+                "frequency": jnp.asarray(freq_full),
+                "repetition": jnp.asarray(rep_full),
+            }
+
+        cache_key = (b, c_pad, steps, penalties is not None)
         if cache_key not in self._decode_multi_fns:
             logger.info(
-                "compiling multi-step decode b=%d ctx=%d k=%d",
-                b, c_pad, steps,
+                "compiling multi-step decode b=%d ctx=%d k=%d pen=%s",
+                b, c_pad, steps, penalties is not None,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
-                b, c_pad, steps
+                b, c_pad, steps, use_penalties=penalties is not None,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}
@@ -770,6 +824,7 @@ class ModelRunner:
             jnp.asarray(p_full),
             jnp.asarray(k_full),
             jnp.asarray(key_full),
+            **pen_kw,
             **lora_kw,
         )
         return toks

@@ -96,8 +96,8 @@ class BroadcastingRunner:
 
     def decode_multi(self, token_ids, positions, block_tables,
                      context_lens, steps, temps, top_ps, top_ks, keys,
-                     lora_slots=None):
-        self._bc.publish({
+                     lora_slots=None, penalties=None):
+        msg = {
             "kind": "decode_multi",
             "token_ids": [int(t) for t in token_ids],
             "positions": [int(p) for p in positions],
@@ -108,10 +108,20 @@ class BroadcastingRunner:
             "top_ps": np.asarray(top_ps).tolist(),
             "top_ks": np.asarray(top_ks).tolist(),
             "keys": np.asarray(keys, np.uint32).tolist(),
-        })
+        }
+        if penalties is not None:
+            gen, pres, freq, rep = penalties
+            msg["penalties"] = {
+                "gen": [[int(t) for t in g] for g in gen],
+                "pres": np.asarray(pres).tolist(),
+                "freq": np.asarray(freq).tolist(),
+                "rep": np.asarray(rep).tolist(),
+            }
+        self._bc.publish(msg)
         return self._runner.decode_multi(
             token_ids, positions, block_tables, context_lens, steps,
             temps, top_ps, top_ks, keys, lora_slots=lora_slots,
+            penalties=penalties,
         )
 
     def embed(self, *a, **kw):
@@ -156,6 +166,14 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
                 msg[arr] = np.asarray(msg[arr], np.float32
                                       if arr != "top_ks" else np.int32)
             msg["keys"] = np.asarray(msg["keys"], np.uint32)
+            pen = msg.pop("penalties", None)
+            if pen is not None:
+                msg["penalties"] = (
+                    pen["gen"],
+                    np.asarray(pen["pres"], np.float32),
+                    np.asarray(pen["freq"], np.float32),
+                    np.asarray(pen["rep"], np.float32),
+                )
             runner.decode_multi(**msg)
         else:  # future step kinds must fail loudly, not silently desync
             raise RuntimeError(f"unknown multihost step kind {kind!r}")

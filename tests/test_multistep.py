@@ -66,9 +66,9 @@ def test_eos_mid_dispatch():
     assert multi == single
 
 
-def test_penalties_fall_back_to_single_step():
-    """Penalty sampling needs host-side logit edits; outputs must still
-    match the single-step engine exactly."""
+def test_penalties_on_device_parity():
+    """Penalty token counts ride on device through the multi-step scan;
+    outputs must match the single-step host-penalty engine exactly."""
     sp = SamplingParams(max_tokens=6, temperature=0.7, seed=3,
                         repetition_penalty=1.3, ignore_eos=True)
     single = [o.token_ids for o in _engine(1).generate(PROMPTS, sp)]
