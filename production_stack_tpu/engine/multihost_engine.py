@@ -74,6 +74,7 @@ class BroadcastingRunner:
             "start_pos": int(start_pos),
             "block_table": [int(b) for b in block_table],
             "total_len": int(total_len),
+            "lora_slot": int(lora_slot),
         })
         return self._runner.prefill(
             token_ids, start_pos, block_table, total_len,
@@ -82,13 +83,16 @@ class BroadcastingRunner:
 
     def decode(self, token_ids, positions, block_tables, context_lens,
                lora_slots=None):
-        self._bc.publish({
+        msg = {
             "kind": "decode",
             "token_ids": [int(t) for t in token_ids],
             "positions": [int(p) for p in positions],
             "block_tables": [[int(b) for b in t] for t in block_tables],
             "context_lens": [int(c) for c in context_lens],
-        })
+        }
+        if lora_slots is not None:
+            msg["lora_slots"] = [int(s) for s in lora_slots]
+        self._bc.publish(msg)
         return self._runner.decode(
             token_ids, positions, block_tables, context_lens,
             lora_slots=lora_slots,
@@ -109,6 +113,8 @@ class BroadcastingRunner:
             "top_ks": np.asarray(top_ks).tolist(),
             "keys": np.asarray(keys, np.uint32).tolist(),
         }
+        if lora_slots is not None:
+            msg["lora_slots"] = [int(s) for s in lora_slots]
         if penalties is not None:
             gen, pres, freq, rep = penalties
             msg["penalties"] = {

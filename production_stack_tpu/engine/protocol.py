@@ -119,7 +119,11 @@ def chat_response(
     if tool_calls:
         message["tool_calls"] = tool_calls
         message["content"] = text or None
-        finish_reason = "tool_calls"
+        # OpenAI semantics: a parsed tool call flips "stop" to
+        # "tool_calls", but a truncated generation stays "length" so
+        # clients can see the call may be incomplete
+        if finish_reason == "stop":
+            finish_reason = "tool_calls"
     return {
         "id": request_id,
         "object": "chat.completion",

@@ -462,7 +462,8 @@ class EngineServer:
         model = body.get("model", self.model_name)
         lora_name = model if model in self.lora_adapters else None
         top_n = body.get("top_n", len(docs))
-        if not isinstance(top_n, int) or top_n < 0:
+        if isinstance(top_n, bool) or not isinstance(top_n, int) \
+                or top_n < 0:
             return web.json_response(
                 proto.error_json("'top_n' must be a non-negative integer"),
                 status=400,

@@ -13,8 +13,73 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 HERE = os.path.dirname(__file__)
 WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+class _RecordingRunner:
+    """Stands in for ModelRunner; records every call's kwargs."""
+
+    def __init__(self):
+        self.calls = []
+
+    def prefill(self, *a, **kw):
+        self.calls.append(("prefill", kw))
+
+    def decode(self, *a, **kw):
+        self.calls.append(("decode", kw))
+
+    def decode_multi(self, *a, **kw):
+        self.calls.append(("decode_multi", kw))
+
+
+class _FakeBroadcaster:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, msg):
+        self.published.append(msg)
+
+    def next(self, timeout_s=None):
+        return self.published.pop(0)
+
+
+def test_broadcast_carries_lora_slots():
+    """Advisor finding (round 2): leader must publish lora_slots so
+    follower hosts don't run the replicated step with zeroed LoRA slots
+    and silently desync."""
+    from production_stack_tpu.engine import multihost_engine as mhe
+
+    runner = _RecordingRunner()
+    bc = _FakeBroadcaster()
+    proxy = mhe.BroadcastingRunner(runner, bc)
+    proxy.prefill([1, 2, 3], 0, [0, 1], 3, lora_slot=2)
+    proxy.decode([4], [3], [[0, 1]], [4], lora_slots=[2])
+    proxy.decode_multi(
+        [5], [4], [[0, 1]], [5], 2,
+        np.zeros(1), np.ones(1), np.full(1, -1), np.zeros(2, np.uint32),
+        lora_slots=[2],
+    )
+    kinds = [m["kind"] for m in bc.published]
+    assert kinds == ["prefill", "decode", "decode_multi"]
+    assert bc.published[0]["lora_slot"] == 2
+    assert bc.published[1]["lora_slots"] == [2]
+    assert bc.published[2]["lora_slots"] == [2]
+
+    # follower replays the same slots into its local runner
+    follower = _RecordingRunner()
+    bc.published.append({"kind": "shutdown"})
+    orig = mhe.multihost.StepBroadcaster
+    mhe.multihost.StepBroadcaster = lambda: bc
+    try:
+        mhe.follower_loop(follower)
+    finally:
+        mhe.multihost.StepBroadcaster = orig
+    assert follower.calls[0][1]["lora_slot"] == 2
+    assert follower.calls[1][1]["lora_slots"] == [2]
+    assert follower.calls[2][1]["lora_slots"] == [2]
 
 
 def test_two_process_engine_matches_single_process():

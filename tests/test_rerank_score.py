@@ -109,6 +109,15 @@ class TestRerank:
         status, _ = _post("/v1/rerank", {"documents": ["a"]})
         assert status == 400
 
+    def test_bool_top_n_rejected(self):
+        # booleans are ints in Python; {"top_n": true} must 400, not
+        # silently slice to one result
+        for bad in (True, False):
+            status, _ = _post("/v1/rerank", {
+                "query": "q", "documents": ["a", "b"], "top_n": bad,
+            })
+            assert status == 400
+
 
 class TestScore:
     def test_single_and_batch(self):

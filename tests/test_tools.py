@@ -131,15 +131,15 @@ class TestInject:
 # -- server wiring ----------------------------------------------------------
 
 class _FakeOut:
-    def __init__(self, text):
+    def __init__(self, text, finish_reason="stop"):
         self.text = text
-        self.finish_reason = "stop"
+        self.finish_reason = finish_reason
         self.prompt_token_ids = [1, 2, 3]
         self.token_ids = [4, 5]
         self.metrics = None
 
 
-def _make_server(canned_text, **cfg_kw):
+def _make_server(canned_text, finish_reason="stop", **cfg_kw):
     """EngineServer with the engine's generate loop stubbed out."""
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.server import EngineServer
@@ -160,7 +160,7 @@ def _make_server(canned_text, **cfg_kw):
 
         async def generate(self, request_id, sampling_params, lora_name,
                            **kw):
-            yield _FakeOut(canned_text)
+            yield _FakeOut(canned_text, finish_reason)
 
     srv.engine = _Eng()
     srv._observe_finish = lambda out, arrival: None
@@ -217,6 +217,25 @@ class TestServerTools:
         assert msg["content"] == "The weather is nice."
         assert "tool_calls" not in msg
         assert body["choices"][0]["finish_reason"] == "stop"
+
+    def test_truncated_tool_call_keeps_length(self):
+        # a generation cut off by max_tokens whose text still parses as a
+        # tool call must report finish_reason "length" (OpenAI semantics),
+        # so clients can tell the call may be incomplete
+        srv = _make_server(
+            '<tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "Oslo"}}</tool_call>',
+            finish_reason="length",
+            enable_auto_tool_choice=True,
+        )
+        status, body = _post(srv, CHAT, {
+            "messages": [{"role": "user", "content": "weather in oslo"}],
+            "tools": [WEATHER],
+        })
+        assert status == 200, body
+        msg = body["choices"][0]["message"]
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+        assert body["choices"][0]["finish_reason"] == "length"
 
     def test_auto_requires_flag(self):
         srv = _make_server("x")  # enable_auto_tool_choice defaults False
