@@ -40,6 +40,12 @@ ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
 # fused decode iterations per dispatch (amortises the host<->device RTT,
 # which dominates through the tunneled chip; see engine/model_runner.py)
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
+# cross-sequence prefill packing group cap (1 = round-2 behavior)
+PREFILL_SEQS = int(os.environ.get("PST_BENCH_PREFILL_SEQS", "8"))
+# pre-compile the packed-prefill buckets the timed run will hit so no
+# XLA compile lands inside a TTFT measurement (each tunnel compile is
+# tens of seconds)
+PRECOMPILE = os.environ.get("PST_BENCH_PRECOMPILE", "1") == "1"
 HBM_BW_GBPS = float(os.environ.get("PST_BENCH_HBM_BW", "819"))  # v5e
 QPS = float(os.environ.get("PST_BENCH_QPS", "2.0"))  # arrival pacing
 
@@ -114,6 +120,7 @@ def main() -> None:
         max_model_len=4096,
         max_num_seqs=NUM_USERS,
         max_prefill_chunk=512,
+        max_prefill_seqs=PREFILL_SEQS,
         tensor_parallel_size=TP,
         num_scheduler_steps=SCHED_STEPS,
         seed=0,
@@ -146,6 +153,51 @@ def main() -> None:
         SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
     )
     print(f"# warmup/compile {time.time() - t0:.1f}s", file=sys.stderr)
+
+    if PRECOMPILE and PREFILL_SEQS > 1:
+        # sweep the packed-prefill (group, ctx) buckets the QPS-paced run
+        # can form (chunks are all max_prefill_chunk long; group sizes
+        # bucket to powers of two). Synthetic chunks write into
+        # unallocated high blocks: nothing reads them, and real prefills
+        # own their blocks exclusively.
+        t0 = time.time()
+        chunk_len = 512
+        nb = engine.runner.num_blocks
+        bs = config.block_size
+        blocks_per = 2048 // bs
+        max_sweep = min(PREFILL_SEQS, NUM_USERS)
+        # the sweep claims the TOP max_sweep*blocks_per block ids; the
+        # allocator hands out low ids first, so require the pool to be at
+        # least twice the swept range (plus warmup's prefix blocks) or
+        # skip — overwriting live cached K/V would corrupt the timed run
+        if nb < 2 * max_sweep * blocks_per + 64:
+            print(
+                f"# packed-prefill precompile skipped: pool {nb} blocks "
+                f"too small for a {max_sweep}x{blocks_per}-block sweep",
+                file=sys.stderr,
+            )
+            max_sweep = 0
+        s = 2
+        while s <= max_sweep:
+            for total in (512, 1024, 2048):
+                start = total - chunk_len
+                tabs = []
+                for i in range(s):
+                    first = nb - (i + 1) * blocks_per
+                    tabs.append(
+                        list(range(first, first + (total + bs - 1) // bs))
+                    )
+                engine.runner.prefill_batch(
+                    [[1] * chunk_len] * s,
+                    start_positions=[start] * s,
+                    block_tables=tabs,
+                    total_lens=[total] * s,
+                )
+            s *= 2
+        print(
+            f"# packed-prefill precompile {time.time() - t0:.1f}s",
+            file=sys.stderr,
+        )
 
     # -- timed run ---------------------------------------------------------
     # QPS-paced arrivals, like the reference harness (multi-round-qa.py

@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     p.add_argument("--no-enable-chunked-prefill",
                    dest="enable_chunked_prefill", action="store_false")
+    p.add_argument("--max-prefill-seqs", type=int, default=8,
+                   help="cross-sequence prefill packing: chunks from up "
+                        "to this many sequences share one dispatch "
+                        "(1 = no packing)")
     p.add_argument("--decode-interleave", type=int, default=1,
                    help="max consecutive prefill chunks while decodes "
                         "wait (0 = prefill always wins)")
@@ -111,6 +115,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_num_seqs=args.max_num_seqs,
         max_prefill_chunk=args.max_prefill_chunk,
         enable_chunked_prefill=args.enable_chunked_prefill,
+        max_prefill_seqs=args.max_prefill_seqs,
         decode_interleave=args.decode_interleave,
         num_scheduler_steps=args.num_scheduler_steps,
         enable_prefix_caching=args.enable_prefix_caching,

@@ -25,6 +25,12 @@ class EngineConfig:
     max_num_seqs: int = 8
     max_prefill_chunk: int = 512
     enable_chunked_prefill: bool = True
+    # cross-sequence prefill packing: up to this many sequences' prompt
+    # chunks run in ONE dispatch (N concurrent arrivals cost ~1 program
+    # instead of N — burst TTFT). 1 = round-2 behavior. Group size is
+    # bucketed to powers of two, so the jit compile space grows by
+    # log2(max_prefill_seqs) variants.
+    max_prefill_seqs: int = 8
     enable_prefix_caching: bool = True
     # max consecutive prefill chunks while decodes wait (bounded ITL);
     # 0 = prefill always wins (round-1 behavior)

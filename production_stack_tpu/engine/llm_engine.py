@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import time
 
+import jax.numpy as jnp
 import numpy as np
 
 from production_stack_tpu.engine.block_manager import BlockManager
@@ -68,6 +69,7 @@ class LLMEngine:
                 max_prefill_chunk=config.max_prefill_chunk,
                 max_model_len=config.resolved_max_model_len(),
                 enable_chunked_prefill=config.enable_chunked_prefill,
+                max_prefill_seqs=config.max_prefill_seqs,
                 decode_interleave=config.decode_interleave,
                 decode_lookahead=max(0, config.num_scheduler_steps - 1),
             ),
@@ -246,7 +248,7 @@ class LLMEngine:
         self._preemptions_total += len(sched_out.preempted)
         self.last_step_kind = (
             "prefill"
-            if sched_out.prefill is not None
+            if sched_out.prefills
             else "decode"
             if sched_out.decode is not None
             else "idle"
@@ -262,27 +264,57 @@ class LLMEngine:
             self._seqs.pop(seq.request_id, None)
 
         stepped: list[Sequence] = []
-        if sched_out.prefill is not None:
-            w = sched_out.prefill
-            seq = w.seq
-            if seq.metrics.first_scheduled_time is None:
-                seq.metrics.first_scheduled_time = time.time()
-            chunk = seq.prompt_token_ids[
-                w.chunk_start : w.chunk_start + w.chunk_len
+        if sched_out.prefills:
+            works = sched_out.prefills
+            now = time.time()
+            for w in works:
+                if w.seq.metrics.first_scheduled_time is None:
+                    w.seq.metrics.first_scheduled_time = now
+            if len(works) == 1:
+                # single-sequence path keeps the round-2 compile buckets
+                w = works[0]
+                seq = w.seq
+                chunk = seq.prompt_token_ids[
+                    w.chunk_start : w.chunk_start + w.chunk_len
+                ]
+                logits = self.runner.prefill(
+                    chunk,
+                    start_pos=w.chunk_start,
+                    block_table=seq.block_table,
+                    total_len=w.chunk_start + w.chunk_len,
+                    lora_slot=self._lora_slot(seq),
+                )
+                last_logits = {0: logits}
+            else:
+                # packed cross-sequence prefill: one dispatch covers
+                # every scheduled chunk (burst-TTFT fix)
+                logits = self.runner.prefill_batch(
+                    [
+                        w.seq.prompt_token_ids[
+                            w.chunk_start : w.chunk_start + w.chunk_len
+                        ]
+                        for w in works
+                    ],
+                    start_positions=[w.chunk_start for w in works],
+                    block_tables=[w.seq.block_table for w in works],
+                    total_lens=[
+                        w.chunk_start + w.chunk_len for w in works
+                    ],
+                    lora_slots=[self._lora_slot(w.seq) for w in works],
+                )
+                last_logits = {i: logits[i] for i in range(len(works))}
+            for i, w in enumerate(works):
+                w.seq.num_computed_tokens += w.chunk_len
+                self._prompt_tokens_total += w.chunk_len
+            finals = [
+                (i, w) for i, w in enumerate(works) if w.is_last_chunk
             ]
-            logits = self.runner.prefill(
-                chunk,
-                start_pos=w.chunk_start,
-                block_table=seq.block_table,
-                total_len=w.chunk_start + w.chunk_len,
-                lora_slot=self._lora_slot(seq),
-            )
-            seq.num_computed_tokens += w.chunk_len
-            self._prompt_tokens_total += w.chunk_len
-            if w.is_last_chunk:
-                token = self._sample([seq], logits[None, :])[0]
-                self._append_token(seq, token)
-                stepped.append(seq)
+            if finals:
+                fl = jnp.stack([last_logits[i] for i, _ in finals])
+                sampled = self._sample([w.seq for _, w in finals], fl)
+                for (i, w), token in zip(finals, sampled):
+                    self._append_token(w.seq, int(token))
+                    stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
             tokens = [s.all_token_ids[-1] for s in seqs]

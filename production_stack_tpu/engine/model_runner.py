@@ -5,8 +5,10 @@ TPU without per-step recompilation):
 
 - every device program has a **static shape**, selected from a small set of
   buckets; jit traces each bucket once and the compile cache does the rest;
-- prefill runs one sequence per call with the chunk length padded to a
-  power-of-two bucket and the context padded to a whole-block bucket;
+- prefill packs chunks from up to max_prefill_seqs sequences into one
+  dispatch (prefill_batch; group size bucketed to a power of two), each
+  chunk padded to a power-of-two length bucket and the context padded to
+  a whole-block bucket; single-sequence prefill keeps its own buckets;
 - decode runs a fixed number of lanes (max_num_seqs) with the context padded
   to the max bucket needed this step; idle lanes point at the null block and
   their writes land in the reserved trash slot 0;
@@ -189,6 +191,7 @@ class ModelRunner:
 
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
+        self._prefill_batch_fns: dict[tuple[int, int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
         self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
         self._embed_fns: dict[tuple[int, int], object] = {}
@@ -368,6 +371,89 @@ class ModelRunner:
                 lora=lora, lora_slots=lora_slots,
             )
             return logits[0], kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
+
+    def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
+        """Packed cross-sequence prefill: chunks from s_pad sequences run
+        in ONE device program (one dispatch instead of s_pad — burst-TTFT
+        fix; reference capability bar is vLLM's batched chunked prefill,
+        reference: helm/templates/deployment-vllm-multi.yaml:140-146).
+
+        The flat token axis carries the s_pad chunks back to back
+        (row s*t_pad + r is row r of chunk s): the embedding, projections,
+        MLP, and cache scatters are already per-token, so they batch for
+        free on the MXU; only attention needs per-sequence handling. The
+        Pallas path unrolls the hardware-validated single-sequence kernel
+        s_pad times inside the jitted step — TPU grid programs run
+        sequentially on the core anyway, so this matches a batched-grid
+        kernel's schedule without forking a second Mosaic kernel."""
+        mc = self.model_config
+        scale = self._scale
+
+        if self.attention_impl == "pallas":
+            from production_stack_tpu.ops import pallas_attention
+
+            bs = self.block_size
+            interpret = jax.default_backend() != "tpu"
+            mesh = self.mesh
+
+            # tables: (s_pad, P) per-sequence padded block tables;
+            # q_starts: (s_pad,) absolute position of each chunk's row 0
+            def attn(q, l, kc, vc, tables, q_starts, positions2d,
+                     total_lens):
+                qs = q.reshape(s_pad, t_pad, mc.num_heads, mc.head_dim)
+                outs = []
+                for s in range(s_pad):
+                    if mesh is not None:
+                        o = pallas_attention.paged_prefill_attention_tp(
+                            qs[s], kc, vc, l, tables[s], q_starts[s],
+                            mesh=mesh, block_size=bs, scale=scale,
+                            interpret=interpret,
+                        )
+                    else:
+                        o = pallas_attention.paged_prefill_attention(
+                            qs[s], kc, vc, l, tables[s], q_starts[s],
+                            block_size=bs, scale=scale,
+                            interpret=interpret,
+                        )
+                    outs.append(o)
+                return jnp.concatenate(outs, axis=0)
+        else:
+
+            # tables: (s_pad, c_pad) per-sequence gather slots
+            def attn(q, l, kc, vc, tables, q_starts, positions2d,
+                     total_lens):
+                # advanced-index hoisting (see prefill): (s, c, nkv, d)
+                k_ctx = kc[l, :, tables]
+                v_ctx = vc[l, :, tables]
+                qs = q.reshape(s_pad, t_pad, mc.num_heads, mc.head_dim)
+                out = jax.vmap(
+                    xla_attn.context_attention_prefill,
+                    in_axes=(0, 0, 0, 0, 0, None),
+                )(qs, k_ctx, v_ctx, positions2d, total_lens, scale)
+                return out.reshape(
+                    s_pad * t_pad, mc.num_heads, mc.head_dim
+                )
+
+        def step(params, kc, vc, tokens, positions, write_slots, tables,
+                 q_starts, total_lens, last_rows, lora=None,
+                 lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            attn_fn = functools.partial(
+                attn,
+                tables=tables,
+                q_starts=q_starts,
+                positions2d=positions.reshape(s_pad, t_pad),
+                total_lens=total_lens,
+            )
+            logits, kc, vc = llama.forward(
+                mc, params, tokens, positions, kc, vc, write_slots,
+                lambda q, l, k, v: attn_fn(q, l, k, v),
+                logits_rows=last_rows,
+                lora=lora, lora_slots=lora_slots,
+            )
+            return logits, kc, vc  # logits: (s_pad, vocab)
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
@@ -625,6 +711,101 @@ class ModelRunner:
             jnp.asarray(gather_slots),
             jnp.int32(total_len),
             jnp.int32(t - 1),
+            **lora_kw,
+        )
+        return logits
+
+    def prefill_batch(
+        self,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+        lora_slots: list[int] | None = None,
+    ) -> jax.Array:
+        """Run one prompt chunk for EACH of n sequences in a single packed
+        dispatch; returns fp32 logits (s_pad, vocab) where row s is the
+        logits of chunk s's last *actual* token (rows >= n are padding).
+        K/V for every chunk is written into the cache."""
+        n = len(chunks)
+        s_pad = next_pow2(max(n, 1))
+        t_pad = self._prefill_bucket(max(len(c) for c in chunks))
+        c_pad = max(self._ctx_bucket(tl) for tl in total_lens)
+
+        tokens = np.zeros((s_pad, t_pad), dtype=np.int32)
+        positions = np.full((s_pad, t_pad), -1, dtype=np.int32)
+        write_slots = np.zeros((s_pad, t_pad), dtype=np.int32)
+        q_starts = np.zeros((s_pad,), dtype=np.int32)
+        tl_full = np.ones((s_pad,), dtype=np.int32)
+        last_rows = np.zeros((s_pad,), dtype=np.int32)
+        for s, (ids, start) in enumerate(zip(chunks, start_positions)):
+            t = len(ids)
+            tokens[s, :t] = ids
+            positions[s, :t] = np.arange(start, start + t)
+            write_slots[s] = self._slots_for_positions(
+                block_tables[s], positions[s]
+            )
+            q_starts[s] = start
+            tl_full[s] = total_lens[s]
+            last_rows[s] = s * t_pad + (t - 1)
+        for s in range(n, s_pad):
+            last_rows[s] = s * t_pad
+        # padded rows/sequences: position -1 -> rope of position 0, write
+        # to the trash slot; their attention output is never read
+        positions_dev = np.where(positions < 0, 0, positions).astype(
+            np.int32
+        )
+        if self.attention_impl == "pallas":
+            n_pages = c_pad // self.block_size
+            tables = np.stack([
+                self._padded_block_table(
+                    block_tables[s] if s < n else [], n_pages
+                )
+                for s in range(s_pad)
+            ])
+        else:
+            tables = np.zeros((s_pad, c_pad), dtype=np.int32)
+            for s in range(n):
+                tables[s] = self._gather_slots_for_table(
+                    block_tables[s], c_pad
+                )
+
+        key = (s_pad, t_pad, c_pad)
+        if key not in self._prefill_batch_fns:
+            logger.info(
+                "compiling packed prefill step s=%d t=%d ctx=%d",
+                s_pad, t_pad, c_pad,
+            )
+            self._prefill_batch_fns[key] = self._build_prefill_batch(
+                s_pad, t_pad, c_pad
+            )
+        fn = self._prefill_batch_fns[key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            slots = lora_slots if lora_slots is not None else [0] * n
+            if len(set(slots)) <= 1:
+                # whole group shares one adapter: uniform fast path
+                slots_arg = jnp.int32(slots[0] if slots else 0)
+            else:
+                per_tok = np.zeros((s_pad, t_pad), dtype=np.int32)
+                for s, slot in enumerate(slots):
+                    per_tok[s] = slot
+                slots_arg = jnp.asarray(per_tok.reshape(-1))
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": slots_arg,
+            }
+        logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens.reshape(-1)),
+            jnp.asarray(positions_dev.reshape(-1)),
+            jnp.asarray(write_slots.reshape(-1)),
+            jnp.asarray(tables),
+            jnp.asarray(q_starts),
+            jnp.asarray(tl_full),
+            jnp.asarray(last_rows),
             **lora_kw,
         )
         return logits

@@ -81,6 +81,23 @@ class BroadcastingRunner:
             lora_slot=lora_slot,
         )
 
+    def prefill_batch(self, chunks, start_positions, block_tables,
+                      total_lens, lora_slots=None):
+        msg = {
+            "kind": "prefill_batch",
+            "chunks": [[int(t) for t in c] for c in chunks],
+            "start_positions": [int(p) for p in start_positions],
+            "block_tables": [[int(b) for b in t] for t in block_tables],
+            "total_lens": [int(t) for t in total_lens],
+        }
+        if lora_slots is not None:
+            msg["lora_slots"] = [int(s) for s in lora_slots]
+        self._bc.publish(msg)
+        return self._runner.prefill_batch(
+            chunks, start_positions, block_tables, total_lens,
+            lora_slots=lora_slots,
+        )
+
     def decode(self, token_ids, positions, block_tables, context_lens,
                lora_slots=None):
         msg = {
@@ -165,6 +182,8 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
             return
         if kind == "prefill":
             runner.prefill(**msg)
+        elif kind == "prefill_batch":
+            runner.prefill_batch(**msg)
         elif kind == "decode":
             runner.decode(**msg)
         elif kind == "decode_multi":

@@ -3,11 +3,12 @@
 The capability contract mirrors what the reference stack's engines provide
 (continuous batching + chunked prefill flags in reference:
 helm/templates/deployment-vllm-multi.yaml:140-146), re-shaped for TPU/XLA:
-each engine step is either ONE prefill chunk (bucketed length, batch 1) or ONE
-decode batch (fixed lane count), so every device program has a static shape
-and jit traces a handful of bucket variants total. Prefill is
-prefill-priority (lowest TTFT, the benchmark's headline metric) with a token
-budget per chunk; decode packs all running sequences into one batch.
+each engine step is either ONE packed prefill dispatch (chunks from up to
+max_prefill_seqs sequences, each bucketed to a static length) or ONE decode
+batch (fixed lane count), so every device program has a static shape and
+jit traces a handful of bucket variants total. Prefill is prefill-priority
+(lowest TTFT, the benchmark's headline metric) with a token budget per
+chunk; decode packs all running sequences into one batch.
 
 Queues: waiting (FIFO admission) -> running; preemption-by-recomputation
 pushes the youngest running sequence back to the front of waiting when KV
@@ -17,8 +18,9 @@ Prefill/decode interleaving: a long multi-chunk prefill must not starve
 running decodes (the reference stack's engines mix chunked prefill with
 decode in one step — reference: helm/templates/deployment-vllm-multi.yaml:140-146;
 our static-shape design alternates instead). `decode_interleave = K` caps
-consecutive prefill chunks at K while any decode-ready sequence exists, so
-the inter-token gap of a running stream is bounded by K prefill chunks +
+consecutive prefill CHUNKS at K while any decode-ready sequence exists
+(a packed dispatch of N chunks spends N units of that budget), so the
+inter-token gap of a running stream is bounded by ~K prefill chunks +
 one decode step regardless of how many new users are admitted.
 """
 
@@ -54,7 +56,9 @@ class DecodeWork:
 
 @dataclass
 class SchedulerOutput:
-    prefill: PrefillWork | None = None
+    # one step runs EVERY listed prefill chunk in a single packed
+    # dispatch (cross-sequence prefill packing); empty list = no prefill
+    prefills: list[PrefillWork] = field(default_factory=list)
     decode: DecodeWork | None = None
     preempted: list[Sequence] = field(default_factory=list)
     # sequences rejected at admission (e.g. prompt too long); the engine
@@ -62,9 +66,14 @@ class SchedulerOutput:
     aborted: list[Sequence] = field(default_factory=list)
 
     @property
+    def prefill(self) -> PrefillWork | None:
+        """First scheduled prefill chunk (single-chunk-era accessor)."""
+        return self.prefills[0] if self.prefills else None
+
+    @property
     def is_empty(self) -> bool:
         return (
-            self.prefill is None
+            not self.prefills
             and self.decode is None
             and not self.aborted
         )
@@ -76,6 +85,12 @@ class SchedulerConfig:
     max_prefill_chunk: int = 512
     max_model_len: int = 8192
     enable_chunked_prefill: bool = True
+    # cross-sequence prefill packing: chunks from up to this many
+    # sequences share one dispatch. Packing needs chunked prefill (each
+    # chunk is bounded by max_prefill_chunk, so a packed program is at
+    # most max_prefill_seqs x max_prefill_chunk tokens); with chunking
+    # off, groups stay at 1.
+    max_prefill_seqs: int = 8
     # max consecutive prefill chunks while decode-ready sequences wait;
     # 0 disables interleaving (prefill runs to completion first)
     decode_interleave: int = 1
@@ -189,20 +204,33 @@ class Scheduler:
             and self._prefill_streak >= self.config.decode_interleave
         )
         if not decode_starved:
+            group_cap = (
+                self.config.max_prefill_seqs
+                if self.config.enable_chunked_prefill
+                else 1
+            )
             for seq in self.running:
-                if not seq.prefill_done:
-                    chunk_len = seq.num_uncomputed_prompt_tokens
-                    if self.config.enable_chunked_prefill:
-                        chunk_len = min(
-                            chunk_len, self.config.max_prefill_chunk
-                        )
-                    out.prefill = PrefillWork(
-                        seq=seq,
-                        chunk_start=seq.num_computed_tokens,
-                        chunk_len=chunk_len,
+                if seq.prefill_done:
+                    continue
+                if len(out.prefills) >= group_cap:
+                    break
+                chunk_len = seq.num_uncomputed_prompt_tokens
+                if self.config.enable_chunked_prefill:
+                    chunk_len = min(
+                        chunk_len, self.config.max_prefill_chunk
                     )
-                    self._prefill_streak += 1
-                    return out
+                out.prefills.append(PrefillWork(
+                    seq=seq,
+                    chunk_start=seq.num_computed_tokens,
+                    chunk_len=chunk_len,
+                ))
+            if out.prefills:
+                # streak counts CHUNKS, not dispatches: a packed group of
+                # N chunks consumes N units of the decode_interleave
+                # budget, so the documented ITL bound ("at most K prefill
+                # chunks between decode steps") survives packing
+                self._prefill_streak += len(out.prefills)
+                return out
         self._prefill_streak = 0
 
         # 3) otherwise decode every decode-ready running sequence (mid-

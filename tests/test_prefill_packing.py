@@ -1,0 +1,176 @@
+"""Cross-sequence prefill packing: chunks from several sequences run in
+one packed dispatch (round-2 verdict item 2 — burst TTFT). The packed
+path must be bit-identical to the round-2 one-sequence-per-step path on
+both attention impls, including prefix sharing inside one group.
+
+Reference capability bar: batched chunked prefill inside vLLM
+(reference: helm/templates/deployment-vllm-multi.yaml:140-146)."""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.model_runner import ModelRunner
+from production_stack_tpu.engine.sampling_params import SamplingParams
+
+
+def tiny_cfg(**overrides) -> EngineConfig:
+    kwargs = dict(
+        model="pst-tiny-debug",
+        tokenizer="byte",
+        dtype="float32",
+        cache_dtype="float32",
+        block_size=4,
+        num_kv_blocks=128,
+        max_num_seqs=4,
+        max_prefill_chunk=16,
+        seed=0,
+    )
+    kwargs.update(overrides)
+    return EngineConfig(**kwargs)
+
+
+def greedy(n):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def _prompts():
+    rng = np.random.RandomState(7)
+    # mixed lengths: same-bucket chunks, smaller last chunks, one-chunk
+    # prompts — exercises ragged groups and mid/last chunk mixes
+    return [rng.randint(0, 384, size=n).tolist() for n in (5, 23, 45, 12)]
+
+
+def test_packed_matches_unpacked_engine():
+    packed = LLMEngine(tiny_cfg(max_prefill_seqs=8))
+    unpacked = LLMEngine(tiny_cfg(max_prefill_seqs=1))
+    out_p = [o.token_ids for o in packed.generate(_prompts(), greedy(6))]
+    out_u = [o.token_ids for o in unpacked.generate(_prompts(), greedy(6))]
+    assert out_p == out_u
+
+
+def test_packed_pallas_interpret_matches_xla():
+    kw = dict(block_size=8, num_kv_blocks=64, max_prefill_chunk=32,
+              max_prefill_seqs=8)
+    eng_x = LLMEngine(tiny_cfg(attention_impl="xla", **kw))
+    out_x = [o.token_ids for o in eng_x.generate(_prompts(), greedy(6))]
+    eng_p = LLMEngine(tiny_cfg(attention_impl="pallas", **kw))
+    assert eng_p.runner.attention_impl == "pallas"
+    out_p = [o.token_ids for o in eng_p.generate(_prompts(), greedy(6))]
+    assert out_p == out_x
+
+
+def test_packed_group_shares_cached_prefix():
+    """Two sequences admitted together whose prompts share a cached
+    prefix (from an earlier request) must both reuse it and still match
+    the unpacked engine."""
+    shared = list(range(1, 17))  # 4 whole blocks
+    tails = [[100, 101, 102], [200, 201, 202, 203]]
+    prompts = [shared + t for t in tails]
+    packed = LLMEngine(tiny_cfg(max_prefill_seqs=8))
+    unpacked = LLMEngine(tiny_cfg(max_prefill_seqs=1))
+    # prime the prefix cache in both engines
+    packed.generate([shared], greedy(2))
+    unpacked.generate([shared], greedy(2))
+    out_p = [o.token_ids for o in packed.generate(prompts, greedy(5))]
+    out_u = [o.token_ids for o in unpacked.generate(prompts, greedy(5))]
+    assert out_p == out_u
+    assert packed.block_manager.prefix_hits > 0
+
+
+def test_runner_prefill_batch_matches_sequential():
+    """Runner-level: one packed dispatch == n sequential prefill calls
+    (same logits, same cache contents)."""
+    cfg = tiny_cfg()
+    r_seq = ModelRunner(cfg)
+    r_bat = ModelRunner(cfg)
+
+    rng = np.random.RandomState(3)
+    chunks = [rng.randint(0, 384, size=n).tolist() for n in (7, 16, 3)]
+    tables = [[2, 3], [4, 5, 6, 7], [8]]
+    starts = [0, 0, 0]
+    totals = [len(c) for c in chunks]
+
+    seq_logits = [
+        np.asarray(r_seq.prefill(c, s, bt, tl))
+        for c, s, bt, tl in zip(chunks, starts, tables, totals)
+    ]
+    bat_logits = np.asarray(r_bat.prefill_batch(
+        chunks, starts, tables, totals
+    ))
+    for i, sl in enumerate(seq_logits):
+        np.testing.assert_allclose(bat_logits[i], sl, rtol=1e-5,
+                                   atol=1e-5)
+    # identical KV writes (compare only the slots the chunks own; the
+    # trash block 0 legitimately differs)
+    slots = sorted({
+        bt_i * cfg.block_size + o
+        for bt in tables for bt_i in bt
+        for o in range(cfg.block_size)
+    })
+    # one-dispatch vs three-dispatch XLA programs fuse differently;
+    # allow f32 accumulation noise
+    np.testing.assert_allclose(
+        np.asarray(r_bat.k_cache[:, :, slots]),
+        np.asarray(r_seq.k_cache[:, :, slots]),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_scheduler_packs_up_to_cap():
+    from production_stack_tpu.engine.block_manager import BlockManager
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.sequence import Sequence
+
+    bm = BlockManager(num_blocks=64, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=8, max_prefill_chunk=8,
+                        max_prefill_seqs=3),
+        bm,
+    )
+    for i in range(5):
+        sched.add_seq(Sequence(
+            request_id=f"r{i}", prompt_token_ids=list(range(1, 11)),
+            sampling_params=SamplingParams(max_tokens=2),
+            eos_token_id=None,
+        ))
+    out = sched.schedule()
+    # group capped at max_prefill_seqs, not everything runnable
+    assert len(out.prefills) == 3
+    assert [w.seq.request_id for w in out.prefills] == ["r0", "r1", "r2"]
+    assert all(w.chunk_len == 8 for w in out.prefills)
+    # single-chunk-era accessor still works
+    assert out.prefill is out.prefills[0]
+
+
+def test_scheduler_no_packing_without_chunking():
+    from production_stack_tpu.engine.block_manager import BlockManager
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.sequence import Sequence
+
+    bm = BlockManager(num_blocks=64, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=8, max_prefill_chunk=8,
+                        enable_chunked_prefill=False,
+                        max_prefill_seqs=4),
+        bm,
+    )
+    for i in range(3):
+        sched.add_seq(Sequence(
+            request_id=f"r{i}", prompt_token_ids=list(range(1, 11)),
+            sampling_params=SamplingParams(max_tokens=2),
+            eos_token_id=None,
+        ))
+    out = sched.schedule()
+    # unbounded whole-prompt chunks must not pack (bucket blowup guard)
+    assert len(out.prefills) == 1
+    assert out.prefills[0].chunk_len == 10
