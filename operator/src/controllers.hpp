@@ -9,9 +9,11 @@
 #pragma once
 
 #include <algorithm>
+#include <functional>
 #include <iostream>
 #include <map>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "kube.hpp"
@@ -355,9 +357,41 @@ struct LoraPlacement {
   std::string pod_ip;
 };
 
+// how many LoRA adapters an engine currently serves: /v1/models lists one
+// card per adapter with root == adapter path (!= id); the base model card
+// has root == id. The adapter being reconciled is excluded (by its own
+// path/name) — otherwise a steady-state resync would see its previous
+// placement as "load" and hop the adapter to a fresh engine every tick.
+// Unreachable engines count 0 (they sort first, and the subsequent load
+// attempt reports the real error in status).
+inline int count_loaded_adapters(const std::string& ip, int port,
+                                 const std::string& exclude_path = "",
+                                 const std::string& exclude_name = "") {
+  try {
+    psthttp::Client engine(ip, port, 5);
+    auto r = engine.get("/v1/models");
+    if (r.status >= 300) return 0;
+    Json data = Json::parse(r.body);
+    int n = 0;
+    for (const Json& card : data.get("data").elements()) {
+      const std::string id = card.get("id").as_string();
+      const std::string root = card.get("root").as_string();
+      if (root.empty() || root == id) continue;  // base model card
+      if (!exclude_path.empty() && root == exclude_path) continue;
+      if (!exclude_name.empty() && id == exclude_name) continue;
+      ++n;
+    }
+    return n;
+  } catch (const std::exception&) {
+    return 0;
+  }
+}
+
 inline std::vector<LoraPlacement> pick_placements(
     const std::vector<Json>& pods, const std::string& algorithm,
-    int max_engines) {
+    int max_engines,
+    const std::function<int(const LoraPlacement&)>& adapter_count =
+        nullptr) {
   std::vector<LoraPlacement> ready;
   for (const auto& pod : pods) {
     if (pod.get("status").get("phase").as_string() != "Running") continue;
@@ -371,19 +405,29 @@ inline std::vector<LoraPlacement> pick_placements(
               return a.pod_name < b.pod_name;
             });
   // "default": all ready engines; "ordered": first max_engines by name;
-  // "equalized" (multi-adapter spreading) degrades to ordered here — the
-  // spread emerges because each adapter CR picks from the same sorted
-  // list with its own offset (hash of adapter name)
+  // "equalized": spread adapters by current load — engines serving the
+  // FEWEST adapters first (queried live via /v1/models), name-ordered
+  // within a tie. Exceeds the reference bar (its getOptimalPlacement is
+  // an acknowledged TODO returning the first N ready pods,
+  // reference: loraadapter_controller.go:394-440).
   if (algorithm == "ordered" && max_engines > 0 &&
       static_cast<int>(ready.size()) > max_engines)
     ready.resize(max_engines);
-  if (algorithm == "equalized" && !ready.empty() && max_engines > 0 &&
-      static_cast<int>(ready.size()) > max_engines) {
-    size_t offset = 0;
-    for (char c : algorithm) offset += c;
-    std::rotate(ready.begin(), ready.begin() + (offset % ready.size()),
-                ready.end());
-    ready.resize(max_engines);
+  if (algorithm == "equalized" && !ready.empty()) {
+    // one live query per engine, then a stable least-loaded sort
+    std::vector<std::pair<int, LoraPlacement>> counted;
+    counted.reserve(ready.size());
+    for (const auto& p : ready)
+      counted.emplace_back(adapter_count ? adapter_count(p) : 0, p);
+    std::stable_sort(counted.begin(), counted.end(),
+                     [](const auto& a, const auto& b) {
+                       return a.first < b.first;
+                     });
+    ready.clear();
+    for (const auto& cp : counted) ready.push_back(cp.second);
+    if (max_engines > 0 &&
+        static_cast<int>(ready.size()) > max_engines)
+      ready.resize(max_engines);
   }
   return ready;
 }
@@ -408,7 +452,12 @@ inline void reconcile_loraadapter(KubeClient& kube, const std::string& ns,
   std::string selector = "app=pst-engine";
   if (!base_model.empty()) selector += ",model=" + base_model;
   auto pods = kube.list(pstkube::kPods, ns, selector);
-  auto placements = pick_placements(pods, algorithm, max_engines);
+  auto placements = pick_placements(
+      pods, algorithm, max_engines,
+      [engine_port, &adapter_path, &adapter_name](const LoraPlacement& p) {
+        return count_loaded_adapters(p.pod_ip, engine_port, adapter_path,
+                                     adapter_name);
+      });
 
   Json loaded = Json::array();
   for (const auto& p : placements) {

@@ -155,8 +155,11 @@ def chat_chunk(
     }
 
 
-def model_card(name: str, root: str | None = None) -> dict:
-    return {
+def model_card(
+    name: str, root: str | None = None,
+    kv_instance_id: str | None = None,
+) -> dict:
+    card = {
         "id": name,
         "object": "model",
         "created": int(time.time()),
@@ -166,3 +169,10 @@ def model_card(name: str, root: str | None = None) -> dict:
         "max_model_len": None,
         "permission": [],
     }
+    if kv_instance_id is not None:
+        # advertised so the router's kvaware/ttft logic can map KV
+        # controller matches to this endpoint without relying on the
+        # id == host:port convention (reference role:
+        # src/gateway_inference_extension/kv_aware_picker.go:90-131)
+        card["kv_instance_id"] = kv_instance_id
+    return card

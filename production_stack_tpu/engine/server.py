@@ -550,7 +550,10 @@ class EngineServer:
 
     # -- misc endpoints ----------------------------------------------------
     async def handle_models(self, request: web.Request) -> web.Response:
-        cards = [proto.model_card(self.model_name)]
+        cards = [proto.model_card(
+            self.model_name,
+            kv_instance_id=self.config.kv_instance_id,
+        )]
         cards += [
             proto.model_card(name, root=path)
             for name, path in self.lora_adapters.items()

@@ -134,6 +134,8 @@ class RouterApp:
             session_key=args.session_key,
             kv_controller_url=args.kv_controller_url,
             kv_min_match_tokens=args.kv_aware_threshold,
+            kv_transfer_gbps=args.kv_transfer_gbps,
+            kv_bytes_per_token=args.kv_bytes_per_token,
             tokenizer=tokenizer,
         )
 

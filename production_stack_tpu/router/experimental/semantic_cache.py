@@ -94,6 +94,12 @@ class VectorIndex:
     def __len__(self) -> int:
         return len(self.payloads)
 
+    def trim_to(self, keep: int) -> None:
+        """FIFO eviction: keep only the newest `keep` entries (bounds the
+        exact scan and the memory footprint)."""
+        self.vectors = self.vectors[-keep:]
+        self.payloads = self.payloads[-keep:]
+
     # -- persistence (np.savez + json, reference pickles FAISS + db:
     #    db_adapters/faiss_adapter.py:47-70) ------------------------------
     def save(self, directory: str) -> None:
@@ -117,6 +123,73 @@ class VectorIndex:
         return idx
 
 
+class FaissVectorIndex(VectorIndex):
+    """FAISS-accelerated inner-product index behind the VectorIndex
+    interface (reference: db_adapters/faiss_adapter.py:14-70 uses
+    IndexFlatIP the same way). Falls back is handled by the caller:
+    constructing this class without faiss installed raises ImportError.
+
+    Vectors are mirrored in the numpy array (the source of truth for
+    persistence and trim); faiss only serves the search. At router-cache
+    scale the mirror is tiny, and it keeps save/load/trim_to semantics
+    identical to the exact index."""
+
+    def __init__(self, dim: int):
+        import faiss  # noqa: F401 — ImportError => caller falls back
+
+        super().__init__(dim)
+        self._faiss = faiss
+        self._index = faiss.IndexFlatIP(dim)
+
+    def add(self, vec: np.ndarray, payload: dict) -> None:
+        super().add(vec, payload)
+        self._index.add(vec[None, :].astype(np.float32))
+
+    def search(self, vec: np.ndarray) -> tuple[float, dict | None]:
+        if len(self.payloads) == 0:
+            return 0.0, None
+        sims, ids = self._index.search(
+            vec[None, :].astype(np.float32), 1
+        )
+        i = int(ids[0, 0])
+        if i < 0:
+            return 0.0, None
+        return float(sims[0, 0]), self.payloads[i]
+
+    def _rebuild(self) -> None:
+        self._index = self._faiss.IndexFlatIP(self.dim)
+        if len(self.vectors):
+            self._index.add(self.vectors.astype(np.float32))
+
+    def trim_to(self, keep: int) -> None:
+        super().trim_to(keep)
+        self._rebuild()
+
+    @classmethod
+    def load(cls, directory: str, dim: int) -> "FaissVectorIndex":
+        idx = cls(dim)
+        base = VectorIndex.load(directory, dim)
+        idx.vectors, idx.payloads = base.vectors, base.payloads
+        idx._rebuild()
+        return idx
+
+
+def make_vector_index(
+    dim: int, cache_dir: str | None = None, backend: str = "auto"
+) -> VectorIndex:
+    """backend: "auto" (faiss if importable), "faiss", or "exact"."""
+    cls: type[VectorIndex] = VectorIndex
+    if backend in ("auto", "faiss"):
+        try:
+            FaissVectorIndex(1)  # probe the import cheaply
+            cls = FaissVectorIndex
+        except ImportError:
+            if backend == "faiss":
+                raise
+            logger.info("faiss not installed; exact index")
+    return cls.load(cache_dir, dim) if cache_dir else cls(dim)
+
+
 def _chat_request_text(body: dict) -> str | None:
     msgs = body.get("messages")
     if not isinstance(msgs, list):
@@ -134,7 +207,7 @@ class SemanticCache:
 
     def __init__(self, model_name: str = "all-MiniLM-L6-v2",
                  cache_dir: str | None = None, threshold: float = 0.95,
-                 max_entries: int = 4096):
+                 max_entries: int = 4096, index_backend: str = "auto"):
         self.threshold = threshold
         self.cache_dir = cache_dir
         self.max_entries = max_entries
@@ -145,9 +218,7 @@ class SemanticCache:
             self.embedder = HashedNgramEmbedder()
             logger.info("semantic cache: hermetic hashed-ngram embedder")
         dim = self.embedder.dim
-        self.index = (
-            VectorIndex.load(cache_dir, dim) if cache_dir else VectorIndex(dim)
-        )
+        self.index = make_vector_index(dim, cache_dir, index_backend)
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -205,9 +276,7 @@ class SemanticCache:
                 return  # near-duplicate already cached
             if len(self.index) >= self.max_entries:
                 # simple FIFO trim: drop the oldest half
-                keep = self.max_entries // 2
-                self.index.vectors = self.index.vectors[-keep:]
-                self.index.payloads = self.index.payloads[-keep:]
+                self.index.trim_to(self.max_entries // 2)
             self.index.add(vec, {"request_text": text, "response": response})
             self.stores += 1
         self._dirty.set()

@@ -96,6 +96,16 @@ def build_parser() -> argparse.ArgumentParser:
     rout.add_argument("--kv-aware-threshold", type=int, default=2000,
                       help="min matched tokens before kvaware overrides "
                            "load-based choice")
+    rout.add_argument("--kv-transfer-gbps", type=float, default=10.0,
+                      help="inter-engine KV pull bandwidth the ttft "
+                           "estimator assumes for prefixes cached on a "
+                           "DIFFERENT instance (0 disables the "
+                           "transfer-time correction)")
+    rout.add_argument("--kv-bytes-per-token", type=int, default=114688,
+                      help="KV cache bytes per token for the ttft "
+                           "transfer-time correction (default: "
+                           "Llama-3.2-3B bf16: 2*28 layers*8 kv heads"
+                           "*128 head dim*2 bytes)")
     rout.add_argument("--prefill-model-labels", type=str, default=None,
                       help="comma-separated labels marking prefill pods")
     rout.add_argument("--decode-model-labels", type=str, default=None,

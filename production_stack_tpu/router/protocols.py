@@ -51,6 +51,10 @@ class EndpointInfo:
     model_names: list[str] = field(default_factory=list)
     model_info: dict[str, ModelInfo] = field(default_factory=dict)
     model_label: str | None = None  # helm modelSpec label (PD roles use it)
+    # the engine's --kv-instance-id, advertised via /v1/models metadata;
+    # kvaware/ttft routing match KV controller results on it (falling
+    # back to the id == host:port convention when absent)
+    kv_instance_id: str | None = None
     added_timestamp: float = field(default_factory=time.time)
     sleep: bool = False
     pod_name: str | None = None

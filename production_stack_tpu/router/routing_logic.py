@@ -128,6 +128,26 @@ def _hostport(url_or_instance: str) -> str:
         return s
 
 
+def _match_instance_to_url(
+    inst: str, endpoints: list[EndpointInfo]
+) -> str | None:
+    """Map a KV controller instance id to an endpoint url.
+
+    Preference order: the engine-advertised kv_instance_id carried on
+    EndpointInfo (the handshake — robust to ids that are not host:port),
+    then the id == url host:port convention. Exact comparisons only:
+    substring matching would let instance "host:80" claim endpoint
+    "http://host:8000"."""
+    for ep in endpoints:
+        if ep.kv_instance_id and inst == ep.kv_instance_id:
+            return ep.url
+    inst_hp = _hostport(inst)
+    for ep in endpoints:
+        if inst == ep.url or inst_hp == _hostport(ep.url):
+            return ep.url
+    return None
+
+
 def _engine_prompt_text(request, tokenizer=None) -> str:
     """Render the request exactly as the engine will (chat template applied)
     so chained block hashes line up with engine-side prefix hashes — the
@@ -212,20 +232,13 @@ class KvawareRouter(RoutingInterface):
             inst: n for inst, n in matches.items() if n >= self.min_match
         }
         if by_instance:
-            # map instance ids -> endpoint urls (instance id is the engine's
-            # kv_instance_id; by convention it equals its url host:port or is
-            # advertised via /v1/models metadata)
-            # exact host:port comparison — substring matching would let
-            # instance "host:80" claim endpoint "http://host:8000"
-            urls = {e.url: _hostport(e.url) for e in endpoints}
             best = sorted(
                 by_instance.items(), key=lambda kv: -kv[1]
             )
             for inst, _ in best:
-                inst_hp = _hostport(inst)
-                for url, url_hp in urls.items():
-                    if inst == url or inst_hp == url_hp:
-                        return url
+                url = _match_instance_to_url(inst, endpoints)
+                if url is not None:
+                    return url
         return await self.fallback.route_request(
             endpoints, engine_stats, request_stats, request
         )
@@ -303,12 +316,19 @@ class TtftRouter(RoutingInterface):
         self,
         kv_controller_url: str | None = None,
         tokenizer=None,
+        kv_transfer_gbps: float = 10.0,
+        kv_bytes_per_token: int = 114688,
         **kwargs,
     ):
         self.tokenizer = tokenizer
         self.kv_controller_url = kv_controller_url
         self._kv_client = None
         self.default_prefill_tps = 8000.0
+        # transfer-time correction (reference: routing_logic.py:649-676):
+        # a prefix cached on a DIFFERENT instance can be pulled over the
+        # KV transfer link instead of recomputed; 0 Gbps disables it
+        self.kv_transfer_gbps = kv_transfer_gbps
+        self.kv_bytes_per_token = kv_bytes_per_token
 
     async def start(self) -> None:
         if self.kv_controller_url:
@@ -340,6 +360,7 @@ class TtftRouter(RoutingInterface):
         matched_tokens: int,
         engine_stats: dict[str, EngineStats],
         request_stats: dict[str, RequestStats],
+        matched_elsewhere: int = 0,
     ) -> float:
         rs = request_stats.get(ep.url)
         es = engine_stats.get(ep.url)
@@ -353,7 +374,20 @@ class TtftRouter(RoutingInterface):
         new_tokens = max(1, n_tokens - matched_tokens)
         # queued requests assumed to cost their average prompt; approximate
         # with the backlog signal + a per-request constant
-        return (backlog + new_tokens) / tps + 0.05 * queued
+        est = (backlog + new_tokens) / tps + 0.05 * queued
+        # transfer-time correction: tokens cached on another instance can
+        # be pulled over the KV link instead of recomputed — credit the
+        # cheaper of the two (reference: routing_logic.py:649-676)
+        transferable = max(0, matched_elsewhere - matched_tokens)
+        if transferable > 0 and self.kv_transfer_gbps > 0:
+            compute_s = transferable / tps
+            transfer_s = (
+                transferable * self.kv_bytes_per_token * 8
+                / (self.kv_transfer_gbps * 1e9)
+            )
+            if transfer_s < compute_s:
+                est = est - compute_s + transfer_s
+        return est
 
     async def route_request(self, endpoints, engine_stats, request_stats,
                             request) -> str:
@@ -374,16 +408,21 @@ class TtftRouter(RoutingInterface):
                     tokens = ByteTokenizer().encode(text)
                 raw = await self._kv_client.lookup(tokens)
                 for inst, n in raw.items():
-                    for ep in endpoints:
-                        if inst in ep.url or inst == ep.url:
-                            matches[ep.url] = n
+                    url = _match_instance_to_url(inst, endpoints)
+                    if url is not None:
+                        matches[url] = max(matches.get(url, 0), n)
             except Exception:
                 pass
         best_url, best_ttft = None, float("inf")
         for ep in endpoints:
+            elsewhere = max(
+                (n for url, n in matches.items() if url != ep.url),
+                default=0,
+            )
             est = await self._estimate_ttft(
                 ep, n_tokens, matches.get(ep.url, 0),
                 engine_stats, request_stats,
+                matched_elsewhere=elsewhere,
             )
             if est < best_ttft:
                 best_url, best_ttft = ep.url, est

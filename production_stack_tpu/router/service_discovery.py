@@ -65,8 +65,11 @@ class ServiceDiscovery(abc.ABC):
 
 async def _probe_endpoint(
     url: str, timeout_s: float = 5.0
-) -> tuple[list[str], dict[str, ModelInfo]] | None:
-    """GET <url>/v1/models; returns (model_names, model_info) or None."""
+) -> tuple[list[str], dict[str, ModelInfo], str | None] | None:
+    """GET <url>/v1/models; returns (model_names, model_info,
+    kv_instance_id) or None. The kv instance id is the engine-advertised
+    card metadata that lets kvaware routing map controller matches to
+    this endpoint without the id == host:port convention."""
     try:
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
@@ -77,12 +80,14 @@ async def _probe_endpoint(
                 data = await r.json()
     except Exception:
         return None
-    names, info = [], {}
+    names, info, kv_iid = [], {}, None
     for card in data.get("data", []):
         mi = ModelInfo.from_dict(card)
         names.append(mi.id)
         info[mi.id] = mi
-    return names, info
+        if kv_iid is None:
+            kv_iid = card.get("kv_instance_id")
+    return names, info, kv_iid
 
 
 async def _probe_sleep(url: str, timeout_s: float = 3.0) -> bool:
@@ -149,11 +154,15 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     async def start(self) -> None:
         # discover models for endpoints with no static names
+        # endpoints with preset names skip the probe (hermetic static
+        # configs must start without live backends); their kv instance id
+        # stays None and kvaware matching uses the host:port convention
         for ep in self._endpoints:
             if not ep.model_names:
                 probed = await _probe_endpoint(ep.url)
                 if probed:
-                    ep.model_names, ep.model_info = probed
+                    ep.model_names, ep.model_info = probed[0], probed[1]
+                    ep.kv_instance_id = probed[2]
         if self.health_checks:
             self._task = asyncio.create_task(self._health_loop())
 
@@ -280,7 +289,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         probed = await _probe_endpoint(url)
         if probed is None:
             return
-        names, info = probed
+        names, info, kv_iid = probed
         sleeping = await _probe_sleep(url)
         async with self._lock:
             self._endpoints[pod_name] = EndpointInfo(
@@ -288,6 +297,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 model_names=names,
                 model_info=info,
                 model_label=model_label,
+                kv_instance_id=kv_iid,
                 sleep=sleeping,
                 pod_name=pod_name,
                 namespace=self.namespace,
@@ -315,7 +325,8 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 async with self._lock:
                     if pod_name in self._endpoints:
                         e = self._endpoints[pod_name]
-                        e.model_names, e.model_info = probed
+                        e.model_names, e.model_info = probed[0], probed[1]
+                        e.kv_instance_id = probed[2]
                         e.sleep = sleeping
 
     def get_endpoint_info(self) -> list[EndpointInfo]:
@@ -371,14 +382,14 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
             probed = await _probe_endpoint(url)
             if probed is None:
                 continue
-            names, info = probed
+            names, info, kv_iid = probed
             label = (
                 svc.get("metadata", {}).get("labels", {}).get("model")
             )
             self._endpoints[name] = EndpointInfo(
                 url=url, model_names=names, model_info=info,
                 model_label=label, pod_name=name,
-                namespace=self.namespace,
+                namespace=self.namespace, kv_instance_id=kv_iid,
             )
 
     def get_endpoint_info(self) -> list[EndpointInfo]:

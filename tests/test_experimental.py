@@ -81,6 +81,79 @@ class TestSemanticCacheUnit:
         sc.store(body, {"id": "b"})
         assert sc.stats()["entries"] == 1
 
+    def test_eviction_bounds_index(self):
+        """The exact index must stay bounded (round-2 verdict item 8:
+        honest bound on the O(n) scan): FIFO trim keeps the newest half
+        once max_entries is reached."""
+        sc = SemanticCache(threshold=0.999, max_entries=8)
+        for i in range(13):
+            sc.store(
+                {"messages": [{"role": "user",
+                               "content": f"question number {i} xyz"}]},
+                {"id": f"r{i}"},
+            )
+        assert sc.stats()["entries"] <= 8
+        # the newest entry survived the trim; the oldest did not
+        new_sim, new_payload = sc.index.search(
+            sc.embedder.encode("user: question number 12 xyz")
+        )
+        assert new_payload["response"]["id"] == "r12"
+        old_sim, old_payload = sc.index.search(
+            sc.embedder.encode("user: question number 0 xyz")
+        )
+        assert old_payload["response"]["id"] != "r0" or old_sim < 0.999
+
+
+class TestVectorIndexBackends:
+    def test_make_vector_index_auto_falls_back(self):
+        from production_stack_tpu.router.experimental.semantic_cache import (
+            make_vector_index,
+        )
+
+        idx = make_vector_index(16, backend="auto")
+        assert isinstance(idx, VectorIndex)  # exact fallback or faiss
+
+    def test_make_vector_index_faiss_requires_faiss(self):
+        from production_stack_tpu.router.experimental.semantic_cache import (
+            make_vector_index,
+        )
+
+        try:
+            import faiss  # noqa: F401
+
+            has_faiss = True
+        except ImportError:
+            has_faiss = False
+        if has_faiss:
+            pytest.skip("faiss installed; explicit backend succeeds")
+        with pytest.raises(ImportError):
+            make_vector_index(16, backend="faiss")
+
+    def test_faiss_index_parity(self, tmp_path):
+        """When faiss IS available the adapter must behave exactly like
+        the exact index (search/trim/persist round-trip)."""
+        pytest.importorskip("faiss")
+        from production_stack_tpu.router.experimental.semantic_cache import (
+            FaissVectorIndex,
+            HashedNgramEmbedder,
+        )
+
+        e = HashedNgramEmbedder()
+        idx = FaissVectorIndex(e.dim)
+        for i, text in enumerate(["alpha beta", "gamma delta",
+                                  "epsilon zeta"]):
+            idx.add(e.encode(text), {"response": {"id": str(i)}})
+        sim, payload = idx.search(e.encode("gamma delta"))
+        assert payload["response"]["id"] == "1" and sim > 0.99
+        idx.trim_to(2)
+        assert len(idx) == 2
+        sim, payload = idx.search(e.encode("alpha beta"))
+        assert payload["response"]["id"] != "0" or sim < 0.99
+        idx.save(str(tmp_path))
+        idx2 = FaissVectorIndex.load(str(tmp_path), e.dim)
+        sim, payload = idx2.search(e.encode("epsilon zeta"))
+        assert payload["response"]["id"] == "2" and sim > 0.99
+
 
 # -- unit: PII --------------------------------------------------------------
 class TestPII:

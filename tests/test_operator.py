@@ -313,6 +313,106 @@ def test_lora_adapter_placement_and_load(operator_bin):
     run_in_loop(scenario())
 
 
+def test_lora_equalized_placement_spreads_by_load(operator_bin):
+    """'equalized' must place the adapter on the engine currently serving
+    the FEWEST adapters (live /v1/models query), not simply the first by
+    name — exceeding the reference's TODO placement
+    (loraadapter_controller.go:394-440)."""
+
+    async def scenario():
+        api = FakeApiServer()
+        await api.start()
+
+        calls_by_host: dict[str, list] = {"127.0.0.1": [], "127.0.0.2": []}
+
+        def make_engine(host: str, n_preloaded: int):
+            # /v1/models reflects loads live, like the real engine — the
+            # resync-stability assertion below depends on it
+            loaded: list[dict] = []
+
+            async def load_lora(request):
+                body = await request.json()
+                calls_by_host[host].append(body)
+                loaded.append({"id": body["lora_name"],
+                               "root": body["lora_path"]})
+                return web.json_response({"status": "ok"})
+
+            async def models(request):
+                cards = [{"id": "m", "root": "m"}] + [
+                    {"id": f"a{i}", "root": f"/models/a{i}"}
+                    for i in range(n_preloaded)
+                ] + loaded
+                return web.json_response({"object": "list", "data": cards})
+
+            app = web.Application()
+            app.router.add_post("/v1/load_lora_adapter", load_lora)
+            app.router.add_get("/v1/models", models)
+            return app
+
+        # engine at .1 already serves 2 adapters; engine at .2 serves 0.
+        # Same port on two loopback addresses (the operator has one
+        # --engine-port for all pods).
+        r1 = web.AppRunner(make_engine("127.0.0.1", 2))
+        await r1.setup()
+        s1 = web.TCPSite(r1, "127.0.0.1", 0)
+        await s1.start()
+        port = s1._server.sockets[0].getsockname()[1]
+        r2 = web.AppRunner(make_engine("127.0.0.2", 0))
+        await r2.setup()
+        s2 = web.TCPSite(r2, "127.0.0.2", port)
+        await s2.start()
+
+        for i, ip in enumerate(["127.0.0.1", "127.0.0.2"]):
+            api.seed("v1", "pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"llama3-engine-{i}",
+                             "labels": {"app": "pst-engine",
+                                        "model": "llama3"}},
+                "status": {"phase": "Running", "podIP": ip},
+            })
+        api.seed("production-stack.tpu/v1alpha1", "loraadapters", {
+            "apiVersion": "production-stack.tpu/v1alpha1",
+            "kind": "LoraAdapter",
+            "metadata": {"name": "spread-adapter", "uid": "u10",
+                         "generation": 1},
+            "spec": {"baseModel": "llama3",
+                     "adapterName": "spread-lora",
+                     "adapterPath": "/models/spread-lora",
+                     "placement": {"algorithm": "equalized",
+                                   "maxEngines": 1}},
+        })
+        await asyncio.get_running_loop().run_in_executor(
+            None, run_operator_once, api.port, port
+        )
+        # the adapter landed on the least-loaded engine (.2), despite
+        # llama3-engine-0 sorting first by name
+        assert len(calls_by_host["127.0.0.2"]) == 1
+        assert calls_by_host["127.0.0.1"] == []
+        cr = api.objs("production-stack.tpu/v1alpha1",
+                      "loraadapters")["spread-adapter"]
+        loaded = cr["status"]["loadedAdapters"]
+        assert [e["pod"] for e in loaded] == ["llama3-engine-1"]
+        assert loaded[0]["status"] == "loaded"
+        # steady-state resync: the count must EXCLUDE this adapter's own
+        # placement, so a second reconcile keeps it on engine-1 instead
+        # of hopping to engine-0 and violating maxEngines
+        await asyncio.get_running_loop().run_in_executor(
+            None, run_operator_once, api.port, port
+        )
+        assert calls_by_host["127.0.0.1"] == []
+        assert len(calls_by_host["127.0.0.2"]) == 2  # re-asserted, same pod
+        cr = api.objs("production-stack.tpu/v1alpha1",
+                      "loraadapters")["spread-adapter"]
+        assert [e["pod"] for e in cr["status"]["loadedAdapters"]] == [
+            "llama3-engine-1"
+        ]
+        await r1.cleanup()
+        await r2.cleanup()
+        await api.stop()
+
+    run_in_loop(scenario())
+
+
 # -- gateway endpoint picker (C++) -----------------------------------------
 # (reference: src/gateway_inference_extension pickers; kvaware queries the
 # KV controller over TCP, kv_aware_picker.go:90-131 — ours speaks
