@@ -42,6 +42,8 @@ ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
 # cross-sequence prefill packing group cap (1 = round-2 behavior)
 PREFILL_SEQS = int(os.environ.get("PST_BENCH_PREFILL_SEQS", "8"))
+# double-buffered decode dispatch (0 = synchronous fetch per round)
+ASYNC_DECODE = os.environ.get("PST_BENCH_ASYNC", "1") == "1"
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -123,6 +125,7 @@ def main() -> None:
         max_prefill_seqs=PREFILL_SEQS,
         tensor_parallel_size=TP,
         num_scheduler_steps=SCHED_STEPS,
+        async_decode=ASYNC_DECODE,
         seed=0,
     )
     engine = LLMEngine(config)

@@ -10,6 +10,7 @@
 
 #include <algorithm>
 #include <functional>
+#include <future>
 #include <iostream>
 #include <map>
 #include <string>
@@ -414,11 +415,21 @@ inline std::vector<LoraPlacement> pick_placements(
       static_cast<int>(ready.size()) > max_engines)
     ready.resize(max_engines);
   if (algorithm == "equalized" && !ready.empty()) {
-    // one live query per engine, then a stable least-loaded sort
+    // one live query per engine, issued CONCURRENTLY (a sequential scan
+    // would stall the reconcile loop up to 5s per unresponsive engine),
+    // then a stable least-loaded sort
+    std::vector<std::future<int>> counts;
+    counts.reserve(ready.size());
+    for (const auto& p : ready)
+      counts.push_back(std::async(
+          std::launch::async,
+          [&adapter_count, p]() {
+            return adapter_count ? adapter_count(p) : 0;
+          }));
     std::vector<std::pair<int, LoraPlacement>> counted;
     counted.reserve(ready.size());
-    for (const auto& p : ready)
-      counted.emplace_back(adapter_count ? adapter_count(p) : 0, p);
+    for (size_t i = 0; i < ready.size(); ++i)
+      counted.emplace_back(counts[i].get(), ready[i]);
     std::stable_sort(counted.begin(), counted.end(),
                      [](const auto& a, const auto& b) {
                        return a.first < b.first;

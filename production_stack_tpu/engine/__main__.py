@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-scheduler-steps", type=int, default=1,
                    help="fused decode+sample iterations per dispatch "
                         "(on-device sampling; amortises host RTT)")
+    p.add_argument("--async-decode", action="store_true", default=True,
+                   help="double-buffered decode: dispatch round N+1 on "
+                        "round N's on-device tokens before fetching it")
+    p.add_argument("--no-async-decode", dest="async_decode",
+                   action="store_false")
     p.add_argument("--enable-prefix-caching", action="store_true",
                    default=True)
     p.add_argument("--no-enable-prefix-caching",
@@ -118,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_prefill_seqs=args.max_prefill_seqs,
         decode_interleave=args.decode_interleave,
         num_scheduler_steps=args.num_scheduler_steps,
+        async_decode=args.async_decode,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
         multihost=args.multihost,

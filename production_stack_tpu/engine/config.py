@@ -41,6 +41,15 @@ class EngineConfig:
     # tokens come back in ONE host fetch, amortising the dispatch/fetch
     # RTT. Must be <= block_size.
     num_scheduler_steps: int = 1
+    # double-buffered decode (vLLM --async-scheduling role): dispatch
+    # decode round N+1 chained on round N's ON-DEVICE sampled tokens
+    # before fetching round N, so the device never idles on the
+    # host<->device RTT. Requires num_scheduler_steps > 1; rounds with
+    # logit penalties, lane-set changes, or lanes within K tokens of
+    # finishing fall back to the synchronous path (outputs stay
+    # bit-identical). Ignored under multihost (followers replay host
+    # token lists).
+    async_decode: bool = True
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1

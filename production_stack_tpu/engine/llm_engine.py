@@ -77,6 +77,14 @@ class LLMEngine:
         )
         self._seqs: dict[str, Sequence] = {}
         self.last_step_kind = "idle"  # "prefill" | "decode" | "idle"
+        # async decode pipeline (double-buffered dispatch): the in-flight
+        # decode round whose sampled tokens are still ON DEVICE
+        self._pending_decode: dict | None = None
+        self._async_decode = (
+            config.async_decode
+            and config.num_scheduler_steps > 1
+            and not config.multihost
+        )
         # lifetime counters for /metrics
         self._prompt_tokens_total = 0
         self._generation_tokens_total = 0
@@ -242,8 +250,103 @@ class LLMEngine:
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
 
+    # -- async decode pipeline --------------------------------------------
+    def _can_chain(self) -> bool:
+        """True when the in-flight decode round can be followed by
+        another dispatch on the SAME lanes before its tokens land:
+        no admission/prefill work waiting, every pending lane alive and
+        more than K tokens from any host-side bound, and KV lookahead
+        growable without preemption."""
+        pend = self._pending_decode
+        if pend is None:
+            return False
+        if self.scheduler.waiting:
+            return False  # admission (and prefill priority) need schedule()
+        seqs: list[Sequence] = pend["seqs"]
+        k = pend["k"]
+        if any(s.finished for s in seqs):  # aborted mid-flight
+            return False
+        if set(id(s) for s in self.scheduler.running) != set(
+            id(s) for s in seqs
+        ):
+            return False  # lane set changed (new prefill-done seq, ...)
+        for s in seqs:
+            sp = s.sampling_params
+            remaining = sp.max_tokens - len(s.generated_token_ids) - k
+            if remaining < k:
+                return False  # final rounds run synchronously
+            if s.num_tokens + 2 * k >= self.scheduler.config.max_model_len:
+                return False
+            if sp.stop or sp.stop_token_ids or (
+                not sp.ignore_eos and s.eos_token_id is not None
+            ):
+                # host-side stop conditions can end the stream anywhere;
+                # the overshoot-discard path handles them, but the next
+                # chained round would still be wasted — chain only when
+                # the generation length is host-predictable
+                return False
+            # grow the block table to cover this round + the chained one
+            if not self.block_manager.ensure_capacity(
+                s.num_tokens + 2 * k, s.block_table
+            ):
+                return False  # needs preemption: go through schedule()
+        return True
+
+    def _resolve_pending(self) -> list[RequestOutput]:
+        """Fetch the in-flight round's tokens and apply them (identical
+        bookkeeping to the synchronous path)."""
+        pend = self._pending_decode
+        self._pending_decode = None
+        toks = np.asarray(pend["toks"])  # (k, b) — the only device fetch
+        seqs = pend["seqs"]
+        for i in range(pend["k"]):
+            for j, seq in enumerate(seqs):
+                if seq.finished:
+                    continue  # overshoot tokens are discarded
+                seq.num_computed_tokens = seq.num_tokens
+                self._append_token(seq, int(toks[i, j]))
+        # requests aborted mid-flight already emitted their final output
+        # via abort_request; re-finalizing them would double-count
+        # requests_finished_total and emit a spurious finished output
+        return self._finalize_stepped(
+            [s for s in seqs if s.request_id in self._seqs]
+        )
+
     # -- the step loop ----------------------------------------------------
     def step(self) -> list[RequestOutput]:
+        # async decode fast path: keep the device busy by dispatching the
+        # next round on the in-flight round's on-device tokens, THEN
+        # fetching the in-flight round (the fetch overlaps the new
+        # round's execution)
+        if self._pending_decode is not None:
+            if self._can_chain():
+                pend = self._pending_decode
+                seqs: list[Sequence] = pend["seqs"]
+                k = pend["k"]
+                temps, top_ps, top_ks, keys, _ = self._sampling_arrays(
+                    seqs
+                )
+                keys[:, 1] += k  # k sampled-but-unapplied tokens per lane
+                positions = [s.num_tokens - 1 + k for s in seqs]
+                ctx_lens = [s.num_tokens + k for s in seqs]
+                toks_next = self.runner.decode_multi(
+                    pend["toks"][-1], positions,
+                    [s.block_table for s in seqs], ctx_lens, k,
+                    temps, top_ps, top_ks, keys,
+                    lora_slots=[self._lora_slot(s) for s in seqs],
+                )
+                outputs = self._resolve_pending()
+                self._pending_decode = {"seqs": seqs, "toks": toks_next,
+                                        "k": k}
+                self.last_step_kind = "decode"
+                return outputs
+            # pipeline flush: apply the in-flight tokens before any
+            # scheduling decision reads sequence state
+            flushed = self._resolve_pending()
+            return flushed + self._step_scheduled()
+        return self._step_scheduled()
+
+    def _step_scheduled(self) -> list[RequestOutput]:
         sched_out = self.scheduler.schedule()
         self._preemptions_total += len(sched_out.preempted)
         self.last_step_kind = (
@@ -344,12 +447,21 @@ class LLMEngine:
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
-                toks = np.asarray(self.runner.decode_multi(
+                toks_dev = self.runner.decode_multi(
                     tokens, positions, tables, ctx_lens, k_steps,
                     temps, top_ps, top_ks, keys,
                     lora_slots=[self._lora_slot(s) for s in seqs],
                     penalties=penalties,
-                ))  # (k, b)
+                )  # (k, b) on device
+                if self._async_decode and penalties is None:
+                    # start the double-buffered pipeline: leave the
+                    # tokens on device; the NEXT step dispatches the
+                    # following round before fetching this one
+                    self._pending_decode = {
+                        "seqs": seqs, "toks": toks_dev, "k": k_steps,
+                    }
+                    return outputs
+                toks = np.asarray(toks_dev)
                 for i in range(k_steps):
                     for j, seq in enumerate(seqs):
                         if seq.finished:
@@ -368,6 +480,13 @@ class LLMEngine:
                     self._append_token(seq, int(token))
                     stepped.append(seq)
 
+        outputs.extend(self._finalize_stepped(stepped))
+        return outputs
+
+    def _finalize_stepped(
+        self, stepped: list[Sequence]
+    ) -> list[RequestOutput]:
+        outputs: list[RequestOutput] = []
         for seq in stepped:
             self._register_full_blocks(seq)
             out = self._make_output(seq)

@@ -905,19 +905,29 @@ class ModelRunner:
         repetition) — generated-token history per lane (list of int
         lists) + (b_actual,) penalty arrays; token counts are then
         maintained on device through the scan (sampler.apply_penalties
-        semantics, bit-identical to the host single-step path)."""
+        semantics, bit-identical to the host single-step path).
+
+        `token_ids` may be a full-lane (b,) DEVICE array instead of a
+        host list: the async-decode pipeline chains round N+1 directly on
+        round N's on-device sampled tokens, so no host fetch sits between
+        dispatches."""
         if steps > self.block_size:
             raise ValueError(
                 f"num_scheduler_steps={steps} > block_size="
                 f"{self.block_size}: idle lanes would overrun the trash "
                 "block"
             )
-        b_actual = len(token_ids)
         b = self.config.max_num_seqs
+        chained = isinstance(token_ids, jax.Array)
+        b_actual = len(positions) if chained else len(token_ids)
         c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
 
-        tokens = np.zeros((b,), dtype=np.int32)
-        tokens[:b_actual] = token_ids
+        if chained:
+            tokens_arg = token_ids  # already (b,) on device
+        else:
+            tokens = np.zeros((b,), dtype=np.int32)
+            tokens[:b_actual] = token_ids
+            tokens_arg = jnp.asarray(tokens)
         pos = np.zeros((b,), dtype=np.int32)
         pos[:b_actual] = positions
         ctx = np.ones((b,), dtype=np.int32)
@@ -996,7 +1006,7 @@ class ModelRunner:
             self.params,
             self.k_cache,
             self.v_cache,
-            jnp.asarray(tokens),
+            tokens_arg,
             jnp.asarray(pos),
             jnp.asarray(page_tables),
             jnp.asarray(gather_tables),
