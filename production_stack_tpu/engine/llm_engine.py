@@ -248,7 +248,13 @@ class LLMEngine:
         return self.scheduler.abort(request_id)
 
     def has_unfinished(self) -> bool:
-        return self.scheduler.has_unfinished()
+        # an in-flight async decode round counts as unfinished work even
+        # when every owning request was aborted — the step loop must keep
+        # stepping so the round gets flushed and its device arrays freed
+        return (
+            self.scheduler.has_unfinished()
+            or self._pending_decode is not None
+        )
 
     # -- async decode pipeline --------------------------------------------
     def _can_chain(self) -> bool:
@@ -299,18 +305,25 @@ class LLMEngine:
         self._pending_decode = None
         toks = np.asarray(pend["toks"])  # (k, b) — the only device fetch
         seqs = pend["seqs"]
-        for i in range(pend["k"]):
-            for j, seq in enumerate(seqs):
-                if seq.finished:
-                    continue  # overshoot tokens are discarded
-                seq.num_computed_tokens = seq.num_tokens
-                self._append_token(seq, int(toks[i, j]))
+        self._apply_multi_tokens(seqs, toks, pend["k"])
         # requests aborted mid-flight already emitted their final output
         # via abort_request; re-finalizing them would double-count
         # requests_finished_total and emit a spurious finished output
         return self._finalize_stepped(
             [s for s in seqs if s.request_id in self._seqs]
         )
+
+    def _apply_multi_tokens(
+        self, seqs: list[Sequence], toks: np.ndarray, k: int
+    ) -> None:
+        """Apply a fused-K round's (k, b) sampled tokens — the ONE copy
+        of the bookkeeping both the sync and async paths share."""
+        for i in range(k):
+            for j, seq in enumerate(seqs):
+                if seq.finished:
+                    continue  # overshoot tokens are discarded
+                seq.num_computed_tokens = seq.num_tokens
+                self._append_token(seq, int(toks[i, j]))
 
     # -- the step loop ----------------------------------------------------
     def step(self) -> list[RequestOutput]:
@@ -461,13 +474,9 @@ class LLMEngine:
                         "seqs": seqs, "toks": toks_dev, "k": k_steps,
                     }
                     return outputs
-                toks = np.asarray(toks_dev)
-                for i in range(k_steps):
-                    for j, seq in enumerate(seqs):
-                        if seq.finished:
-                            continue  # overshoot tokens are discarded
-                        seq.num_computed_tokens = seq.num_tokens
-                        self._append_token(seq, int(toks[i, j]))
+                self._apply_multi_tokens(
+                    seqs, np.asarray(toks_dev), k_steps
+                )
                 stepped.extend(seqs)
             else:
                 logits = self.runner.decode(

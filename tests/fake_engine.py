@@ -28,7 +28,9 @@ class FakeEngine:
         num_tokens: int = 8,
         model_label: str | None = None,
         engine_id: str | None = None,
+        kv_instance_id: str | None = None,
     ):
+        self.kv_instance_id = kv_instance_id
         self.model = model
         # stamped into responses as system_fingerprint so routing e2e tests
         # can measure request distribution; unique per instance by default
@@ -141,12 +143,12 @@ class FakeEngine:
             self.running -= 1
 
     async def models(self, request: web.Request):
-        return web.json_response({
-            "object": "list",
-            "data": [{"id": self.model, "object": "model",
-                      "created": int(time.time()),
-                      "owned_by": "fake-engine"}],
-        })
+        card = {"id": self.model, "object": "model",
+                "created": int(time.time()),
+                "owned_by": "fake-engine"}
+        if self.kv_instance_id is not None:
+            card["kv_instance_id"] = self.kv_instance_id
+        return web.json_response({"object": "list", "data": [card]})
 
     async def metrics(self, request: web.Request):
         lines = [

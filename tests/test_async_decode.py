@@ -148,6 +148,27 @@ def test_abort_mid_pipeline_no_spurious_output():
     assert eng.stats().requests_finished_total == 1
 
 
+def test_abort_all_mid_pipeline_drains():
+    """When EVERY request is aborted while a round is in flight,
+    has_unfinished() must stay true until the pending round is flushed
+    (otherwise the step loop parks and device arrays leak)."""
+    eng = make_engine(True)
+    sp = SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True)
+    eng.add_request("only", prompt_token_ids=_prompts()[0],
+                    sampling_params=sp)
+    for _ in range(20):
+        eng.step()
+        if eng._pending_decode is not None:
+            break
+    assert eng._pending_decode is not None
+    eng.abort_request("only")
+    assert eng.has_unfinished()  # pending round still needs a flush
+    outs = eng.step()
+    assert eng._pending_decode is None
+    assert not eng.has_unfinished()
+    assert [o.request_id for o in outs if o.finished] == []
+
+
 def test_async_respects_max_model_len():
     """Lanes near the context limit must not chain past it."""
     sp = SamplingParams(max_tokens=200, temperature=0.0, ignore_eos=True)

@@ -1,0 +1,170 @@
+"""K8s pod-IP service discovery driven end-to-end against a fake
+apiserver (watch stream included) and LIVE fake engines.
+
+This is the in-image stand-in for the kind-based routing e2e
+(.github/workflows/functionality-helm-chart.yml +
+tests/e2e/run-k8s-routing-test.sh, which need a container runtime this
+environment lacks — reference tier:
+.github/workflows/router-e2e-test.yml:109-162): the router's REAL watch
+client, pod-event handling, /v1/models probing (including the
+kv-instance-id handshake), and routing over discovered endpoints all
+execute; only the kubelet/container layer is faked."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from production_stack_tpu.router.k8s_client import K8sClient
+from production_stack_tpu.router.service_discovery import (
+    K8sPodIPServiceDiscovery,
+)
+
+from tests.fake_engine import FakeEngine
+
+
+class WatchableApiServer:
+    """Pods endpoint with list + chunked watch streaming."""
+
+    def __init__(self):
+        self.pods: dict[str, dict] = {}
+        self._subscribers: list[asyncio.Queue] = []
+        app = web.Application()
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/pods", self.handle_pods
+        )
+        self.app = app
+        self.port = None
+
+    def pod(self, name: str, ip: str, phase: str = "Running") -> dict:
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "labels": {"environment": "router-controlled"},
+                "resourceVersion": str(len(self.pods) + 1),
+            },
+            "status": {
+                "phase": phase,
+                "podIP": ip,
+                "conditions": (
+                    [{"type": "Ready", "status": "True"}]
+                    if phase == "Running" else []
+                ),
+            },
+        }
+
+    async def emit(self, ev_type: str, pod: dict) -> None:
+        if ev_type == "DELETED":
+            self.pods.pop(pod["metadata"]["name"], None)
+        else:
+            self.pods[pod["metadata"]["name"]] = pod
+        for q in self._subscribers:
+            q.put_nowait({"type": ev_type, "object": pod})
+
+    async def handle_pods(self, request: web.Request) -> web.StreamResponse:
+        if request.query.get("watch") != "true":
+            return web.json_response({"items": list(self.pods.values())})
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        for pod in self.pods.values():  # replay current state
+            q.put_nowait({"type": "ADDED", "object": pod})
+        self._subscribers.append(q)
+        try:
+            while True:
+                ev = await q.get()
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        finally:
+            self._subscribers.remove(q)
+        return resp
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+
+async def _wait_for(cond, timeout_s: float = 10.0):
+    for _ in range(int(timeout_s / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def test_k8s_pod_discovery_end_to_end():
+    async def scenario():
+        api = WatchableApiServer()
+        await api.start()
+
+        # two live engines on distinct loopback IPs, SAME port (pod-IP
+        # discovery derives url as http://<podIP>:<port>)
+        e1 = FakeEngine(model="m", kv_instance_id="engine-a:dev0")
+        await e1.start(host="127.0.0.1")
+        port = e1.port
+        e2 = FakeEngine(model="m")
+        await e2.start(host="127.0.0.2", port=port)
+
+        await api.emit("ADDED", api.pod("pod-a", "127.0.0.1"))
+        await api.emit("ADDED", api.pod("pod-b", "127.0.0.2"))
+        await api.emit("ADDED", api.pod("pod-pending", "", phase="Pending"))
+
+        disco = K8sPodIPServiceDiscovery(
+            namespace="default", port=port,
+            k8s_client=K8sClient(host=f"http://127.0.0.1:{api.port}",
+                                 namespace="default"),
+            probe_interval_s=0.2,
+        )
+        await disco.start()
+        try:
+            assert await _wait_for(
+                lambda: len(disco.get_endpoint_info()) == 2
+            ), disco.get_endpoint_info()
+            eps = {e.pod_name: e for e in disco.get_endpoint_info()}
+            assert eps["pod-a"].url == f"http://127.0.0.1:{port}"
+            assert eps["pod-a"].model_names == ["m"]
+            # the kv-instance-id handshake rode the /v1/models probe
+            assert eps["pod-a"].kv_instance_id == "engine-a:dev0"
+            assert eps["pod-b"].kv_instance_id is None
+
+            # real routing over the discovered endpoints
+            from production_stack_tpu.router.routing_logic import (
+                RoundRobinRouter,
+            )
+            from production_stack_tpu.router.protocols import RouterRequest
+
+            router = RoundRobinRouter()
+            req = RouterRequest(headers={}, body={"prompt": "x"},
+                                endpoint="/v1/completions")
+            urls = {
+                await router.route_request(
+                    disco.get_endpoint_info(), {}, {}, req
+                )
+                for _ in range(4)
+            }
+            assert urls == {f"http://127.0.0.1:{port}",
+                            f"http://127.0.0.2:{port}"}
+
+            # pod deletion flows through the watch and removes the
+            # endpoint (failure-detection path)
+            await api.emit("DELETED", api.pod("pod-b", "127.0.0.2"))
+            assert await _wait_for(
+                lambda: len(disco.get_endpoint_info()) == 1
+            )
+            assert disco.get_endpoint_info()[0].pod_name == "pod-a"
+        finally:
+            await disco.close()
+            await e1._runner.cleanup()
+            await e2._runner.cleanup()
+            await api.stop()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
