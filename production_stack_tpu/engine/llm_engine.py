@@ -386,6 +386,9 @@ class LLMEngine:
             for w in works:
                 if w.seq.metrics.first_scheduled_time is None:
                     w.seq.metrics.first_scheduled_time = now
+            seqs_w = [w.seq for w in works]
+            temps, top_ps, top_ks, keys, _ = self._sampling_arrays(seqs_w)
+            sampling = (temps, top_ps, top_ks, keys)
             if len(works) == 1:
                 # single-sequence path keeps the round-2 compile buckets
                 w = works[0]
@@ -393,18 +396,20 @@ class LLMEngine:
                 chunk = seq.prompt_token_ids[
                     w.chunk_start : w.chunk_start + w.chunk_len
                 ]
-                logits = self.runner.prefill(
+                token_dev, logits = self.runner.prefill(
                     chunk,
                     start_pos=w.chunk_start,
                     block_table=seq.block_table,
                     total_len=w.chunk_start + w.chunk_len,
                     lora_slot=self._lora_slot(seq),
+                    sampling=sampling,
                 )
+                tokens_dev = token_dev[None]
                 last_logits = {0: logits}
             else:
                 # packed cross-sequence prefill: one dispatch covers
                 # every scheduled chunk (burst-TTFT fix)
-                logits = self.runner.prefill_batch(
+                tokens_dev, logits = self.runner.prefill_batch(
                     [
                         w.seq.prompt_token_ids[
                             w.chunk_start : w.chunk_start + w.chunk_len
@@ -417,6 +422,7 @@ class LLMEngine:
                         w.chunk_start + w.chunk_len for w in works
                     ],
                     lora_slots=[self._lora_slot(w.seq) for w in works],
+                    sampling=sampling,
                 )
                 last_logits = {i: logits[i] for i in range(len(works))}
             for i, w in enumerate(works):
@@ -426,11 +432,35 @@ class LLMEngine:
                 (i, w) for i, w in enumerate(works) if w.is_last_chunk
             ]
             if finals:
-                fl = jnp.stack([last_logits[i] for i, _ in finals])
-                sampled = self._sample([w.seq for _, w in finals], fl)
-                for (i, w), token in zip(finals, sampled):
-                    self._append_token(w.seq, int(token))
-                    stepped.append(w.seq)
+                # first tokens were sampled ON DEVICE inside the prefill
+                # program — the host fetches (s_pad,) int32 instead of
+                # (s_pad, vocab) f32 logits. Only a post-preemption
+                # sequence with active penalties (its generated history
+                # is folded into the prompt, so penalty counts are
+                # non-empty at the "first" token) needs the logits.
+                def _needs_host_sample(s: Sequence) -> bool:
+                    sp = s.sampling_params
+                    return bool(s.generated_token_ids) and (
+                        sp.presence_penalty != 0.0
+                        or sp.frequency_penalty != 0.0
+                        or sp.repetition_penalty != 1.0
+                    )
+
+                pen = [(i, w) for i, w in finals
+                       if _needs_host_sample(w.seq)]
+                clean = [(i, w) for i, w in finals
+                         if not _needs_host_sample(w.seq)]
+                if clean:
+                    toks = np.asarray(tokens_dev)
+                    for i, w in clean:
+                        self._append_token(w.seq, int(toks[i]))
+                        stepped.append(w.seq)
+                if pen:
+                    fl = jnp.stack([last_logits[i] for i, _ in pen])
+                    sampled = self._sample([w.seq for _, w in pen], fl)
+                    for (i, w), token in zip(pen, sampled):
+                        self._append_token(w.seq, int(token))
+                        stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
             tokens = [s.all_token_ids[-1] for s in seqs]

@@ -272,15 +272,17 @@ class ModelRunner:
             )
         jax.block_until_ready((out, out2))
 
-    def _step_jit_kwargs(self) -> dict:
-        """Extra jit options for the prefill/decode step builders."""
+    def _step_jit_kwargs(self, n_host_outs: int = 1) -> dict:
+        """Extra jit options for the prefill/decode step builders.
+        `n_host_outs` = leading outputs host 0 may fetch (replicated
+        under multihost so followers' shards are never addressed)."""
         if not (self.replicate_logits and self.mesh is not None):
             return {}
         from jax.sharding import NamedSharding, PartitionSpec
 
         rep = NamedSharding(self.mesh, PartitionSpec())
         cs = sharding_rules.cache_sharding(self.mesh)
-        return {"out_shardings": (rep, cs, cs)}
+        return {"out_shardings": (rep,) * n_host_outs + (cs, cs)}
 
     # -- buckets ----------------------------------------------------------
     def _ctx_bucket(self, num_tokens: int) -> int:
@@ -318,6 +320,7 @@ class ModelRunner:
     def _build_prefill(self, t_pad: int, c_pad: int):
         mc = self.model_config
         scale = self._scale
+        from production_stack_tpu.engine.sampler import sample_tokens
 
         if self.attention_impl == "pallas":
             from production_stack_tpu.ops import pallas_attention
@@ -355,8 +358,8 @@ class ModelRunner:
                 )
 
         def step(params, kc, vc, tokens, positions, write_slots,
-                 gather_slots, total_len, last_row, lora=None,
-                 lora_slots=None):
+                 gather_slots, total_len, last_row, temps, top_ps,
+                 top_ks, keys, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -370,9 +373,17 @@ class ModelRunner:
                 logits_rows=last_row[None],
                 lora=lora, lora_slots=lora_slots,
             )
-            return logits[0], kc, vc
+            # sample the first generated token ON DEVICE: the host then
+            # fetches 4 bytes instead of a (vocab,) f32 row — the logit
+            # fetch was the dominant per-prompt TTFT cost through
+            # remote-attached chips (the logits output stays available
+            # for penalty/debug paths, unfetched)
+            token = sample_tokens(logits[:1], temps, top_ps, top_ks,
+                                  keys)[0]
+            return token, logits[0], kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
+        return jax.jit(step, donate_argnums=(1, 2),
+                       **self._step_jit_kwargs(2))
 
     def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
         """Packed cross-sequence prefill: chunks from s_pad sequences run
@@ -390,6 +401,7 @@ class ModelRunner:
         kernel's schedule without forking a second Mosaic kernel."""
         mc = self.model_config
         scale = self._scale
+        from production_stack_tpu.engine.sampler import sample_tokens
 
         if self.attention_impl == "pallas":
             from production_stack_tpu.ops import pallas_attention
@@ -437,8 +449,8 @@ class ModelRunner:
                 )
 
         def step(params, kc, vc, tokens, positions, write_slots, tables,
-                 q_starts, total_lens, last_rows, lora=None,
-                 lora_slots=None):
+                 q_starts, total_lens, last_rows, temps, top_ps, top_ks,
+                 keys, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -453,9 +465,13 @@ class ModelRunner:
                 logits_rows=last_rows,
                 lora=lora, lora_slots=lora_slots,
             )
-            return logits, kc, vc  # logits: (s_pad, vocab)
+            # on-device first-token sampling (see _build_prefill): the
+            # host fetches (s_pad,) int32, not (s_pad, vocab) f32
+            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys)
+            return sampled, logits, kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
+        return jax.jit(step, donate_argnums=(1, 2),
+                       **self._step_jit_kwargs(2))
 
     def _build_decode(self, b: int, c_pad: int):
         mc = self.model_config
@@ -657,6 +673,24 @@ class ModelRunner:
         return (bt[:, None] * self.block_size + offs).reshape(-1)
 
     # -- public API --------------------------------------------------------
+    @staticmethod
+    def _sampling_args(
+        n: int, sampling=None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Pad per-sequence sampling params to n rows (greedy defaults)."""
+        temps = np.zeros((n,), np.float32)
+        top_ps = np.ones((n,), np.float32)
+        top_ks = np.full((n,), -1, np.int32)
+        keys = np.zeros((n, 2), np.uint32)
+        if sampling is not None:
+            t, p, k, kd = sampling
+            m = len(np.asarray(t).reshape(-1))
+            temps[:m] = np.asarray(t, np.float32).reshape(-1)
+            top_ps[:m] = np.asarray(p, np.float32).reshape(-1)
+            top_ks[:m] = np.asarray(k, np.int32).reshape(-1)
+            keys[:m] = np.asarray(kd, np.uint32).reshape(m, 2)
+        return temps, top_ps, top_ks, keys
+
     def prefill(
         self,
         token_ids: list[int],
@@ -664,9 +698,14 @@ class ModelRunner:
         block_table: list[int],
         total_len: int,
         lora_slot: int = 0,
-    ) -> jax.Array:
-        """Run one prefill chunk; returns fp32 logits (vocab,) for the chunk's
-        last *actual* token. K/V for the chunk is written into the cache."""
+        sampling=None,
+    ) -> tuple[jax.Array, jax.Array]:
+        """Run one prefill chunk; returns (token, logits) ON DEVICE where
+        `token` is the first generated token sampled from the chunk's last
+        *actual* row with `sampling` = (temps, top_ps, top_ks, keys)
+        (greedy/zero-key defaults), and `logits` is that row's fp32
+        (vocab,) for penalty/debug paths. K/V for the chunk is written
+        into the cache."""
         t = len(token_ids)
         t_pad = self._prefill_bucket(t)
         c_pad = self._ctx_bucket(total_len)
@@ -701,7 +740,8 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": jnp.int32(lora_slot),
             }
-        logits, self.k_cache, self.v_cache = fn(
+        temps, top_ps, top_ks, keys = self._sampling_args(1, sampling)
+        token, logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -711,9 +751,13 @@ class ModelRunner:
             jnp.asarray(gather_slots),
             jnp.int32(total_len),
             jnp.int32(t - 1),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            jnp.asarray(keys),
             **lora_kw,
         )
-        return logits
+        return token, logits
 
     def prefill_batch(
         self,
@@ -722,11 +766,14 @@ class ModelRunner:
         block_tables: list[list[int]],
         total_lens: list[int],
         lora_slots: list[int] | None = None,
-    ) -> jax.Array:
+        sampling=None,
+    ) -> tuple[jax.Array, jax.Array]:
         """Run one prompt chunk for EACH of n sequences in a single packed
-        dispatch; returns fp32 logits (s_pad, vocab) where row s is the
-        logits of chunk s's last *actual* token (rows >= n are padding).
-        K/V for every chunk is written into the cache."""
+        dispatch; returns (tokens, logits) ON DEVICE — tokens (s_pad,)
+        sampled from each chunk's last *actual* row with `sampling` =
+        per-sequence (temps, top_ps, top_ks, keys), logits (s_pad, vocab)
+        for penalty/debug paths (rows >= n are padding). K/V for every
+        chunk is written into the cache."""
         n = len(chunks)
         s_pad = next_pow2(max(n, 1))
         t_pad = self._prefill_bucket(max(len(c) for c in chunks))
@@ -795,7 +842,8 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": slots_arg,
             }
-        logits, self.k_cache, self.v_cache = fn(
+        temps, top_ps, top_ks, keys = self._sampling_args(s_pad, sampling)
+        sampled, logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -806,9 +854,13 @@ class ModelRunner:
             jnp.asarray(q_starts),
             jnp.asarray(tl_full),
             jnp.asarray(last_rows),
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+            jnp.asarray(top_ks),
+            jnp.asarray(keys),
             **lora_kw,
         )
-        return logits
+        return sampled, logits
 
     def decode(
         self,

@@ -66,8 +66,20 @@ class BroadcastingRunner:
     def __getattr__(self, name):
         return getattr(self._runner, name)
 
+    @staticmethod
+    def _sampling_msg(sampling):
+        if sampling is None:
+            return None
+        temps, top_ps, top_ks, keys = sampling
+        return [
+            np.asarray(temps, np.float32).tolist(),
+            np.asarray(top_ps, np.float32).tolist(),
+            np.asarray(top_ks, np.int32).tolist(),
+            np.asarray(keys, np.uint32).tolist(),
+        ]
+
     def prefill(self, token_ids, start_pos, block_table, total_len,
-                lora_slot=0):
+                lora_slot=0, sampling=None):
         self._bc.publish({
             "kind": "prefill",
             "token_ids": [int(t) for t in token_ids],
@@ -75,27 +87,29 @@ class BroadcastingRunner:
             "block_table": [int(b) for b in block_table],
             "total_len": int(total_len),
             "lora_slot": int(lora_slot),
+            "sampling": self._sampling_msg(sampling),
         })
         return self._runner.prefill(
             token_ids, start_pos, block_table, total_len,
-            lora_slot=lora_slot,
+            lora_slot=lora_slot, sampling=sampling,
         )
 
     def prefill_batch(self, chunks, start_positions, block_tables,
-                      total_lens, lora_slots=None):
+                      total_lens, lora_slots=None, sampling=None):
         msg = {
             "kind": "prefill_batch",
             "chunks": [[int(t) for t in c] for c in chunks],
             "start_positions": [int(p) for p in start_positions],
             "block_tables": [[int(b) for b in t] for t in block_tables],
             "total_lens": [int(t) for t in total_lens],
+            "sampling": self._sampling_msg(sampling),
         }
         if lora_slots is not None:
             msg["lora_slots"] = [int(s) for s in lora_slots]
         self._bc.publish(msg)
         return self._runner.prefill_batch(
             chunks, start_positions, block_tables, total_lens,
-            lora_slots=lora_slots,
+            lora_slots=lora_slots, sampling=sampling,
         )
 
     def decode(self, token_ids, positions, block_tables, context_lens,

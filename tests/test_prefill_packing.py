@@ -92,13 +92,20 @@ def test_runner_prefill_batch_matches_sequential():
     starts = [0, 0, 0]
     totals = [len(c) for c in chunks]
 
-    seq_logits = [
-        np.asarray(r_seq.prefill(c, s, bt, tl))
+    seq_results = [
+        r_seq.prefill(c, s, bt, tl)
         for c, s, bt, tl in zip(chunks, starts, tables, totals)
     ]
-    bat_logits = np.asarray(r_bat.prefill_batch(
+    seq_logits = [np.asarray(lg) for _, lg in seq_results]
+    bat_tokens, bat_logits_dev = r_bat.prefill_batch(
         chunks, starts, tables, totals
-    ))
+    )
+    bat_logits = np.asarray(bat_logits_dev)
+    # on-device greedy sampling agrees with the logits argmax
+    for i in range(len(chunks)):
+        assert int(np.asarray(bat_tokens)[i]) == int(
+            bat_logits[i].argmax()
+        )
     for i, sl in enumerate(seq_logits):
         np.testing.assert_allclose(bat_logits[i], sl, rtol=1e-5,
                                    atol=1e-5)
@@ -116,6 +123,28 @@ def test_runner_prefill_batch_matches_sequential():
         np.asarray(r_seq.k_cache[:, :, slots]),
         rtol=1e-4, atol=1e-4,
     )
+
+
+def test_preempted_penalty_seq_uses_host_logits():
+    """A post-preemption prefill-final with active penalties has folded
+    generated history, so the on-device first-token sample (penalty-free)
+    is wrong for it — the engine must fall back to the host logits path.
+    Identity check: sync vs packed engines under forced preemption with
+    repetition_penalty agree (both ultimately vs the recompute design)."""
+    kw = dict(num_kv_blocks=18, enable_prefix_caching=False,
+              max_num_seqs=2)
+    sp = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True,
+                        repetition_penalty=1.5)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 384, size=24).tolist() for _ in range(2)]
+    out_p = [o.token_ids
+             for o in LLMEngine(tiny_cfg(max_prefill_seqs=8, **kw))
+             .generate(prompts, sp)]
+    out_u = [o.token_ids
+             for o in LLMEngine(tiny_cfg(max_prefill_seqs=1, **kw))
+             .generate(prompts, sp)]
+    assert out_p == out_u
+    assert all(len(t) == 10 for t in out_p)
 
 
 def test_scheduler_packs_up_to_cap():
