@@ -101,6 +101,22 @@ def _init_backend_or_die(timeout_s: float = 60.0, retries: int = 1):
 
 
 def main() -> None:
+    # chip-session hygiene: one TPU process at a time, SIGTERM-only stop
+    from production_stack_tpu.utils import chip_guard
+    from production_stack_tpu.utils.chip_guard import ChipBusyError
+
+    try:
+        _chip_lock = chip_guard.engage()  # noqa: F841 — held for run life
+    except ChipBusyError as e:
+        print(f"# {e}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench-aborted: chip lock held by another process",
+            "value": 0.0,
+            "unit": "gen_tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": str(e)[:200],
+        }))
+        sys.exit(1)
     devices = _init_backend_or_die()
     import jax
 

@@ -12,6 +12,7 @@
 #include <functional>
 #include <future>
 #include <iostream>
+#include <limits>
 #include <map>
 #include <string>
 #include <utility>
@@ -363,15 +364,18 @@ struct LoraPlacement {
 // has root == id. The adapter being reconciled is excluded (by its own
 // path/name) — otherwise a steady-state resync would see its previous
 // placement as "load" and hop the adapter to a fresh engine every tick.
-// Unreachable engines count 0 (they sort first, and the subsequent load
-// attempt reports the real error in status).
+// Engines that fail the probe (e.g. Running pods still loading weights)
+// count INT_MAX so they sort LAST — preferring them would guarantee
+// failed loads and placement flapping until the pod serves HTTP.
+inline constexpr int kUnprobeableEngine = std::numeric_limits<int>::max();
+
 inline int count_loaded_adapters(const std::string& ip, int port,
                                  const std::string& exclude_path = "",
                                  const std::string& exclude_name = "") {
   try {
     psthttp::Client engine(ip, port, 5);
     auto r = engine.get("/v1/models");
-    if (r.status >= 300) return 0;
+    if (r.status >= 300) return kUnprobeableEngine;
     Json data = Json::parse(r.body);
     int n = 0;
     for (const Json& card : data.get("data").elements()) {
@@ -384,7 +388,7 @@ inline int count_loaded_adapters(const std::string& ip, int port,
     }
     return n;
   } catch (const std::exception&) {
-    return 0;
+    return kUnprobeableEngine;
   }
 }
 

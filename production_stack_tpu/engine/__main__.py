@@ -150,6 +150,12 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    # chip-session hygiene: refuse to start a second process that would
+    # dial the real TPU (a second dial hangs in backend init and can
+    # wedge a remote-attached chip); SIGTERM is the sanctioned stop
+    from production_stack_tpu.utils import chip_guard
+
+    _chip_lock = chip_guard.engage()  # noqa: F841 — held for process life
     if args.kv_instance_id == "default-instance":
         # by convention the instance id is host:port so kvaware routing can
         # map controller matches back to endpoint urls (routing_logic.py);

@@ -261,8 +261,18 @@ class LLMEngine:
         """True when the in-flight decode round can be followed by
         another dispatch on the SAME lanes before its tokens land:
         no admission/prefill work waiting, every pending lane alive and
-        more than K tokens from any host-side bound, and KV lookahead
-        growable without preemption."""
+        KV lookahead growable without preemption.
+
+        Host-side stop conditions (EOS / stop tokens / stop strings) do
+        NOT refuse the chain: the next round is dispatched speculatively
+        and a lane that turns out to have stopped discards its overshoot
+        tokens in _apply_multi_tokens, wasting at most ONE round (<=K
+        tokens) per finished stream — once the stop is observed at
+        resolve time, `any(s.finished)` flushes the pipeline before
+        another round is chained (vLLM --async-scheduling semantics).
+        Only the bounds the host CAN predict — max_tokens and
+        max_model_len — refuse the chain outright, since their final
+        rounds would be guaranteed waste."""
         pend = self._pending_decode
         if pend is None:
             return False
@@ -270,12 +280,14 @@ class LLMEngine:
             return False  # admission (and prefill priority) need schedule()
         seqs: list[Sequence] = pend["seqs"]
         k = pend["k"]
-        if any(s.finished for s in seqs):  # aborted mid-flight
+        if any(s.finished for s in seqs):  # stopped/aborted mid-flight
             return False
         if set(id(s) for s in self.scheduler.running) != set(
             id(s) for s in seqs
         ):
             return False  # lane set changed (new prefill-done seq, ...)
+        bs = self.block_manager.block_size
+        grow = 0
         for s in seqs:
             sp = s.sampling_params
             remaining = sp.max_tokens - len(s.generated_token_ids) - k
@@ -283,19 +295,21 @@ class LLMEngine:
                 return False  # final rounds run synchronously
             if s.num_tokens + 2 * k >= self.scheduler.config.max_model_len:
                 return False
-            if sp.stop or sp.stop_token_ids or (
-                not sp.ignore_eos and s.eos_token_id is not None
-            ):
-                # host-side stop conditions can end the stream anywhere;
-                # the overshoot-discard path handles them, but the next
-                # chained round would still be wasted — chain only when
-                # the generation length is host-predictable
-                return False
-            # grow the block table to cover this round + the chained one
-            if not self.block_manager.ensure_capacity(
+            # blocks needed to cover this round + the chained one
+            need = (s.num_tokens + 2 * k + bs - 1) // bs - len(s.block_table)
+            if need > 0:
+                grow += need
+        # all-or-nothing growth: allocate only after EVERY lane passed its
+        # checks, so a late refusal never leaves earlier lanes holding
+        # speculatively grown block tables (advisor r3: the predicate must
+        # not have partial side effects)
+        if grow > self.block_manager.num_free_blocks:
+            return False  # needs preemption: go through schedule()
+        for s in seqs:
+            ok = self.block_manager.ensure_capacity(
                 s.num_tokens + 2 * k, s.block_table
-            ):
-                return False  # needs preemption: go through schedule()
+            )
+            assert ok  # guaranteed by the free-block precheck above
         return True
 
     def _resolve_pending(self) -> list[RequestOutput]:

@@ -209,6 +209,17 @@ class Scheduler:
                 if self.config.enable_chunked_prefill
                 else 1
             )
+            if has_decode_ready and self.config.decode_interleave > 0:
+                # decodes are waiting: a packed group must not blow the
+                # documented ITL bound ("at most decode_interleave prefill
+                # chunks between decode steps"), so cap the group at the
+                # remaining streak budget (advisor r3). Not decode_starved
+                # here implies _prefill_streak < decode_interleave, so the
+                # budget is always >= 1.
+                group_cap = min(
+                    group_cap,
+                    self.config.decode_interleave - self._prefill_streak,
+                )
             for seq in self.running:
                 if seq.prefill_done:
                     continue

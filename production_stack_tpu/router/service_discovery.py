@@ -337,17 +337,27 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
     """Watch Services, route via cluster DNS
     (reference: service_discovery.py:762)."""
 
+    #: how a Service name becomes a URL; cluster DNS by default.
+    #: Overridable for routers running OFF-cluster (port-forwards, bare
+    #: metal) and for hermetic e2e tests, where cluster DNS cannot
+    #: resolve.
+    DEFAULT_URL_TEMPLATE = (
+        "http://{name}.{namespace}.svc.cluster.local:{port}"
+    )
+
     def __init__(
         self,
         namespace: str = "default",
         port: int = 8000,
         label_selector: str = "environment=router-controlled",
         k8s_client: K8sClient | None = None,
+        url_template: str | None = None,
     ):
         self.k8s = k8s_client or K8sClient(namespace=namespace)
         self.namespace = namespace or self.k8s.namespace
         self.port = port
         self.label_selector = label_selector
+        self.url_template = url_template or self.DEFAULT_URL_TEMPLATE
         self._endpoints: dict[str, EndpointInfo] = {}
         self._watch_task: asyncio.Task | None = None
         self._healthy = False
@@ -375,9 +385,8 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
             if event.get("type") == "DELETED":
                 self._endpoints.pop(name, None)
                 continue
-            url = (
-                f"http://{name}.{self.namespace}.svc.cluster.local:"
-                f"{self.port}"
+            url = self.url_template.format(
+                name=name, namespace=self.namespace, port=self.port
             )
             probed = await _probe_endpoint(url)
             if probed is None:

@@ -1,0 +1,131 @@
+"""Chip-session guard: at most ONE process may own the (tunneled) TPU.
+
+Hard-won operational lesson encoded as code: a second process dialing a
+busy TPU backend sleep-polls forever inside backend init, and SIGKILLing
+either side can wedge the remote-attached chip's tunnel for many
+minutes. The guard is a ``flock(2)`` on a well-known lock file taken
+BEFORE jax backend init:
+
+- a second TPU process fails FAST with a clear message instead of
+  hanging in backend init (``acquire`` raises :class:`ChipBusyError`);
+- teardown is SIGTERM-only: :func:`install_sigterm_handler` converts
+  SIGTERM into ``SystemExit`` so ``finally``/``atexit`` run and the
+  lock is released with the fd. Never SIGKILL a chip owner — the kernel
+  releases the flock, but the remote backend does not notice for
+  minutes and the next dial hangs.
+
+Used by ``bench.py`` and ``python -m production_stack_tpu.engine``
+whenever the process is about to initialize a real accelerator backend
+(skipped under ``JAX_PLATFORMS=cpu`` so hermetic tests never contend).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import signal
+import time
+
+DEFAULT_LOCK_PATH = "/tmp/pst_tpu_chip.lock"
+
+
+class ChipBusyError(RuntimeError):
+    """Another process holds the TPU chip lock."""
+
+
+class ChipLock:
+    """Exclusive advisory lock on the chip. Release via close() or exit."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd: int | None = None
+
+    def acquire(self) -> "ChipLock":
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            holder = ""
+            try:
+                with open(self.path) as f:
+                    holder = f.read().strip()
+            except OSError:
+                pass
+            os.close(fd)
+            raise ChipBusyError(
+                f"TPU chip lock {self.path} is held"
+                + (f" by [{holder}]" if holder else "")
+                + "; refusing to start a second TPU process (a second "
+                "dial can wedge the tunnel). Wait for the owner to exit "
+                "or SIGTERM it — never SIGKILL."
+            ) from None
+        os.ftruncate(fd, 0)
+        os.write(
+            fd,
+            f"pid={os.getpid()} start={time.strftime('%FT%TZ', time.gmtime())}".encode(),
+        )
+        self._fd = fd
+        return self
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                os.ftruncate(self._fd, 0)
+            except OSError:
+                pass
+            os.close(self._fd)  # closes => flock released
+            self._fd = None
+
+    def __enter__(self) -> "ChipLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def chip_guard_needed() -> bool:
+    """True when this process is about to own a real accelerator backend.
+
+    ``JAX_PLATFORMS=cpu`` (how every hermetic test runs) means no chip is
+    dialed, so no guard; anything else (unset, ``tpu``, a plugin
+    platform, or a mixed list like ``tpu,cpu``) may reach real hardware.
+    """
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if not plats:
+        return True
+    entries = [p.strip().lower() for p in plats.split(",") if p.strip()]
+    return any(p != "cpu" for p in entries) or not entries
+
+
+def engage(lock_path: str | None = None) -> ChipLock | None:
+    """The one chip-session ritual for TPU-owning entry points:
+    SIGTERM-only teardown + exclusive chip lock. Returns the held lock
+    (keep it for process lifetime) or None when no guard is needed;
+    raises ChipBusyError when another process owns the chip."""
+    install_sigterm_handler()
+    return acquire_chip_lock(lock_path)
+
+
+def acquire_chip_lock(path: str | None = None) -> ChipLock | None:
+    """Take the chip lock iff this process will touch real hardware.
+
+    Returns the held lock (caller keeps it for process lifetime), or
+    None when no guard is needed. Raises ChipBusyError when another
+    process owns the chip.
+    """
+    if not chip_guard_needed():
+        return None
+    return ChipLock(path or os.environ.get(
+        "PST_CHIP_LOCK", DEFAULT_LOCK_PATH
+    )).acquire()
+
+
+def install_sigterm_handler() -> None:
+    """SIGTERM -> SystemExit so finally/atexit (and the flock fd) run.
+
+    Makes SIGTERM the one sanctioned way to stop a chip owner."""
+
+    def _handler(signum, frame):  # noqa: ARG001
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _handler)

@@ -1,0 +1,51 @@
+"""Bounded-timeout TPU backend probe. Prints one JSON line; exit 0 iff up.
+
+Chip-session hygiene (see README): short-lived, daemon-thread bounded,
+never SIGKILLed. Used by scripts/tpu_probe_loop.sh to build the
+timestamped availability record (TPU_ATTEMPTS.log) the round requires.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from production_stack_tpu.utils.chip_guard import (  # noqa: E402
+    ChipBusyError,
+    acquire_chip_lock,
+)
+
+try:
+    _lock = acquire_chip_lock()
+except ChipBusyError:
+    print(json.dumps({
+        "ok": False,
+        "error": "skipped: chip lock held (another TPU process owns it)",
+        "dt": 0.0,
+    }))
+    raise SystemExit(2)
+
+box = {}
+
+
+def probe():
+    try:
+        import jax
+
+        box["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001
+        box["error"] = f"{type(e).__name__}: {e}"
+
+
+t = threading.Thread(target=probe, daemon=True)
+t0 = time.time()
+t.start()
+t.join(90)
+dt = round(time.time() - t0, 1)
+if "devices" in box:
+    print(json.dumps({"ok": True, "devices": box["devices"], "dt": dt}))
+    raise SystemExit(0)
+err = box.get("error", "timeout after 90s")
+print(json.dumps({"ok": False, "error": str(err)[:300], "dt": dt}))
+raise SystemExit(1)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the TPU every ~15 min, appending to TPU_ATTEMPTS.log.
+# Exits 0 the moment the backend answers (so a watcher can run bench.py).
+# Touch TPU_PROBE_PAUSE in the repo root to skip probes (e.g. while a
+# bench run owns the chip) — hygiene: one TPU process at a time.
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if [ -f TPU_PROBE_PAUSE ]; then
+    sleep 60
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) probe start" >> TPU_ATTEMPTS.log
+  if python scripts/tpu_probe.py >> TPU_ATTEMPTS.log 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) TPU UP" >> TPU_ATTEMPTS.log
+    exit 0
+  fi
+  sleep 810
+done

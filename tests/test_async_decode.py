@@ -79,12 +79,113 @@ def test_async_pipeline_actually_chains():
 
 
 def test_async_with_eos_stops_matches_sync():
-    """EOS/stop-bearing params force per-round flushes (no chaining) but
+    """EOS-bearing params chain speculatively (overshoot discarded);
     outputs must still match sync exactly."""
     sp = SamplingParams(max_tokens=16, temperature=0.0)  # eos active
     out_a = run(make_engine(True), _prompts(), sp)
     out_s = run(make_engine(False), _prompts(), sp)
     assert out_a == out_s
+
+
+def _count_chains(eng):
+    """Instrument _can_chain to count rounds dispatched via the chain."""
+    box = {"n": 0}
+    orig = eng._can_chain
+
+    def counting():
+        r = orig()
+        if r:
+            box["n"] += 1
+        return r
+
+    eng._can_chain = counting
+    return box
+
+
+def _count_dispatches(eng):
+    """Count decode_multi dispatches (device rounds)."""
+    box = {"n": 0}
+    orig = eng.runner.decode_multi
+
+    def counting(*a, **kw):
+        box["n"] += 1
+        return orig(*a, **kw)
+
+    eng.runner.decode_multi = counting
+    return box
+
+
+def test_async_chains_with_eos_enabled():
+    """The flagship case: normal chat traffic (EOS active, no
+    ignore_eos) must still engage the double-buffered pipeline — the
+    chain is speculative and overshoot is discarded."""
+    eng = make_engine(True)
+    chained = _count_chains(eng)
+    sp = SamplingParams(max_tokens=40, temperature=0.0)  # eos ACTIVE
+    out_a = run(eng, _prompts(), sp)
+    out_s = run(make_engine(False), _prompts(), sp)
+    assert out_a == out_s
+    assert chained["n"] >= 3  # pipeline engaged despite EOS being active
+
+
+def test_async_chains_with_stop_token_ids():
+    """stop_token_ids no longer disable chaining. Use a stop token the
+    greedy run never emits so generations run to max_tokens."""
+    base = run(make_engine(False), _prompts(),
+               SamplingParams(max_tokens=32, temperature=0.0,
+                              ignore_eos=True))
+    never = next(t for t in range(384)
+                 if all(t not in ids for ids in base))
+    sp = SamplingParams(max_tokens=32, temperature=0.0,
+                        ignore_eos=True, stop_token_ids=[never])
+    eng = make_engine(True)
+    chained = _count_chains(eng)
+    out_a = run(eng, _prompts(), sp)
+    out_s = run(make_engine(False), _prompts(), sp)
+    assert out_a == out_s
+    assert all(len(t) == 32 for t in out_a)
+    assert chained["n"] >= 3
+
+
+def test_async_stop_token_fires_mid_chain():
+    """A stop token that actually FIRES mid-generation: the async
+    output must be truncated at exactly the sync point (overshoot
+    tokens discarded), with the pipeline having engaged beforehand."""
+    probe = run(make_engine(False), _prompts(),
+                SamplingParams(max_tokens=32, temperature=0.0,
+                               ignore_eos=True))
+    # stop on a token ~2/3 into the longest stream so several chained
+    # rounds happen first
+    stop_tok = probe[0][20]
+    sp = SamplingParams(max_tokens=32, temperature=0.0,
+                        ignore_eos=True, stop_token_ids=[stop_tok])
+    eng = make_engine(True)
+    chained = _count_chains(eng)
+    out_a = run(eng, _prompts(), sp)
+    out_s = run(make_engine(False), _prompts(), sp)
+    assert out_a == out_s
+    assert out_a[0][-1] == stop_tok
+    assert len(out_a[0]) <= 21
+    assert chained["n"] >= 1
+
+
+def test_async_overshoot_waste_bounded():
+    """Speculative chaining may waste at most ONE extra device round
+    per finished stream vs the sync path."""
+    probe = run(make_engine(False), _prompts(),
+                SamplingParams(max_tokens=32, temperature=0.0,
+                               ignore_eos=True))
+    stop_tok = probe[0][20]
+    sp = SamplingParams(max_tokens=32, temperature=0.0,
+                        ignore_eos=True, stop_token_ids=[stop_tok])
+    eng_s = make_engine(False)
+    sync_n = _count_dispatches(eng_s)
+    out_s = run(eng_s, _prompts(), sp)
+    eng_a = make_engine(True)
+    async_n = _count_dispatches(eng_a)
+    out_a = run(eng_a, _prompts(), sp)
+    assert out_a == out_s
+    assert async_n["n"] <= sync_n["n"] + len(out_s)
 
 
 def test_async_with_penalties_falls_back():

@@ -1,0 +1,103 @@
+"""Chip-session guard: one TPU process at a time (flock), SIGTERM-only
+teardown. The guard exists so a second dial can never wedge the
+remote-attached chip's tunnel again (it costs minutes per incident)."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from production_stack_tpu.utils.chip_guard import (
+    ChipBusyError,
+    ChipLock,
+    acquire_chip_lock,
+    chip_guard_needed,
+    install_sigterm_handler,
+)
+
+
+def test_second_acquire_fails_fast(tmp_path):
+    path = str(tmp_path / "chip.lock")
+    lock = ChipLock(path).acquire()
+    try:
+        with pytest.raises(ChipBusyError) as ei:
+            ChipLock(path).acquire()
+        assert "SIGKILL" in str(ei.value)  # teardown guidance in the error
+        assert f"pid={os.getpid()}" in str(ei.value)  # names the holder
+    finally:
+        lock.release()
+
+
+def test_release_allows_reacquire(tmp_path):
+    path = str(tmp_path / "chip.lock")
+    lock = ChipLock(path).acquire()
+    lock.release()
+    with ChipLock(path):
+        pass  # context-manager form
+
+
+def test_cross_process_exclusion(tmp_path):
+    path = str(tmp_path / "chip.lock")
+    with ChipLock(path):
+        rc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, sys.argv[2]);"
+             "from production_stack_tpu.utils.chip_guard import *\n"
+             "try:\n"
+             "    ChipLock(sys.argv[1]).acquire()\n"
+             "except ChipBusyError:\n"
+             "    sys.exit(42)\n"
+             "sys.exit(0)",
+             path, os.getcwd()],
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        ).returncode
+        assert rc == 42
+
+
+def test_guard_skipped_on_cpu_platform(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not chip_guard_needed()
+    assert acquire_chip_lock() is None  # hermetic tests never contend
+
+
+def test_guard_needed_on_real_platforms(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert chip_guard_needed()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert chip_guard_needed()
+    # a mixed list still dials the accelerator: cpu-anywhere must not
+    # disable the guard
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert chip_guard_needed()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,axon")
+    assert chip_guard_needed()
+    monkeypatch.setenv("JAX_PLATFORMS", " CPU ")
+    assert not chip_guard_needed()
+
+
+def test_engage_ritual(tmp_path, monkeypatch):
+    from production_stack_tpu.utils import chip_guard
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("PST_CHIP_LOCK", str(tmp_path / "chip.lock"))
+    lock = chip_guard.engage()
+    try:
+        assert lock is not None
+        with pytest.raises(ChipBusyError):
+            chip_guard.engage()
+    finally:
+        lock.release()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+def test_sigterm_becomes_systemexit():
+    install_sigterm_handler()
+    try:
+        with pytest.raises(SystemExit) as ei:
+            os.kill(os.getpid(), signal.SIGTERM)
+            signal.sigtimedwait([], 0)  # force delivery point
+        assert ei.value.code == 143
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)

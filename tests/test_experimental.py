@@ -193,6 +193,38 @@ class TestPII:
             assert r3 is None
         asyncio.run(run())
 
+    def test_presidio_analyzer(self):
+        """When presidio IS installed the analyzer must produce spans
+        that index back into the original text and integrate with the
+        middleware (mirrors the FAISS parity pattern; reference:
+        experimental/pii/analyzers/presidio_analyzer.py:45)."""
+        pytest.importorskip("presidio_analyzer")
+        from production_stack_tpu.router.experimental.pii import (
+            PresidioAnalyzer,
+        )
+
+        a = PresidioAnalyzer()
+        text = "mail me at alice@example.com from host 10.1.2.3"
+        matches = a.analyze(text)
+        types = {m.entity_type for m in matches}
+        assert "EMAIL_ADDRESS" in types
+        for m in matches:
+            assert text[m.start:m.end] == m.text
+        mw = PIIMiddleware(analyzer="presidio", action="block")
+        assert isinstance(mw.analyzer, PresidioAnalyzer)
+
+    def test_presidio_unavailable_falls_back_to_regex(self):
+        """Without presidio the middleware must degrade to the regex
+        analyzer with a warning, never crash."""
+        try:
+            import presidio_analyzer  # noqa: F401
+
+            pytest.skip("presidio installed; fallback path not taken")
+        except ImportError:
+            pass
+        mw = PIIMiddleware(analyzer="presidio")
+        assert isinstance(mw.analyzer, RegexAnalyzer)
+
 
 # -- e2e through the real router app ----------------------------------------
 async def _start_stack(extra_args=()):

@@ -20,23 +20,32 @@ from aiohttp import web
 from production_stack_tpu.router.k8s_client import K8sClient
 from production_stack_tpu.router.service_discovery import (
     K8sPodIPServiceDiscovery,
+    K8sServiceNameServiceDiscovery,
 )
 
 from tests.fake_engine import FakeEngine
 
 
 class WatchableApiServer:
-    """Pods endpoint with list + chunked watch streaming."""
+    """Pods + Services endpoints with list + chunked watch streaming."""
 
     def __init__(self):
-        self.pods: dict[str, dict] = {}
-        self._subscribers: list[asyncio.Queue] = []
+        self.store: dict[str, dict[str, dict]] = {
+            "pods": {}, "services": {},
+        }
+        self._subscribers: dict[str, list[asyncio.Queue]] = {
+            "pods": [], "services": [],
+        }
         app = web.Application()
         app.router.add_get(
-            "/api/v1/namespaces/{ns}/pods", self.handle_pods
+            "/api/v1/namespaces/{ns}/{plural}", self.handle
         )
         self.app = app
         self.port = None
+
+    @property
+    def pods(self) -> dict[str, dict]:
+        return self.store["pods"]
 
     def pod(self, name: str, ip: str, phase: str = "Running") -> dict:
         return {
@@ -56,36 +65,53 @@ class WatchableApiServer:
             },
         }
 
-    async def emit(self, ev_type: str, pod: dict) -> None:
-        if ev_type == "DELETED":
-            self.pods.pop(pod["metadata"]["name"], None)
-        else:
-            self.pods[pod["metadata"]["name"]] = pod
-        for q in self._subscribers:
-            q.put_nowait({"type": ev_type, "object": pod})
+    def svc(self, name: str, model: str | None = None) -> dict:
+        labels = {"environment": "router-controlled"}
+        if model:
+            labels["model"] = model
+        return {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {"ports": [{"port": 8000}]},
+        }
 
-    async def handle_pods(self, request: web.Request) -> web.StreamResponse:
+    async def emit(self, ev_type: str, obj: dict,
+                   plural: str = "pods") -> None:
+        if ev_type == "DELETED":
+            self.store[plural].pop(obj["metadata"]["name"], None)
+        else:
+            self.store[plural][obj["metadata"]["name"]] = obj
+        for q in self._subscribers[plural]:
+            q.put_nowait({"type": ev_type, "object": obj})
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        plural = request.match_info["plural"]
+        objs = self.store[plural]
         if request.query.get("watch") != "true":
-            return web.json_response({"items": list(self.pods.values())})
+            return web.json_response({"items": list(objs.values())})
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         await resp.prepare(request)
         q: asyncio.Queue = asyncio.Queue()
-        for pod in self.pods.values():  # replay current state
-            q.put_nowait({"type": "ADDED", "object": pod})
-        self._subscribers.append(q)
+        for obj in objs.values():  # replay current state
+            q.put_nowait({"type": "ADDED", "object": obj})
+        self._subscribers[plural].append(q)
         try:
             while True:
                 ev = await q.get()
                 await resp.write(json.dumps(ev).encode() + b"\n")
         finally:
-            self._subscribers.remove(q)
+            self._subscribers[plural].remove(q)
         return resp
 
     async def start(self):
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        # the watch handler blocks on q.get() forever by design; without
+        # a short shutdown_timeout, cleanup() waits the default 60s for
+        # it to finish
+        site = web.TCPSite(self._runner, "127.0.0.1", 0,
+                           shutdown_timeout=0.5)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
 
@@ -168,3 +194,79 @@ def test_k8s_pod_discovery_end_to_end():
             await api.stop()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_k8s_service_name_discovery_end_to_end():
+    """Service-name discovery driven end-to-end: the real watch client
+    consumes Service events, probes each service URL (/v1/models incl.
+    the kv-instance-id handshake), and removes endpoints on DELETED.
+    Cluster DNS cannot resolve in-image, so the test injects a
+    url_template that maps the one service name to loopback — the
+    default template is asserted separately below."""
+
+    async def scenario():
+        api = WatchableApiServer()
+        await api.start()
+
+        engine = FakeEngine(model="m", kv_instance_id="svc-engine:dev0")
+        await engine.start(host="127.0.0.1")
+        port = engine.port
+
+        await api.emit("ADDED", api.svc("localhost", model="m"),
+                       plural="services")
+        # a service whose engine is unreachable must be skipped, not
+        # crash the watch loop
+        await api.emit("ADDED", api.svc("unreachable"), plural="services")
+
+        disco = K8sServiceNameServiceDiscovery(
+            namespace="default", port=port,
+            k8s_client=K8sClient(host=f"http://127.0.0.1:{api.port}",
+                                 namespace="default"),
+            url_template="http://{name}:{port}",
+        )
+        await disco.start()
+        try:
+            assert await _wait_for(
+                lambda: len(disco.get_endpoint_info()) == 1
+            ), disco.get_endpoint_info()
+            (ep,) = disco.get_endpoint_info()
+            assert ep.url == f"http://localhost:{port}"
+            assert ep.model_names == ["m"]
+            assert ep.model_label == "m"
+            assert ep.kv_instance_id == "svc-engine:dev0"
+            assert disco.get_health()
+
+            # real routing over the discovered endpoint
+            from production_stack_tpu.router.protocols import RouterRequest
+            from production_stack_tpu.router.routing_logic import (
+                RoundRobinRouter,
+            )
+
+            router = RoundRobinRouter()
+            req = RouterRequest(headers={}, body={"prompt": "x"},
+                                endpoint="/v1/completions")
+            assert await router.route_request(
+                disco.get_endpoint_info(), {}, {}, req
+            ) == f"http://localhost:{port}"
+
+            # service deletion flows through the watch (failure detection)
+            await api.emit("DELETED", api.svc("localhost"),
+                           plural="services")
+            assert await _wait_for(
+                lambda: len(disco.get_endpoint_info()) == 0
+            )
+        finally:
+            await disco.close()
+            await engine._runner.cleanup()
+            await api.stop()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_k8s_service_name_default_url_is_cluster_dns():
+    assert (
+        K8sServiceNameServiceDiscovery.DEFAULT_URL_TEMPLATE.format(
+            name="svc-a", namespace="prod", port=8000
+        )
+        == "http://svc-a.prod.svc.cluster.local:8000"
+    )

@@ -413,6 +413,93 @@ def test_lora_equalized_placement_spreads_by_load(operator_bin):
     run_in_loop(scenario())
 
 
+def test_lora_equalized_prefers_reachable_engines(operator_bin):
+    """An engine whose /v1/models probe fails (e.g. a Running pod still
+    loading weights) must sort LAST under 'equalized' — counting it as 0
+    would preferentially place adapters on it, guaranteeing failed loads
+    and placement flapping until the pod serves HTTP (advisor r3)."""
+
+    async def scenario():
+        api = FakeApiServer()
+        await api.start()
+
+        calls: list[dict] = []
+        loaded: list[dict] = []
+
+        async def load_lora(request):
+            body = await request.json()
+            calls.append(body)
+            loaded.append({"id": body["lora_name"],
+                           "root": body["lora_path"]})
+            return web.json_response({"status": "ok"})
+
+        async def models(request):
+            # this engine already serves 2 adapters — still preferable
+            # to an unreachable one
+            cards = [{"id": "m", "root": "m"}] + [
+                {"id": f"a{i}", "root": f"/models/a{i}"} for i in range(2)
+            ] + loaded
+            return web.json_response({"object": "list", "data": cards})
+
+        app = web.Application()
+        app.router.add_post("/v1/load_lora_adapter", load_lora)
+        app.router.add_get("/v1/models", models)
+        import socket
+
+        runner = web.AppRunner(app)
+        await runner.setup()
+        while True:
+            site = web.TCPSite(runner, "127.0.0.2", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            # the test needs 127.0.0.1:port CLOSED (unreachable engine);
+            # the ephemeral port was only allocated on 127.0.0.2, so
+            # verify nothing else holds it on 127.0.0.1
+            try:
+                probe_sock = socket.socket()
+                probe_sock.bind(("127.0.0.1", port))
+                probe_sock.close()
+                break
+            except OSError:
+                await site.stop()
+
+        # engine-0 (sorts first, would win a tie) is Running but serves
+        # no HTTP on 127.0.0.1:port -> probe fails fast
+        for i, ip in enumerate(["127.0.0.1", "127.0.0.2"]):
+            api.seed("v1", "pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"llama3-engine-{i}",
+                             "labels": {"app": "pst-engine",
+                                        "model": "llama3"}},
+                "status": {"phase": "Running", "podIP": ip},
+            })
+        api.seed("production-stack.tpu/v1alpha1", "loraadapters", {
+            "apiVersion": "production-stack.tpu/v1alpha1",
+            "kind": "LoraAdapter",
+            "metadata": {"name": "reach-adapter", "uid": "u11",
+                         "generation": 1},
+            "spec": {"baseModel": "llama3",
+                     "adapterName": "reach-lora",
+                     "adapterPath": "/models/reach-lora",
+                     "placement": {"algorithm": "equalized",
+                                   "maxEngines": 1}},
+        })
+        await asyncio.get_running_loop().run_in_executor(
+            None, run_operator_once, api.port, port
+        )
+        # placed on the reachable engine despite its higher adapter count
+        assert len(calls) == 1
+        cr = api.objs("production-stack.tpu/v1alpha1",
+                      "loraadapters")["reach-adapter"]
+        placed = cr["status"]["loadedAdapters"]
+        assert [e["pod"] for e in placed] == ["llama3-engine-1"]
+        assert placed[0]["status"] == "loaded"
+        await runner.cleanup()
+        await api.stop()
+
+    run_in_loop(scenario())
+
+
 # -- gateway endpoint picker (C++) -----------------------------------------
 # (reference: src/gateway_inference_extension pickers; kvaware queries the
 # KV controller over TCP, kv_aware_picker.go:90-131 — ours speaks

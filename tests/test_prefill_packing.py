@@ -203,3 +203,81 @@ def test_scheduler_no_packing_without_chunking():
     # unbounded whole-prompt chunks must not pack (bucket blowup guard)
     assert len(out.prefills) == 1
     assert out.prefills[0].chunk_len == 10
+
+
+def test_packing_respects_decode_interleave_bound():
+    """When decode-ready sequences are waiting, a packed prefill group
+    must not exceed the remaining decode_interleave budget — otherwise
+    the documented ITL bound ("at most K prefill chunks between decode
+    steps") silently becomes K-1+max_prefill_seqs (advisor r3)."""
+    from production_stack_tpu.engine.block_manager import BlockManager
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.sequence import Sequence
+
+    def build(decode_interleave):
+        bm = BlockManager(num_blocks=256, block_size=4,
+                          enable_prefix_caching=False)
+        sched = Scheduler(
+            SchedulerConfig(max_num_seqs=16, max_prefill_chunk=8,
+                            max_prefill_seqs=8,
+                            decode_interleave=decode_interleave),
+            bm,
+        )
+        # one decode-ready sequence
+        d = Sequence(request_id="d", prompt_token_ids=list(range(1, 9)),
+                     sampling_params=SamplingParams(max_tokens=64),
+                     eos_token_id=None)
+        sched.add_seq(d)
+        out = sched.schedule()
+        for w in out.prefills:
+            w.seq.num_computed_tokens += w.chunk_len
+        d.append_token(1)
+        out = sched.schedule()  # decode round resets the prefill streak
+        assert out.decode is not None
+        d.num_computed_tokens = d.num_tokens
+        d.append_token(1)
+        # six fresh prompts wanting prefill
+        for i in range(6):
+            sched.add_seq(Sequence(
+                request_id=f"p{i}", prompt_token_ids=list(range(1, 9)),
+                sampling_params=SamplingParams(max_tokens=2),
+                eos_token_id=None,
+            ))
+        return sched
+
+    # K=1: exactly one prefill chunk, then a decode, never a full group
+    sched = build(decode_interleave=1)
+    out = sched.schedule()
+    assert len(out.prefills) == 1  # capped by the ITL budget, not 6
+    out.prefills[0].seq.num_computed_tokens += out.prefills[0].chunk_len
+    out = sched.schedule()
+    assert out.decode is not None  # the bound held
+
+    # K=4: the group may take the whole remaining budget at once
+    sched = build(decode_interleave=4)
+    out = sched.schedule()
+    assert len(out.prefills) == 4
+    for w in out.prefills:
+        w.seq.num_computed_tokens += w.chunk_len
+    out = sched.schedule()
+    assert out.decode is not None
+
+    # no decode-ready sequences: packing is unconstrained
+    bm = BlockManager(num_blocks=256, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=16, max_prefill_chunk=8,
+                        max_prefill_seqs=8, decode_interleave=1),
+        bm,
+    )
+    for i in range(6):
+        sched.add_seq(Sequence(
+            request_id=f"p{i}", prompt_token_ids=list(range(1, 9)),
+            sampling_params=SamplingParams(max_tokens=2),
+            eos_token_id=None,
+        ))
+    out = sched.schedule()
+    assert len(out.prefills) == 6
