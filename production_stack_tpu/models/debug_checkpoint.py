@@ -1,0 +1,145 @@
+"""Generate a tiny-but-REAL HF Llama-family checkpoint on disk.
+
+Writes everything a genuine checkpoint directory has — ``config.json``,
+``model.safetensors`` in HF's torch (out, in) layout, and a real fast
+tokenizer (``tokenizer.json`` + ``tokenizer_config.json`` with eos/bos
+and a chat template) — so the whole serve path runs exactly as it would
+for a downloaded model: ``resolve_model_dir`` -> ``load_hf_weights`` ->
+``HFTokenizer`` -> ``engine/server.py``.
+
+Role model: the reference's e2e tier serves a real small checkpoint
+(opt-125m, reference: .github/workflows/router-e2e-test.yml:195-196);
+this image has zero egress, so the checkpoint is generated once on disk
+and then treated as opaque files.
+
+CLI: ``python -m production_stack_tpu.models.debug_checkpoint OUTDIR``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+DEFAULT_CONFIG = {
+    "architectures": ["LlamaForCausalLM"],
+    "vocab_size": 384,
+    "hidden_size": 32,
+    "intermediate_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "max_position_embeddings": 256,
+    "rope_theta": 10000.0,
+    "rms_norm_eps": 1e-5,
+    "tie_word_embeddings": False,
+}
+
+# enough text for a stable char/BPE vocab covering ascii prompts
+_TOKENIZER_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "The Quick Brown Fox Jumps Over The Lazy Dog 0123456789",
+    "hello world! how are you today? i am a tiny debug model.",
+    "serving engines route requests, cache kv blocks, stream tokens.",
+    "!\"#$%&'()*+,-./:;<=>?@[]^_`{|}~",
+]
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}<|{{ message.role }}|>\n"
+    "{{ message.content }}\n{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+def write_debug_tokenizer(dirpath: str, vocab_size: int = 384) -> None:
+    """Train + save a real byte-level BPE fast tokenizer into dirpath."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers.trainers import BpeTrainer
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<s>", "</s>", "<unk>"],
+        show_progress=False,
+    )
+    tok.train_from_iterator(_TOKENIZER_CORPUS, trainer)
+    tok.save(os.path.join(dirpath, "tokenizer.json"))
+    with open(os.path.join(dirpath, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<s>",
+            "eos_token": "</s>",
+            "unk_token": "<unk>",
+            "model_max_length": 256,
+            "chat_template": CHAT_TEMPLATE,
+        }, f, indent=1)
+
+
+def write_debug_checkpoint(
+    dirpath: str,
+    seed: int = 0,
+    config: dict | None = None,
+    with_tokenizer: bool = True,
+) -> dict[str, np.ndarray]:
+    """Write config + weights (+ tokenizer); returns the HF tensor dict."""
+    from safetensors.numpy import save_file
+
+    c = dict(DEFAULT_CONFIG)
+    c.update(config or {})
+    rng = np.random.RandomState(seed)
+    h, i, v = c["hidden_size"], c["intermediate_size"], c["vocab_size"]
+    hd = h // c["num_attention_heads"]
+    q_size = c["num_attention_heads"] * hd
+    kv_size = c["num_key_value_heads"] * hd
+    tensors = {
+        "model.embed_tokens.weight":
+            rng.randn(v, h).astype(np.float32) * 0.1,
+        "model.norm.weight": np.ones(h, np.float32),
+        "lm_head.weight": rng.randn(v, h).astype(np.float32) * 0.1,
+    }
+    for layer in range(c["num_hidden_layers"]):
+        p = f"model.layers.{layer}."
+        tensors[p + "input_layernorm.weight"] = np.ones(h, np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(
+            h, np.float32)
+        tensors[p + "self_attn.q_proj.weight"] = (
+            rng.randn(q_size, h).astype(np.float32) * 0.1)
+        tensors[p + "self_attn.k_proj.weight"] = (
+            rng.randn(kv_size, h).astype(np.float32) * 0.1)
+        tensors[p + "self_attn.v_proj.weight"] = (
+            rng.randn(kv_size, h).astype(np.float32) * 0.1)
+        tensors[p + "self_attn.o_proj.weight"] = (
+            rng.randn(h, q_size).astype(np.float32) * 0.1)
+        tensors[p + "mlp.gate_proj.weight"] = (
+            rng.randn(i, h).astype(np.float32) * 0.1)
+        tensors[p + "mlp.up_proj.weight"] = (
+            rng.randn(i, h).astype(np.float32) * 0.1)
+        tensors[p + "mlp.down_proj.weight"] = (
+            rng.randn(h, i).astype(np.float32) * 0.1)
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "config.json"), "w") as f:
+        json.dump(c, f, indent=1)
+    save_file(tensors, os.path.join(dirpath, "model.safetensors"))
+    if with_tokenizer:
+        write_debug_tokenizer(dirpath, vocab_size=c["vocab_size"])
+    return tensors
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="write a tiny real HF checkpoint (weights + tokenizer)"
+    )
+    ap.add_argument("outdir")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    write_debug_checkpoint(args.outdir, seed=args.seed)
+    print(f"wrote debug checkpoint to {args.outdir}")
+
+
+if __name__ == "__main__":
+    main()
