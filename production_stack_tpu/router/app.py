@@ -136,6 +136,7 @@ class RouterApp:
             kv_min_match_tokens=args.kv_aware_threshold,
             kv_transfer_gbps=args.kv_transfer_gbps,
             kv_bytes_per_token=args.kv_bytes_per_token,
+            default_prefill_tps=args.default_prefill_tps,
             tokenizer=tokenizer,
         )
 

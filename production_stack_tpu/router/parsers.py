@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                            "transfer-time correction (default: "
                            "Llama-3.2-3B bf16: 2*28 layers*8 kv heads"
                            "*128 head dim*2 bytes)")
+    rout.add_argument("--default-prefill-tps", type=float, default=8000.0,
+                      help="cold-start prefill tokens/s the ttft "
+                           "estimator assumes before the first MEASURED "
+                           "per-engine prefill TPS arrives (after that, "
+                           "measured stats and the fleet EWMA take over)")
     rout.add_argument("--prefill-model-labels", type=str, default=None,
                       help="comma-separated labels marking prefill pods")
     rout.add_argument("--decode-model-labels", type=str, default=None,

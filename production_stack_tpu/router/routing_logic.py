@@ -318,12 +318,27 @@ class TtftRouter(RoutingInterface):
         tokenizer=None,
         kv_transfer_gbps: float = 10.0,
         kv_bytes_per_token: int = 114688,
+        default_prefill_tps: float = 8000.0,
         **kwargs,
     ):
         self.tokenizer = tokenizer
         self.kv_controller_url = kv_controller_url
         self._kv_client = None
-        self.default_prefill_tps = 8000.0
+        # bootstrap-only constant: used until the FIRST measured
+        # prefill-TPS sample arrives, after which the fleet EWMA below
+        # replaces it for engines that lack their own measurement
+        # (reference derives prefill TPS from measured request stats,
+        # request_stats.py:363-390; ours does too — these fallbacks only
+        # cover the cold-start window)
+        self.default_prefill_tps = default_prefill_tps
+        # fleet-wide EWMA of measured per-engine prefill TPS: a fresh or
+        # stat-less engine is assumed to prefill like its (identically
+        # provisioned) peers, not like a hardcoded guess
+        self._fleet_tps: float | None = None
+        # EWMA of routed prompt sizes: a queued request is costed at the
+        # measured average prompt / measured TPS instead of a constant
+        self._avg_prompt_tokens: float | None = None
+        self._ewma_alpha = 0.1
         # transfer-time correction (reference: routing_logic.py:649-676):
         # a prefix cached on a DIFFERENT instance can be pulled over the
         # KV transfer link instead of recomputed; 0 Gbps disables it
@@ -364,17 +379,31 @@ class TtftRouter(RoutingInterface):
     ) -> float:
         rs = request_stats.get(ep.url)
         es = engine_stats.get(ep.url)
-        tps = (
-            rs.prefill_tps
-            if rs and rs.prefill_tps > 0
-            else self.default_prefill_tps
-        )
+        if rs and rs.prefill_tps > 0:
+            tps = rs.prefill_tps
+            # fold every fresh measurement into the fleet estimate
+            self._fleet_tps = (
+                tps
+                if self._fleet_tps is None
+                else (1 - self._ewma_alpha) * self._fleet_tps
+                + self._ewma_alpha * tps
+            )
+        elif self._fleet_tps is not None:
+            tps = self._fleet_tps  # stat-less engine: assume peer speed
+        else:
+            tps = self.default_prefill_tps  # cold start, nothing measured
         backlog = rs.uncomputed_prefix_tokens if rs else 0
         queued = es.num_queuing_requests if es else 0
         new_tokens = max(1, n_tokens - matched_tokens)
-        # queued requests assumed to cost their average prompt; approximate
-        # with the backlog signal + a per-request constant
-        est = (backlog + new_tokens) / tps + 0.05 * queued
+        # queued requests cost their (measured) average prompt at the
+        # engine's (measured) prefill speed; 0.05 s/request only covers
+        # the window before any prompt has been observed
+        per_queued_s = (
+            self._avg_prompt_tokens / tps
+            if self._avg_prompt_tokens is not None
+            else 0.05
+        )
+        est = (backlog + new_tokens) / tps + per_queued_s * queued
         # transfer-time correction: tokens cached on another instance can
         # be pulled over the KV link instead of recomputed — credit the
         # cheaper of the two (reference: routing_logic.py:649-676)
@@ -395,6 +424,14 @@ class TtftRouter(RoutingInterface):
             raise RuntimeError("no available endpoints")
         text = _engine_prompt_text(request, self.tokenizer)
         n_tokens = self._count_tokens(text)
+        # self-observed prompt-size EWMA calibrates the queued-request
+        # cost in _estimate_ttft
+        self._avg_prompt_tokens = (
+            float(n_tokens)
+            if self._avg_prompt_tokens is None
+            else (1 - self._ewma_alpha) * self._avg_prompt_tokens
+            + self._ewma_alpha * n_tokens
+        )
         matches: dict[str, int] = {}
         if self._kv_client is not None and text:
             try:

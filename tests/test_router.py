@@ -556,3 +556,106 @@ def test_ttft_transfer_time_correction_flips_decision():
     # and WITH the fast link the same small-backlog case flips to cold
     # (1s backlog vs ~0.07s transfer + no backlog)
     assert loop.run_until_complete(run(fast)) == "http://cold:8000"
+
+
+def test_ttft_measured_stats_beat_fallback_constant():
+    """Round-3 verdict item 5: with measured per-engine prefill TPS the
+    router must rank engines by their REAL speeds — a scenario where the
+    uncalibrated cold-start constant picks the wrong engine."""
+    from production_stack_tpu.router.routing_logic import TtftRouter
+    from production_stack_tpu.router.stats.request_stats import (
+        RequestStats,
+    )
+
+    eps = [
+        EndpointInfo(url="http://slow:8000", model_names=["m"]),
+        EndpointInfo(url="http://fast:8000", model_names=["m"]),
+    ]
+    # slow engine: empty, but measured to prefill at 1k tok/s.
+    # fast engine: 24k-token backlog, measured 24k tok/s (drains in 1s).
+    # A 8k-token prompt: slow takes 8s, fast takes ~1s + 0.33s.
+    measured = {
+        "http://slow:8000": RequestStats(
+            prefill_tps=1000.0, uncomputed_prefix_tokens=0),
+        "http://fast:8000": RequestStats(
+            prefill_tps=24000.0, uncomputed_prefix_tokens=24000),
+    }
+    req = make_request(body={"prompt": "x" * 32000})  # ~8k tokens
+    loop = asyncio.new_event_loop()
+
+    with_stats = TtftRouter()
+    assert loop.run_until_complete(
+        with_stats.route_request(eps, {}, measured, req)
+    ) == "http://fast:8000"
+
+    # the same topology with NO measurements: both engines are assumed
+    # to run at the cold-start constant, so the backlog dominates and
+    # the router picks the (actually slower) empty engine — this is the
+    # mis-ranking the measured path fixes
+    blind = {
+        "http://slow:8000": RequestStats(uncomputed_prefix_tokens=0),
+        "http://fast:8000": RequestStats(uncomputed_prefix_tokens=24000),
+    }
+    without_stats = TtftRouter()
+    assert loop.run_until_complete(
+        without_stats.route_request(eps, {}, blind, req)
+    ) == "http://slow:8000"
+
+
+def test_ttft_fleet_ewma_replaces_cold_start_constant():
+    """An engine with no stats yet must be costed at the measured fleet
+    speed, not the hardcoded default."""
+    from production_stack_tpu.router.routing_logic import TtftRouter
+    from production_stack_tpu.router.stats.request_stats import (
+        RequestStats,
+    )
+
+    router = TtftRouter(default_prefill_tps=8000.0)
+    eps = [
+        EndpointInfo(url="http://a:8000", model_names=["m"]),
+        EndpointInfo(url="http://b:8000", model_names=["m"]),
+    ]
+    stats = {"http://a:8000": RequestStats(prefill_tps=500.0)}
+    req = make_request(body={"prompt": "y" * 4000})
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(router.route_request(eps, {}, stats, req))
+    # the fleet EWMA learned the real (slow) speed from engine a
+    assert router._fleet_tps is not None
+    assert abs(router._fleet_tps - 500.0) < 1e-6
+
+    # engine b (no stats) is now estimated at ~500 tok/s, not 8000:
+    # its estimate for 1000 new tokens must reflect the fleet speed
+    est = loop.run_until_complete(router._estimate_ttft(
+        eps[1], 1000, 0, {}, {}
+    ))
+    assert abs(est - 1000 / 500.0) < 1e-6
+
+
+def test_ttft_queued_cost_derived_from_measurements():
+    """The per-queued-request cost must come from the observed average
+    prompt size and measured TPS, not the 0.05 s constant."""
+    from production_stack_tpu.router.routing_logic import TtftRouter
+    from production_stack_tpu.router.stats.engine_stats import EngineStats
+    from production_stack_tpu.router.stats.request_stats import (
+        RequestStats,
+    )
+
+    router = TtftRouter()
+    eps = [EndpointInfo(url="http://a:8000", model_names=["m"])]
+    stats = {"http://a:8000": RequestStats(prefill_tps=1000.0)}
+    req = make_request(body={"prompt": "z" * 8000})  # ~2000 tokens
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(router.route_request(eps, {}, stats, req))
+    assert router._avg_prompt_tokens is not None
+
+    es = {"http://a:8000": EngineStats(num_queuing_requests=4)}
+    est_queued = loop.run_until_complete(router._estimate_ttft(
+        eps[0], 100, 0, es, stats
+    ))
+    est_idle = loop.run_until_complete(router._estimate_ttft(
+        eps[0], 100, 0, {}, stats
+    ))
+    # each queued request costs avg_prompt/tps = 2000/1000 = 2s, far
+    # from the old 0.05 s constant
+    per_queued = (est_queued - est_idle) / 4
+    assert abs(per_queued - 2.0) < 0.01
