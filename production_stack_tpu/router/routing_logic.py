@@ -76,13 +76,20 @@ class RoundRobinRouter(RoutingInterface):
 
     def __init__(self, **kwargs):
         self._counter = 0
+        # cached sorted view: endpoints only change on discovery events,
+        # so re-sorting per request is wasted work on the hot path
+        self._sorted_urls: list[str] = []
+        self._key: tuple[str, ...] = ()
 
     async def route_request(self, endpoints, engine_stats, request_stats,
                             request) -> str:
         if not endpoints:
             raise RuntimeError("no available endpoints")
-        ordered = sorted(endpoints, key=lambda e: e.url)
-        url = ordered[self._counter % len(ordered)].url
+        key = tuple(e.url for e in endpoints)
+        if key != self._key:
+            self._sorted_urls = sorted(key)
+            self._key = key
+        url = self._sorted_urls[self._counter % len(self._sorted_urls)]
         self._counter += 1
         return url
 
