@@ -156,6 +156,7 @@ class RouterApp:
                 model_name=args.semantic_cache_model,
                 cache_dir=args.semantic_cache_dir,
                 threshold=args.semantic_cache_threshold,
+                embedder_url=args.semantic_cache_embedder_url,
             )
         if gates.enabled("PIIDetection"):
             from production_stack_tpu.router.experimental.pii import (

@@ -55,6 +55,59 @@ class HashedNgramEmbedder:
         return v / norm if norm > 0 else v
 
 
+class EngineEmbedder:
+    """True semantic embeddings without extra deps: embed via a serving
+    engine's /v1/embeddings endpoint (the engine's own hidden states).
+
+    This is the production-grade default for deployments that want real
+    paraphrase recall but don't ship sentence-transformers: the router
+    already fronts engines, and one of them (or a dedicated small
+    embedding engine) supplies the vectors. Async-only — check() awaits
+    it; store() reuses the vector check() computed (see _vec_memo)."""
+
+    def __init__(self, url: str, model: str | None = None,
+                 timeout_s: float = 10.0):
+        self.url = url.rstrip("/")
+        self.model = model
+        self.timeout_s = timeout_s
+        self.dim: int | None = None  # discovered on first embedding
+        self._session = None
+
+    async def encode_async(self, text: str) -> np.ndarray | None:
+        """Returns an L2-normalised vector, or None when the engine is
+        unreachable (the cache silently bypasses)."""
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        body: dict = {"input": text}
+        if self.model:
+            body["model"] = self.model
+        try:
+            async with self._session.post(
+                f"{self.url}/v1/embeddings", json=body
+            ) as r:
+                if r.status != 200:
+                    return None
+                data = await r.json()
+            v = np.asarray(
+                data["data"][0]["embedding"], dtype=np.float32
+            )
+        except Exception:  # noqa: BLE001 — engine down => cache bypass
+            return None
+        norm = float(np.linalg.norm(v))
+        v = v / norm if norm > 0 else v
+        if self.dim is None:
+            self.dim = int(v.shape[0])
+        return v
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
 class SentenceTransformerEmbedder:  # pragma: no cover - heavy optional dep
     def __init__(self, model_name: str):
         # zero-egress guard: only use a locally cached model — without this
@@ -207,18 +260,36 @@ class SemanticCache:
 
     def __init__(self, model_name: str = "all-MiniLM-L6-v2",
                  cache_dir: str | None = None, threshold: float = 0.95,
-                 max_entries: int = 4096, index_backend: str = "auto"):
+                 max_entries: int = 4096, index_backend: str = "auto",
+                 embedder_url: str | None = None):
         self.threshold = threshold
         self.cache_dir = cache_dir
         self.max_entries = max_entries
-        try:
-            self.embedder = SentenceTransformerEmbedder(model_name)
-            logger.info("semantic cache: sentence-transformers %s", model_name)
-        except Exception:  # noqa: BLE001 — not installed on this image
-            self.embedder = HashedNgramEmbedder()
-            logger.info("semantic cache: hermetic hashed-ngram embedder")
-        dim = self.embedder.dim
-        self.index = make_vector_index(dim, cache_dir, index_backend)
+        self.index_backend = index_backend
+        self.index: VectorIndex | None = None
+        if embedder_url:
+            # real semantic embeddings from a serving engine; dim is
+            # discovered on the first embedding, so the index is built
+            # lazily
+            self.embedder = EngineEmbedder(embedder_url, model_name)
+            logger.info("semantic cache: engine embedder at %s",
+                        embedder_url)
+        else:
+            try:
+                self.embedder = SentenceTransformerEmbedder(model_name)
+                logger.info(
+                    "semantic cache: sentence-transformers %s", model_name
+                )
+            except Exception:  # noqa: BLE001 — not installed on this image
+                self.embedder = HashedNgramEmbedder()
+                logger.info("semantic cache: hermetic hashed-ngram embedder")
+        if self.embedder.dim is not None:
+            self.index = make_vector_index(
+                self.embedder.dim, cache_dir, index_backend
+            )
+        # check()-computed vectors parked for the sync store() call that
+        # follows the response (async embedders cannot re-embed there)
+        self._vec_memo: dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -248,8 +319,21 @@ class SemanticCache:
         text = _chat_request_text(body)
         if not text:
             return None
-        vec = self.embedder.encode(text)
+        if isinstance(self.embedder, EngineEmbedder):
+            vec = await self.embedder.encode_async(text)
+            if vec is None:
+                return None  # embedding engine unreachable: bypass cache
+        else:
+            vec = self.embedder.encode(text)
         with self._lock:
+            if self.index is None:  # dim just discovered (engine embedder)
+                self.index = make_vector_index(
+                    self.embedder.dim, self.cache_dir, self.index_backend
+                )
+            # park the vector for the sync store() after the response
+            self._vec_memo[text] = vec
+            while len(self._vec_memo) > 1024:
+                self._vec_memo.pop(next(iter(self._vec_memo)))
             sim, payload = self.index.search(vec)
         if payload is not None and sim >= self.threshold:
             self.hits += 1
@@ -269,8 +353,19 @@ class SemanticCache:
         text = _chat_request_text(body)
         if not text:
             return
-        vec = self.embedder.encode(text)
         with self._lock:
+            vec = self._vec_memo.pop(text, None)
+        if vec is None:
+            if isinstance(self.embedder, EngineEmbedder):
+                # no vector captured at check() time (engine was down or
+                # check was skipped): nothing to store
+                return
+            vec = self.embedder.encode(text)
+        with self._lock:
+            if self.index is None:
+                self.index = make_vector_index(
+                    self.embedder.dim, self.cache_dir, self.index_backend
+                )
             sim, _ = self.index.search(vec)
             if sim >= self.threshold:
                 return  # near-duplicate already cached
@@ -283,13 +378,25 @@ class SemanticCache:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"entries": len(self.index), "hits": self.hits,
+            n = len(self.index) if self.index is not None else 0
+            return {"entries": n, "hits": self.hits,
                     "misses": self.misses, "stores": self.stores}
 
     def close(self) -> None:
         self._stop.set()
         if self._flusher is not None:
             self._flusher.join(timeout=5.0)
+        if isinstance(self.embedder, EngineEmbedder):
+            # best-effort: close the HTTP session if a loop is running
+            # (process teardown reclaims it otherwise)
+            import asyncio
+
+            try:
+                asyncio.get_running_loop().create_task(
+                    self.embedder.close()
+                )
+            except RuntimeError:
+                pass
 
     # -- background persistence -------------------------------------------
     def _flush_loop(self, interval_s: float = 5.0) -> None:
@@ -305,6 +412,8 @@ class SemanticCache:
 
     def _flush_once(self) -> None:
         with self._lock:
+            if self.index is None:
+                return  # engine embedder, nothing embedded yet
             vectors = self.index.vectors.copy()
             payloads = list(self.index.payloads)
         snap = VectorIndex(self.embedder.dim)

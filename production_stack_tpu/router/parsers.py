@@ -162,6 +162,11 @@ def build_parser() -> argparse.ArgumentParser:
                      default="all-MiniLM-L6-v2")
     sem.add_argument("--semantic-cache-dir", type=str, default=None)
     sem.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+    sem.add_argument("--semantic-cache-embedder-url", type=str,
+                     default=None,
+                     help="embed via this serving engine's /v1/embeddings "
+                          "(real semantic vectors, no extra deps) instead "
+                          "of sentence-transformers/hashed-ngrams")
 
     pii = p.add_argument_group("PII detection")
     pii.add_argument("--pii-analyzer", type=str, default="regex",
