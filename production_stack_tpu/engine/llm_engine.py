@@ -629,15 +629,38 @@ class LLMEngine:
             seq.metrics.first_token_time = time.time()
         seq.append_token(int(token))
         self._generation_tokens_total += 1
-        new_text = self.tokenizer.decode(seq.generated_token_ids)
-        prev_len = len(seq.output_text)
+        # incremental detokenization: O(1) amortised per token instead of
+        # re-decoding the whole stream (engine/detokenizer.py); output is
+        # bit-identical to decode(generated_token_ids)
+        detok = getattr(seq, "_detok", None)
+        if detok is None:
+            from production_stack_tpu.engine.detokenizer import (
+                IncrementalDetokenizer,
+            )
+
+            detok = IncrementalDetokenizer(self.tokenizer)
+            for t in seq.generated_token_ids[:-1]:  # post-preemption replay
+                detok.append(t)
+            seq._detok = detok  # type: ignore[attr-defined]
+        new_text = detok.append(int(token))
         seq.output_text = new_text
         # deltas ACCUMULATE until _make_output drains them: a multi-step
         # dispatch appends K tokens before one output is built, and a
-        # last-token-only delta would stream 1/K of the text
+        # last-token-only delta would stream 1/K of the text.
+        # Trailing U+FFFD chars are WITHHELD from the stream: a partial
+        # UTF-8 character spanning tokens re-renders once completed, and
+        # a delta already sent cannot be rewritten (they flush on finish
+        # if the byte sequence really was invalid).
+        prev_emitted = getattr(seq, "_emitted_chars", 0)
+        stable = len(new_text)
+        while stable > 0 and new_text[stable - 1] == "�":
+            stable -= 1
+        stable = max(stable, prev_emitted)  # never retract sent text
         seq._pending_delta = (
-            getattr(seq, "_pending_delta", "") + new_text[prev_len:]
+            getattr(seq, "_pending_delta", "")
+            + new_text[prev_emitted:stable]
         )  # type: ignore[attr-defined]
+        seq._emitted_chars = stable  # type: ignore[attr-defined]
         seq._pending_ids = (
             getattr(seq, "_pending_ids", []) + [int(token)]
         )  # type: ignore[attr-defined]
@@ -669,6 +692,16 @@ class LLMEngine:
     def _make_output(self, seq: Sequence) -> RequestOutput:
         new_ids = getattr(seq, "_pending_ids", [])
         delta = getattr(seq, "_pending_delta", "")
+        if seq.finished:
+            # flush any withheld trailing U+FFFD (incomplete final char)
+            # on EVERY finish path — stop, length, AND abort — so
+            # concatenated deltas always equal the final text; a
+            # stop-string-truncated output_text is shorter than the
+            # emitted count and flushes nothing
+            emitted = getattr(seq, "_emitted_chars", 0)
+            if emitted < len(seq.output_text):
+                delta += seq.output_text[emitted:]
+                seq._emitted_chars = len(seq.output_text)  # type: ignore[attr-defined]
         seq._pending_ids = []  # type: ignore[attr-defined]
         seq._pending_delta = ""  # type: ignore[attr-defined]
         return RequestOutput(
