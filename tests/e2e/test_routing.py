@@ -96,10 +96,66 @@ def check_prefixaware(args) -> None:
         assert len(pods) == 1, f"{name} spread across pods: {pods}"
 
 
+def send_chat(router_url: str, content: str, model: str) -> dict:
+    req = urllib.request.Request(
+        f"{router_url}/v1/chat/completions",
+        data=json.dumps({
+            "model": model, "max_tokens": 4,
+            "messages": [{"role": "user", "content": content}],
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def check_pd(args) -> None:
+    """Disaggregated prefill: the client-visible response always comes
+    from a DECODE pod (phase 1 runs on a prefiller but its one-token
+    output never reaches the client), and multiple decoders share the
+    load (role of the reference's PD assertions, test-routing.py:423)."""
+    outs = [send_chat(args.router_url, f"pd-prompt-{i}", args.model)
+            for i in range(args.num_requests)]
+    dist = fingerprints(outs)
+    print(f"pd decode distribution: {dict(dist)}")
+    for pod in dist:
+        assert pod.startswith(args.decode_prefix), (
+            f"response served by non-decode pod {pod!r}: {dict(dist)}"
+        )
+    assert len(dist) >= args.min_engines, (
+        f"expected >= {args.min_engines} decode pods, saw {dict(dist)}"
+    )
+
+
+# long enough that its block hashes clear any sane kv-aware threshold;
+# shared between the checker and the harness that seeds the controller
+KV_AFFINITY_PROMPT = "kv-affinity-check " + "k" * 2048
+
+
+def check_kvaware(args) -> None:
+    """KV-aware affinity: repeats of one long prompt all land on the pod
+    whose KV cache (per the controller) already holds its prefix (role
+    of the reference's kvaware assertions, test-routing.py:471)."""
+    outs = [send_completion(args.router_url, KV_AFFINITY_PROMPT,
+                            args.model) for _ in range(6)]
+    dist = fingerprints(outs)
+    print(f"kvaware distribution: {dict(dist)}")
+    assert len(dist) == 1, (
+        f"repeated prompt spread across pods: {dict(dist)}"
+    )
+    if args.expect_pod:
+        (pod,) = dist
+        assert pod == args.expect_pod, (
+            f"expected KV-holding pod {args.expect_pod!r}, got {pod!r}"
+        )
+
+
 CHECKS = {
     "roundrobin": check_roundrobin,
     "session": check_session,
     "prefixaware": check_prefixaware,
+    "pd": check_pd,
+    "kvaware": check_kvaware,
 }
 
 
@@ -112,6 +168,10 @@ def main() -> int:
     ap.add_argument("--min-engines", type=int, default=2)
     ap.add_argument("--session-key", default="x-user-id")
     ap.add_argument("--prefix-chunk-size", type=int, default=128)
+    ap.add_argument("--decode-prefix", default="decode",
+                    help="pd: fingerprint prefix marking decode pods")
+    ap.add_argument("--expect-pod", default=None,
+                    help="kvaware: the pod expected to hold the prompt")
     args = ap.parse_args()
 
     # /v1/models must list the served model before we start
