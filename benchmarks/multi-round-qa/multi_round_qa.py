@@ -169,6 +169,7 @@ class Benchmark:
         }
         answer_parts: list[str] = []
         last_chunk_t = 0.0
+        finish_reason: str | None = None
         try:
             async with http.post(
                 f"{self.args.base_url}/v1/chat/completions", json=body
@@ -188,16 +189,26 @@ class Benchmark:
                     except json.JSONDecodeError:
                         continue
                     now_chunk = time.time()
-                    if rec.first_token is None:
-                        rec.first_token = now_chunk
-                    else:
-                        rec.itls.append(now_chunk - last_chunk_t)
-                    last_chunk_t = now_chunk
+                    got_content = False
                     for choice in chunk.get("choices", []):
                         delta = choice.get("delta", {})
                         if delta.get("content"):
                             answer_parts.append(delta["content"])
                             rec.completion_tokens += 1
+                            got_content = True
+                        fr = choice.get("finish_reason")
+                        if fr is not None:
+                            finish_reason = fr
+                    # TTFT/ITL count CONTENT chunks only: servers send an
+                    # eager role-delta chunk before any token is computed,
+                    # and error/abort chunks carry no content — timing
+                    # those would fabricate sub-millisecond TTFTs
+                    if got_content:
+                        if rec.first_token is None:
+                            rec.first_token = now_chunk
+                        else:
+                            rec.itls.append(now_chunk - last_chunk_t)
+                        last_chunk_t = now_chunk
                     usage = chunk.get("usage")
                     if usage:
                         rec.prompt_tokens = usage.get("prompt_tokens", 0)
@@ -205,6 +216,14 @@ class Benchmark:
                             "completion_tokens", rec.completion_tokens
                         )
             rec.end = time.time()
+            if finish_reason not in ("stop", "length") or (
+                rec.completion_tokens == 0
+            ):
+                # aborted/errored streams (e.g. context overflow) are
+                # failures, not zero-token completions that would
+                # silently zero every latency percentile
+                self.errors += 1
+                return
             rec.ok = True
             session.history.append({"role": "user",
                                     "content": msgs[-1]["content"]})

@@ -165,6 +165,12 @@ class BlockManager:
         cached_tokens = min(cached_tokens, n - 1)
         num_matched_blocks = cached_tokens // self.block_size
         matched = matched[:num_matched_blocks]
+        # re-floor to the adopted block boundary: after the n-1 cap the
+        # token count must match the blocks actually taken, otherwise a
+        # fully-cached prompt whose length is a block multiple starts
+        # computing at a position whose preceding KV was never adopted
+        # (attention over zero blocks => corrupt logits)
+        cached_tokens = num_matched_blocks * self.block_size
 
         total_blocks = (n + self.block_size - 1) // self.block_size
         need_new = total_blocks - len(matched)

@@ -218,6 +218,21 @@ class LLMEngine:
             prompt_token_ids = self.tokenizer.encode(prompt)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if not all(isinstance(t, (int, np.integer))
+                   for t in prompt_token_ids):
+            # validate BEFORE admission: a non-int reaching the runner's
+            # array build would raise inside the step-loop thread and
+            # kill the whole engine (one malformed request = DoS)
+            raise ValueError("prompt_token_ids must be integers")
+        sp0 = sampling_params or SamplingParams()
+        if sp0.logprobs is not None:
+            from production_stack_tpu.engine.sampler import LOGPROB_CAP
+
+            if not 0 <= sp0.logprobs <= LOGPROB_CAP:
+                # same DoS class: the fused path slices a CAP-sized axis
+                raise ValueError(
+                    f"logprobs must be in [0, {LOGPROB_CAP}]"
+                )
         if lora_name is not None:
             if self.runner.lora_manager is None:
                 raise ValueError(
@@ -318,8 +333,11 @@ class LLMEngine:
         pend = self._pending_decode
         self._pending_decode = None
         toks = np.asarray(pend["toks"])  # (k, b) — the only device fetch
+        lps = pend.get("lps")
+        if lps is not None:
+            lps = tuple(np.asarray(a) for a in lps)
         seqs = pend["seqs"]
-        self._apply_multi_tokens(seqs, toks, pend["k"])
+        self._apply_multi_tokens(seqs, toks, pend["k"], lps=lps)
         # requests aborted mid-flight already emitted their final output
         # via abort_request; re-finalizing them would double-count
         # requests_finished_total and emit a spurious finished output
@@ -328,16 +346,32 @@ class LLMEngine:
         )
 
     def _apply_multi_tokens(
-        self, seqs: list[Sequence], toks: np.ndarray, k: int
+        self, seqs: list[Sequence], toks: np.ndarray, k: int,
+        lps: tuple | None = None,
     ) -> None:
         """Apply a fused-K round's (k, b) sampled tokens — the ONE copy
-        of the bookkeeping both the sync and async paths share."""
+        of the bookkeeping both the sync and async paths share.
+        `lps` = (chosen (k,b), top_vals (k,b,CAP), top_ids (k,b,CAP))
+        host arrays when any lane requested logprobs."""
         for i in range(k):
             for j, seq in enumerate(seqs):
                 if seq.finished:
                     continue  # overshoot tokens are discarded
                 seq.num_computed_tokens = seq.num_tokens
-                self._append_token(seq, int(toks[i, j]))
+                entry = None
+                n = seq.sampling_params.logprobs
+                if lps is not None and n is not None:
+                    chosen, tv, ti = lps
+                    entry = {
+                        "token_id": int(toks[i, j]),
+                        "logprob": float(chosen[i, j]),
+                        "top_logprobs": [
+                            {"token_id": int(ti[i, j, m]),
+                             "logprob": float(tv[i, j, m])}
+                            for m in range(n)
+                        ],
+                    }
+                self._append_token(seq, int(toks[i, j]), entry)
 
     # -- the step loop ----------------------------------------------------
     def step(self) -> list[RequestOutput]:
@@ -350,21 +384,26 @@ class LLMEngine:
                 pend = self._pending_decode
                 seqs: list[Sequence] = pend["seqs"]
                 k = pend["k"]
+                want_lp = pend.get("lps") is not None
                 temps, top_ps, top_ks, keys, _ = self._sampling_arrays(
                     seqs
                 )
                 keys[:, 1] += k  # k sampled-but-unapplied tokens per lane
                 positions = [s.num_tokens - 1 + k for s in seqs]
                 ctx_lens = [s.num_tokens + k for s in seqs]
-                toks_next = self.runner.decode_multi(
+                ys = self.runner.decode_multi(
                     pend["toks"][-1], positions,
                     [s.block_table for s in seqs], ctx_lens, k,
                     temps, top_ps, top_ks, keys,
                     lora_slots=[self._lora_slot(s) for s in seqs],
+                    want_logprobs=want_lp,
+                )
+                toks_next, lps_next = (
+                    (ys[0], ys[1:]) if want_lp else (ys, None)
                 )
                 outputs = self._resolve_pending()
                 self._pending_decode = {"seqs": seqs, "toks": toks_next,
-                                        "k": k}
+                                        "k": k, "lps": lps_next}
                 self.last_step_kind = "decode"
                 return outputs
             # pipeline flush: apply the in-flight tokens before any
@@ -467,13 +506,31 @@ class LLMEngine:
                 if clean:
                     toks = np.asarray(tokens_dev)
                     for i, w in clean:
-                        self._append_token(w.seq, int(toks[i]))
+                        entry = None
+                        n = w.seq.sampling_params.logprobs
+                        if n is not None:
+                            entry = self._host_logprob_entry(
+                                np.asarray(last_logits[i]),
+                                int(toks[i]), n,
+                            )
+                        self._append_token(w.seq, int(toks[i]), entry)
                         stepped.append(w.seq)
                 if pen:
                     fl = jnp.stack([last_logits[i] for i, _ in pen])
-                    sampled = self._sample([w.seq for _, w in pen], fl)
-                    for (i, w), token in zip(pen, sampled):
-                        self._append_token(w.seq, int(token))
+                    sampled, used_logits = self._sample(
+                        [w.seq for _, w in pen], fl, return_logits=True
+                    )
+                    used_logits = np.asarray(used_logits)
+                    for j, ((i, w), token) in enumerate(
+                        zip(pen, sampled)
+                    ):
+                        entry = None
+                        n = w.seq.sampling_params.logprobs
+                        if n is not None:
+                            entry = self._host_logprob_entry(
+                                used_logits[j], int(token), n
+                            )
+                        self._append_token(w.seq, int(token), entry)
                         stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
@@ -501,25 +558,35 @@ class LLMEngine:
                         [list(s.generated_token_ids) for s in seqs],
                         pres, freq, rep,
                     )
+                want_lp = any(
+                    s.sampling_params.logprobs is not None for s in seqs
+                )
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
-                toks_dev = self.runner.decode_multi(
+                ys = self.runner.decode_multi(
                     tokens, positions, tables, ctx_lens, k_steps,
                     temps, top_ps, top_ks, keys,
                     lora_slots=[self._lora_slot(s) for s in seqs],
                     penalties=penalties,
-                )  # (k, b) on device
+                    want_logprobs=want_lp,
+                )  # (k, b) on device [+ logprob arrays]
+                toks_dev, lps_dev = (
+                    (ys[0], ys[1:]) if want_lp else (ys, None)
+                )
                 if self._async_decode and penalties is None:
                     # start the double-buffered pipeline: leave the
                     # tokens on device; the NEXT step dispatches the
                     # following round before fetching this one
                     self._pending_decode = {
                         "seqs": seqs, "toks": toks_dev, "k": k_steps,
+                        "lps": lps_dev,
                     }
                     return outputs
                 self._apply_multi_tokens(
-                    seqs, np.asarray(toks_dev), k_steps
+                    seqs, np.asarray(toks_dev), k_steps,
+                    lps=tuple(np.asarray(a) for a in lps_dev)
+                    if lps_dev else None,
                 )
                 stepped.extend(seqs)
             else:
@@ -527,10 +594,19 @@ class LLMEngine:
                     tokens, positions, tables, ctx_lens,
                     lora_slots=[self._lora_slot(s) for s in seqs],
                 )
-                sampled = self._sample(seqs, logits[: len(seqs)])
-                for seq, token in zip(seqs, sampled):
+                sampled, used_logits = self._sample(
+                    seqs, logits[: len(seqs)], return_logits=True
+                )
+                used_logits = np.asarray(used_logits)
+                for i, (seq, token) in enumerate(zip(seqs, sampled)):
                     seq.num_computed_tokens = seq.num_tokens
-                    self._append_token(seq, int(token))
+                    entry = None
+                    if seq.sampling_params.logprobs is not None:
+                        entry = self._host_logprob_entry(
+                            used_logits[i], int(token),
+                            seq.sampling_params.logprobs,
+                        )
+                    self._append_token(seq, int(token), entry)
                     stepped.append(seq)
 
         outputs.extend(self._finalize_stepped(stepped))
@@ -589,7 +665,8 @@ class LLMEngine:
             )
         return temps, top_ps, top_ks, keys, needs_penalties
 
-    def _sample(self, seqs: list[Sequence], logits) -> np.ndarray:
+    def _sample(self, seqs: list[Sequence], logits,
+                return_logits: bool = False):
         b = logits.shape[0]
         temps, top_ps, top_ks, keys, needs_penalties = (
             self._sampling_arrays(seqs, b)
@@ -597,7 +674,36 @@ class LLMEngine:
         if needs_penalties:
             logits = self._apply_penalties(seqs, np.asarray(logits))
         out = sample_tokens(logits, temps, top_ps, top_ks, keys)
-        return np.asarray(out)[: len(seqs)]
+        sampled = np.asarray(out)[: len(seqs)]
+        if return_logits:
+            # the (penalized) logits the sample came from — what
+            # logprob entries must be computed against for parity with
+            # the on-device multi-step path
+            return sampled, logits
+        return sampled
+
+    @staticmethod
+    def _host_logprob_entry(
+        logits_row: np.ndarray, token: int, n: int
+    ) -> dict:
+        """Host-side mirror of sampler.token_logprobs for the
+        single-step / prefill paths."""
+        row = np.asarray(logits_row, np.float32)
+        m = float(np.max(row))
+        row = row - (m + np.log(np.sum(np.exp(row - m))))
+        if n > 0:
+            top = np.argpartition(-row, min(n, row.shape[0] - 1))[:n]
+            top = top[np.argsort(-row[top])]
+        else:
+            top = np.array([], np.int64)
+        return {
+            "token_id": int(token),
+            "logprob": float(row[token]),
+            "top_logprobs": [
+                {"token_id": int(t), "logprob": float(row[t])}
+                for t in top
+            ],
+        }
 
     def _apply_penalties(
         self, seqs: list[Sequence], logits: np.ndarray
@@ -624,11 +730,26 @@ class LLMEngine:
             )
         )
 
-    def _append_token(self, seq: Sequence, token: int) -> None:
+    def _append_token(self, seq: Sequence, token: int,
+                      logprob_entry: dict | None = None) -> None:
         if seq.metrics.first_token_time is None:
             seq.metrics.first_token_time = time.time()
         seq.append_token(int(token))
         self._generation_tokens_total += 1
+        if seq.sampling_params.logprobs is not None:
+            entries = getattr(seq, "_logprob_entries", None)
+            if entries is None:
+                entries = []
+                seq._logprob_entries = entries  # type: ignore[attr-defined]
+            entries.append(logprob_entry or {
+                "token_id": int(token), "logprob": float("nan"),
+                "top_logprobs": [],
+            })
+            pend = getattr(seq, "_pending_lps", None)
+            if pend is None:
+                pend = []
+                seq._pending_lps = pend  # type: ignore[attr-defined]
+            pend.append(entries[-1])
         # incremental detokenization: O(1) amortised per token instead of
         # re-decoding the whole stream (engine/detokenizer.py); output is
         # bit-identical to decode(generated_token_ids)
@@ -704,6 +825,14 @@ class LLMEngine:
                 seq._emitted_chars = len(seq.output_text)  # type: ignore[attr-defined]
         seq._pending_ids = []  # type: ignore[attr-defined]
         seq._pending_delta = ""  # type: ignore[attr-defined]
+        lp_all = lp_new = None
+        if seq.sampling_params.logprobs is not None:
+            lp_new = getattr(seq, "_pending_lps", [])
+            seq._pending_lps = []  # type: ignore[attr-defined]
+            # the full list is only materialised on the final output —
+            # copying it per streamed step would be O(T^2) per request
+            if seq.finished:
+                lp_all = list(getattr(seq, "_logprob_entries", []))
         return RequestOutput(
             request_id=seq.request_id,
             prompt_token_ids=seq.prompt_token_ids[: seq.orig_prompt_len],
@@ -715,6 +844,8 @@ class LLMEngine:
             finish_reason=seq.finish_reason,
             metrics=seq.metrics,
             num_cached_tokens=seq.metrics.num_cached_prompt_tokens,
+            logprobs=lp_all,
+            new_logprobs=lp_new,
         )
 
     # -- LoRA hot-load (adapters applied in the jitted steps; engine/lora.py)

@@ -527,7 +527,8 @@ class ModelRunner:
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
     def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
-                            use_penalties: bool = False):
+                            use_penalties: bool = False,
+                            want_logprobs: bool = False):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -546,6 +547,7 @@ class ModelRunner:
         from production_stack_tpu.engine.sampler import (
             apply_penalties,
             sample_tokens,
+            token_logprobs,
         )
 
         if self.attention_impl == "pallas":
@@ -624,14 +626,20 @@ class ModelRunner:
                 nxt = sample_tokens(logits, temps, top_ps, top_ks, keys)
                 if use_penalties:
                     counts = counts.at[lane, nxt].add(1.0)
-                return (kc, vc, nxt, positions + 1, ctx + 1, counts), nxt
+                if want_logprobs:
+                    # on-device logprobs ride the same single fetch —
+                    # (k, b) chosen + (k, b, CAP) top alternatives
+                    ys = (nxt, *token_logprobs(logits, nxt))
+                else:
+                    ys = nxt
+                return (kc, vc, nxt, positions + 1, ctx + 1, counts), ys
 
-            (kc, vc, *_), toks = jax.lax.scan(
+            (kc, vc, *_), ys = jax.lax.scan(
                 one,
                 (kc, vc, tokens, positions, context_lens, counts0),
                 jnp.arange(k_steps),
             )
-            return toks, kc, vc  # toks: (k_steps, b)
+            return ys, kc, vc  # ys: (k, b) toks [+ logprob arrays]
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
@@ -947,9 +955,12 @@ class ModelRunner:
         keys: np.ndarray,       # (b_actual, 2) uint32
         lora_slots: list[int] | None = None,
         penalties: tuple | None = None,
-    ) -> jax.Array:
+        want_logprobs: bool = False,
+    ):
         """`steps` fused decode+sample iterations (one dispatch, one
-        fetch); returns (steps, b) int32 sampled tokens on device. The
+        fetch); returns (steps, b) int32 sampled tokens on device — or,
+        with `want_logprobs`, a tuple (tokens, chosen_lp (k, b) f32,
+        top_vals (k, b, CAP) f32, top_ids (k, b, CAP) i32). The
         caller must have grown each block table to cover
         context_len + steps - 1 positions (scheduler lookahead).
 
@@ -1035,14 +1046,17 @@ class ModelRunner:
                 "repetition": jnp.asarray(rep_full),
             }
 
-        cache_key = (b, c_pad, steps, penalties is not None)
+        cache_key = (b, c_pad, steps, penalties is not None,
+                     want_logprobs)
         if cache_key not in self._decode_multi_fns:
             logger.info(
-                "compiling multi-step decode b=%d ctx=%d k=%d pen=%s",
-                b, c_pad, steps, penalties is not None,
+                "compiling multi-step decode b=%d ctx=%d k=%d pen=%s "
+                "lp=%s",
+                b, c_pad, steps, penalties is not None, want_logprobs,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
+                want_logprobs=want_logprobs,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}
@@ -1054,7 +1068,7 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": jnp.asarray(slots),
             }
-        toks, self.k_cache, self.v_cache = fn(
+        ys, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -1070,7 +1084,7 @@ class ModelRunner:
             **pen_kw,
             **lora_kw,
         )
-        return toks
+        return ys
 
     # -- embeddings (stateless, /v1/embeddings) ----------------------------
     def _build_embed(self, t_pad: int, c_pad: int):

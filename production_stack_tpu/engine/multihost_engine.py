@@ -131,7 +131,8 @@ class BroadcastingRunner:
 
     def decode_multi(self, token_ids, positions, block_tables,
                      context_lens, steps, temps, top_ps, top_ks, keys,
-                     lora_slots=None, penalties=None):
+                     lora_slots=None, penalties=None,
+                     want_logprobs=False):
         msg = {
             "kind": "decode_multi",
             "token_ids": [int(t) for t in token_ids],
@@ -143,6 +144,9 @@ class BroadcastingRunner:
             "top_ps": np.asarray(top_ps).tolist(),
             "top_ks": np.asarray(top_ks).tolist(),
             "keys": np.asarray(keys, np.uint32).tolist(),
+            # followers must compile the SAME program variant as host 0
+            # (the logprobs scan has extra outputs) or SPMD desyncs
+            "want_logprobs": bool(want_logprobs),
         }
         if lora_slots is not None:
             msg["lora_slots"] = [int(s) for s in lora_slots]
@@ -158,7 +162,7 @@ class BroadcastingRunner:
         return self._runner.decode_multi(
             token_ids, positions, block_tables, context_lens, steps,
             temps, top_ps, top_ks, keys, lora_slots=lora_slots,
-            penalties=penalties,
+            penalties=penalties, want_logprobs=want_logprobs,
         )
 
     def embed(self, *a, **kw):

@@ -19,6 +19,10 @@ class RequestOutput:
     finish_reason: str | None
     metrics: RequestMetrics
     num_cached_tokens: int = 0
+    # per-token logprob entries (only when SamplingParams.logprobs set):
+    # {"token_id", "logprob", "top_logprobs": [{"token_id", "logprob"}]}
+    logprobs: list[dict] | None = None  # all tokens so far
+    new_logprobs: list[dict] | None = None  # this step (streaming)
 
 
 @dataclass

@@ -29,11 +29,19 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
         max_tokens = body.get("max_tokens", body.get("max_completion_tokens"))
         if max_tokens is None:
             max_tokens = 128
-        if int(body.get("n", 1)) != 1:
-            raise ProtocolError(
-                "n>1 (parallel sampling) is not supported yet"
-            )
+        if int(body.get("n", 1)) < 1:
+            raise ProtocolError("n must be >= 1")
+        logprobs = body.get("logprobs")
+        if isinstance(logprobs, bool):
+            # chat-style boolean: the chat handler resolves it together
+            # with top_logprobs; completions use the integer form
+            logprobs = None
+        if logprobs is not None:
+            logprobs = int(logprobs)
+            if not 0 <= logprobs <= 20:
+                raise ProtocolError("logprobs must be in [0, 20]")
         return SamplingParams(
+            logprobs=logprobs,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
@@ -91,7 +99,8 @@ def completion_response(
 
 
 def completion_chunk(
-    request_id: str, model: str, text: str, finish_reason: str | None
+    request_id: str, model: str, text: str, finish_reason: str | None,
+    index: int = 0,
 ) -> dict:
     return {
         "id": request_id,
@@ -100,7 +109,7 @@ def completion_chunk(
         "model": model,
         "choices": [
             {
-                "index": 0,
+                "index": index,
                 "text": text,
                 "logprobs": None,
                 "finish_reason": finish_reason,
@@ -110,11 +119,13 @@ def completion_chunk(
 
 
 # -- chat completions ------------------------------------------------------
-def chat_response(
-    request_id: str, model: str, text: str, finish_reason: str | None,
-    prompt_tokens: int, completion_tokens: int,
+def chat_message_choice(
+    index: int, text: str, finish_reason: str | None,
     tool_calls: list[dict] | None = None,
 ) -> dict:
+    """One chat choice dict — the ONE place the tool-call shaping and
+    the stop->tool_calls finish-reason flip live (shared by the n=1
+    response and the batch/n>1 assembly)."""
     message: dict = {"role": "assistant", "content": text}
     if tool_calls:
         message["tool_calls"] = tool_calls
@@ -125,24 +136,48 @@ def chat_response(
         if finish_reason == "stop":
             finish_reason = "tool_calls"
     return {
+        "index": index,
+        "message": message,
+        "logprobs": None,
+        "finish_reason": finish_reason,
+    }
+
+
+def chat_response(
+    request_id: str, model: str, text: str, finish_reason: str | None,
+    prompt_tokens: int, completion_tokens: int,
+    tool_calls: list[dict] | None = None,
+) -> dict:
+    return {
         "id": request_id,
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
         "choices": [
-            {
-                "index": 0,
-                "message": message,
-                "logprobs": None,
-                "finish_reason": finish_reason,
-            }
+            chat_message_choice(0, text, finish_reason, tool_calls)
         ],
         "usage": usage_dict(prompt_tokens, completion_tokens),
     }
 
 
+def usage_tail_chunk(
+    request_id: str, model: str, chat: bool,
+    prompt_tokens: int, completion_tokens: int,
+) -> dict:
+    """stream_options.include_usage: the final empty-choices chunk."""
+    tail = (
+        chat_chunk(request_id, model, {}, None)
+        if chat
+        else completion_chunk(request_id, model, "", None)
+    )
+    tail["choices"] = []
+    tail["usage"] = usage_dict(prompt_tokens, completion_tokens)
+    return tail
+
+
 def chat_chunk(
-    request_id: str, model: str, delta: dict, finish_reason: str | None
+    request_id: str, model: str, delta: dict, finish_reason: str | None,
+    index: int = 0,
 ) -> dict:
     return {
         "id": request_id,
@@ -150,8 +185,24 @@ def chat_chunk(
         "created": int(time.time()),
         "model": model,
         "choices": [
-            {"index": 0, "delta": delta, "finish_reason": finish_reason}
+            {"index": index, "delta": delta,
+             "finish_reason": finish_reason}
         ],
+    }
+
+
+def multi_choice_response(
+    request_id: str, model: str, chat: bool,
+    choices: list[dict], prompt_tokens: int, completion_tokens: int,
+) -> dict:
+    """Batch/n>1 response envelope; `choices` are pre-shaped dicts."""
+    return {
+        "id": request_id,
+        "object": "chat.completion" if chat else "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": usage_dict(prompt_tokens, completion_tokens),
     }
 
 

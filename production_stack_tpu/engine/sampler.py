@@ -81,3 +81,23 @@ def apply_penalties(
     rep = repetition[:, None]
     penalized = jnp.where(logits > 0, logits / rep, logits * rep)
     return jnp.where(output_mask, penalized, logits)
+
+
+LOGPROB_CAP = 20  # static top-N bucket; hosts slice to the requested N
+
+
+def token_logprobs(
+    logits: jax.Array,  # (b, vocab) float32 — post-penalty model logits
+    tokens: jax.Array,  # (b,) int32 chosen tokens
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-row chosen-token logprob + top-LOGPROB_CAP alternatives.
+
+    Computed from log_softmax of the raw (pre-temperature) logits — the
+    model's distribution, matching vLLM's logprobs semantics. Returns
+    (chosen (b,), top_vals (b, CAP), top_ids (b, CAP) int32)."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(
+        lp, tokens[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    top_vals, top_ids = jax.lax.top_k(lp, LOGPROB_CAP)
+    return chosen, top_vals, top_ids.astype(jnp.int32)

@@ -188,25 +188,59 @@ class EngineServer:
             return web.json_response(
                 proto.error_json("missing 'prompt'"), status=400
             )
-        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
-            prompt = prompt[0]  # single-prompt shortcut; batch via router
+        # OpenAI batch semantics: prompt may be one string, one token-id
+        # list, or a list of either (choices index prompt_idx*n+sample)
+        if isinstance(prompt, str):
+            raw_prompts: list = [prompt]
+        elif isinstance(prompt, list) and prompt and all(
+            isinstance(x, int) for x in prompt
+        ):
+            raw_prompts = [prompt]
+        elif isinstance(prompt, list) and prompt and all(
+            isinstance(x, str)
+            or (isinstance(x, list) and x
+                and all(isinstance(t, int) for t in x))
+            for x in prompt
+        ):
+            raw_prompts = list(prompt)
+        else:
+            return web.json_response(
+                proto.error_json(
+                    "'prompt' must be a string, a token-id list, or a "
+                    "non-empty list of either"
+                ),
+                status=400,
+            )
         try:
             sp = proto.sampling_params_from_request(body)
         except proto.ProtocolError as e:
             return web.json_response(proto.error_json(str(e)), status=400)
 
         request_id = proto.make_id("cmpl")
-        kwargs: dict = {}
-        if isinstance(prompt, list):
-            kwargs["prompt_token_ids"] = prompt
-        else:
-            kwargs["prompt"] = prompt
+        prompt_ids_list: list[list[int]] = []
+        for p in raw_prompts:
+            ids = (
+                list(p) if isinstance(p, list)
+                else self.engine.tokenizer.encode(p)
+            )
+            if err := self._check_context_len(ids):
+                return err
+            prompt_ids_list.append(ids)
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
 
+        if len(prompt_ids_list) * sp.n > 1:
+            return await self._multi_completion(
+                request, request_id, sp, prompt_ids_list, lora_name,
+                chat=False, model=body.get("model") or self.model_name,
+                stream=bool(body.get("stream")),
+                include_usage=self._wants_usage(body),
+            )
+        kwargs = {"prompt_token_ids": prompt_ids_list[0]}
         if body.get("stream"):
             return await self._stream_completion(
-                request, request_id, sp, kwargs, lora_name, chat=False
+                request, request_id, sp, kwargs, lora_name, chat=False,
+                include_usage=self._wants_usage(body),
             )
         return await self._blocking_completion(
             request_id, sp, kwargs, lora_name, chat=False,
@@ -246,6 +280,16 @@ class EngineServer:
                 )
             prompt = self.engine.tokenizer.apply_chat_template(messages)
             sp = proto.sampling_params_from_request(body)
+            if body.get("logprobs") is True:
+                # chat form: logprobs: true + top_logprobs: N
+                import dataclasses
+
+                top_n = int(body.get("top_logprobs", 0) or 0)
+                if not 0 <= top_n <= 20:
+                    raise proto.ProtocolError(
+                        "top_logprobs must be in [0, 20]"
+                    )
+                sp = dataclasses.replace(sp, logprobs=top_n)
         except (proto.ProtocolError, ValueError) as e:
             return web.json_response(proto.error_json(str(e)), status=400)
         except Exception as e:
@@ -254,22 +298,138 @@ class EngineServer:
             )
 
         request_id = proto.make_id("chatcmpl")
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+        if err := self._check_context_len(prompt_ids):
+            return err
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
+        if sp.n > 1:
+            return await self._multi_completion(
+                request, request_id, sp, [prompt_ids], lora_name,
+                chat=True, model=body.get("model") or self.model_name,
+                stream=bool(body.get("stream")),
+                include_usage=self._wants_usage(body),
+                parse_tools=use_tools,
+            )
         if body.get("stream"):
             # streamed responses pass tool-call text through verbatim
             # (parsing happens client-side); blocking mode parses
             return await self._stream_completion(
-                request, request_id, sp, {"prompt": prompt}, lora_name,
-                chat=True,
+                request, request_id, sp, {"prompt_token_ids": prompt_ids},
+                lora_name, chat=True,
+                include_usage=self._wants_usage(body),
             )
         return await self._blocking_completion(
-            request_id, sp, {"prompt": prompt}, lora_name, chat=True,
+            request_id, sp, {"prompt_token_ids": prompt_ids}, lora_name,
+            chat=True,
             model=body.get("model") or self.model_name,
             parse_tools=use_tools,
         )
 
     # -- shared generation paths ------------------------------------------
+    def _check_context_len(self, prompt_ids: list[int]) -> web.Response | None:
+        """Reject prompts the KV layout cannot hold with a 400 up front
+        (vLLM parity: 'maximum context length' errors) instead of
+        admitting the request and streaming an abort."""
+        limit = self.config.resolved_max_model_len()
+        if len(prompt_ids) >= limit:
+            return web.json_response(
+                proto.error_json(
+                    f"This model's maximum context length is {limit} "
+                    f"tokens. However, your request has "
+                    f"{len(prompt_ids)} prompt tokens; please reduce "
+                    "the length of the messages or prompt.",
+                    "context_length_exceeded",
+                ),
+                status=400,
+            )
+        return None
+
+    @staticmethod
+    def _wants_usage(body: dict) -> bool:
+        opts = body.get("stream_options")
+        return bool(isinstance(opts, dict) and opts.get("include_usage"))
+
+    # -- logprobs formatting (OpenAI wire shapes) --------------------------
+    def _tok_str(self, token_id: int) -> str:
+        return self.engine.tokenizer.decode([token_id])
+
+    def _fmt_completion_logprobs(
+        self, entries: list[dict] | None, start: int = 0
+    ) -> dict | None:
+        """Completions shape: tokens / token_logprobs / top_logprobs.
+        `start` seeds text_offset — streamed chunks pass the length of
+        text already emitted so offsets index the full completion."""
+        if entries is None:
+            return None
+        tokens, lps, tops, offsets = [], [], [], []
+        pos = start
+        for e in entries:
+            s = self._tok_str(e["token_id"])
+            tokens.append(s)
+            lps.append(e["logprob"])
+            tops.append({
+                self._tok_str(t["token_id"]): t["logprob"]
+                for t in e["top_logprobs"]
+            })
+            offsets.append(pos)
+            pos += len(s)
+        return {"tokens": tokens, "token_logprobs": lps,
+                "top_logprobs": tops, "text_offset": offsets}
+
+    def _fmt_chat_logprobs(
+        self, entries: list[dict] | None
+    ) -> dict | None:
+        """Chat shape: {"content": [{token, logprob, bytes,
+        top_logprobs: [...]}]}."""
+        if entries is None:
+            return None
+
+        def one(token_id: int, lp: float) -> dict:
+            s = self._tok_str(token_id)
+            return {"token": s, "logprob": lp,
+                    "bytes": list(s.encode("utf-8", "replace"))}
+
+        return {"content": [
+            {**one(e["token_id"], e["logprob"]),
+             "top_logprobs": [one(t["token_id"], t["logprob"])
+                              for t in e["top_logprobs"]]}
+            for e in entries
+        ]}
+
+    def _stream_chunk(
+        self, request_id: str, model: str, chat: bool, text: str,
+        new_lps: list[dict] | None, index: int, lp_start: int,
+    ) -> tuple[dict, int]:
+        """One streamed content chunk (chat or completions) with its
+        logprobs attached — the single copy of the chunk wire shape the
+        single-choice and multi-choice streams share. Returns
+        (chunk, next text_offset seed)."""
+        chunk = (
+            proto.chat_chunk(
+                request_id, model, {"content": text}, None, index=index
+            )
+            if chat
+            else proto.completion_chunk(
+                request_id, model, text, None, index=index
+            )
+        )
+        if new_lps:
+            if chat:
+                chunk["choices"][0]["logprobs"] = (
+                    self._fmt_chat_logprobs(new_lps)
+                )
+            else:
+                fmt = self._fmt_completion_logprobs(
+                    new_lps, start=lp_start
+                )
+                chunk["choices"][0]["logprobs"] = fmt
+                if fmt["tokens"]:
+                    lp_start = (
+                        fmt["text_offset"][-1] + len(fmt["tokens"][-1])
+                    )
+        return chunk, lp_start
+
     async def _blocking_completion(
         self, request_id: str, sp: SamplingParams, kwargs: dict,
         lora_name: str | None, chat: bool, model: str,
@@ -296,19 +456,213 @@ class EngineServer:
             text, tool_calls = final.text, None
             if parse_tools:
                 text, tool_calls = tools.parse_tool_calls(final.text)
-            return web.json_response(proto.chat_response(
+            resp = proto.chat_response(
                 request_id, model, text, final.finish_reason,
                 len(final.prompt_token_ids), len(final.token_ids),
                 tool_calls=tool_calls,
-            ))
-        return web.json_response(proto.completion_response(
+            )
+            resp["choices"][0]["logprobs"] = self._fmt_chat_logprobs(
+                final.logprobs
+            )
+            return web.json_response(resp)
+        resp = proto.completion_response(
             request_id, model, final.text, final.finish_reason,
             len(final.prompt_token_ids), len(final.token_ids),
-        ))
+        )
+        resp["choices"][0]["logprobs"] = self._fmt_completion_logprobs(
+            final.logprobs
+        )
+        return web.json_response(resp)
+
+    async def _multi_completion(
+        self, request: web.Request, request_id: str, sp: SamplingParams,
+        prompt_ids_list: list[list[int]], lora_name: str | None,
+        chat: bool, model: str, stream: bool,
+        include_usage: bool = False, parse_tools: bool = False,
+    ) -> web.StreamResponse:
+        """Batch prompts and/or n>1 sampling: fan the choices out as
+        engine sub-requests (continuous batching coalesces them on
+        device) and assemble index-ordered choices. Choice index =
+        prompt_idx * n + sample_idx (OpenAI/vLLM contract); an explicit
+        seed derives per-sample seeds so samples differ but reproduce."""
+        import dataclasses
+
+        arrival = time.time()
+        n = sp.n
+        plan: list[tuple[int, SamplingParams, list[int]]] = []
+        for pi, ids in enumerate(prompt_ids_list):
+            for j in range(n):
+                sp_j = sp
+                if n > 1 and sp.seed is not None:
+                    sp_j = dataclasses.replace(sp, seed=sp.seed + j)
+                plan.append((pi * n + j, sp_j, ids))
+
+        async def run_one(idx: int, sp_i: SamplingParams,
+                          ids: list[int]):
+            final = None
+            async for out in self.engine.generate(
+                f"{request_id}-c{idx}", sampling_params=sp_i,
+                lora_name=lora_name, prompt_token_ids=ids,
+            ):
+                final = out
+            return final
+
+        if not stream:
+            sub_tasks = [asyncio.ensure_future(run_one(i, s, ids))
+                         for i, s, ids in plan]
+            try:
+                finals = await asyncio.gather(*sub_tasks)
+            except BaseException as e:  # noqa: BLE001 — see below
+                # ANY failure (or cancellation) must cancel the
+                # siblings: their generate() finalizers abort the
+                # engine-side requests, so no orphaned generation keeps
+                # burning decode steps after the error response
+                for t in sub_tasks:
+                    if not t.done():
+                        t.cancel()
+                await asyncio.gather(*sub_tasks, return_exceptions=True)
+                if isinstance(e, EngineSleepingError):
+                    return web.json_response(
+                        proto.error_json("engine is sleeping",
+                                         "service_unavailable", 503),
+                        status=503,
+                    )
+                if isinstance(e, ValueError):
+                    return web.json_response(
+                        proto.error_json(str(e)), status=400
+                    )
+                if isinstance(e, (asyncio.CancelledError, KeyboardInterrupt,
+                                  SystemExit)):
+                    raise
+                logger.exception("multi-completion failed: %s", e)
+                return web.json_response(
+                    proto.error_json(f"internal error: {e}",
+                                     "internal_error", 500),
+                    status=500,
+                )
+            choices = []
+            for (idx, _, _), final in zip(plan, finals):
+                self._observe_finish(final, arrival)
+                if chat:
+                    text, tool_calls = final.text, None
+                    if parse_tools:
+                        text, tool_calls = tools.parse_tool_calls(
+                            final.text
+                        )
+                    choice = proto.chat_message_choice(
+                        idx, text, final.finish_reason, tool_calls
+                    )
+                    choice["logprobs"] = self._fmt_chat_logprobs(
+                        final.logprobs
+                    )
+                    choices.append(choice)
+                else:
+                    choices.append({
+                        "index": idx, "text": final.text,
+                        "logprobs": self._fmt_completion_logprobs(
+                            final.logprobs
+                        ),
+                        "finish_reason": final.finish_reason,
+                    })
+            return web.json_response(proto.multi_choice_response(
+                request_id, model, chat, choices,
+                sum(len(ids) for ids in prompt_ids_list),
+                sum(len(f.token_ids) for f in finals),
+            ))
+
+        # streamed: interleave per-choice chunks tagged with their index
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+
+        async def send(data: dict) -> None:
+            await resp.write(
+                b"data: " + json.dumps(data).encode() + b"\n\n"
+            )
+
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def pump(idx: int, sp_i: SamplingParams, ids: list[int]):
+            try:
+                final = None
+                async for out in self.engine.generate(
+                    f"{request_id}-c{idx}", sampling_params=sp_i,
+                    lora_name=lora_name, prompt_token_ids=ids,
+                ):
+                    final = out
+                    if out.delta_text or out.new_logprobs:
+                        await queue.put((
+                            "delta", idx,
+                            (out.delta_text, out.new_logprobs),
+                        ))
+                await queue.put(("finish", idx, final))
+            except Exception as e:  # noqa: BLE001 — surfaced as a chunk
+                await queue.put(("error", idx, e))
+
+        tasks = [asyncio.ensure_future(pump(i, s, ids))
+                 for i, s, ids in plan]
+        completion_tokens = 0
+        lp_pos: dict[int, int] = {}  # per-choice text_offset seeds
+        try:
+            if chat:
+                for idx, _, _ in plan:
+                    await send(proto.chat_chunk(
+                        request_id, model, {"role": "assistant"}, None,
+                        index=idx,
+                    ))
+            remaining = len(plan)
+            while remaining:
+                kind, idx, payload = await queue.get()
+                if kind == "delta":
+                    text, new_lps = payload
+                    chunk, lp_pos[idx] = self._stream_chunk(
+                        request_id, model, chat, text, new_lps, idx,
+                        lp_pos.get(idx, 0),
+                    )
+                    await send(chunk)
+                elif kind == "finish":
+                    remaining -= 1
+                    if payload is not None:
+                        self._observe_finish(payload, arrival)
+                        completion_tokens += len(payload.token_ids)
+                        await send(
+                            proto.chat_chunk(
+                                request_id, model, {},
+                                payload.finish_reason, index=idx,
+                            )
+                            if chat
+                            else proto.completion_chunk(
+                                request_id, model, "",
+                                payload.finish_reason, index=idx,
+                            )
+                        )
+                else:  # error
+                    remaining -= 1
+                    await send(proto.error_json(str(payload)))
+            if include_usage:
+                await send(proto.usage_tail_chunk(
+                    request_id, model, chat,
+                    sum(len(ids) for ids in prompt_ids_list),
+                    completion_tokens,
+                ))
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("client disconnected from %s", request_id)
+            for t in tasks:
+                t.cancel()
+        await resp.write_eof()
+        return resp
 
     async def _stream_completion(
         self, request: web.Request, request_id: str, sp: SamplingParams,
         kwargs: dict, lora_name: str | None, chat: bool,
+        include_usage: bool = False,
     ) -> web.StreamResponse:
         arrival = time.time()
         model = self.model_name
@@ -335,24 +689,17 @@ class EngineServer:
                     )
                 )
             final = None
+            lp_pos = 0
             async for out in self.engine.generate(
                 request_id, sampling_params=sp, lora_name=lora_name, **kwargs
             ):
                 final = out
-                if out.delta_text:
-                    if chat:
-                        await send(
-                            proto.chat_chunk(
-                                request_id, model,
-                                {"content": out.delta_text}, None,
-                            )
-                        )
-                    else:
-                        await send(
-                            proto.completion_chunk(
-                                request_id, model, out.delta_text, None
-                            )
-                        )
+                if out.delta_text or out.new_logprobs:
+                    chunk, lp_pos = self._stream_chunk(
+                        request_id, model, chat, out.delta_text,
+                        out.new_logprobs, 0, lp_pos,
+                    )
+                    await send(chunk)
             if final is not None:
                 self._observe_finish(final, arrival)
                 if chat:
@@ -367,6 +714,14 @@ class EngineServer:
                             request_id, model, "", final.finish_reason
                         )
                     )
+                if include_usage:
+                    # OpenAI stream_options.include_usage contract: one
+                    # final chunk with empty choices + the usage totals
+                    await send(proto.usage_tail_chunk(
+                        request_id, model, chat,
+                        len(final.prompt_token_ids),
+                        len(final.token_ids),
+                    ))
             await resp.write(b"data: [DONE]\n\n")
         except EngineSleepingError:
             await resp.write(

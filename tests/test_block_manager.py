@@ -49,7 +49,12 @@ def test_prefix_cache_hit_and_refcount():
 
 
 def test_prefix_cache_caps_at_len_minus_one():
-    """A fully cached prompt must still compute >=1 token for logits."""
+    """A fully cached prompt must still compute >=1 token for logits,
+    and the cached count must sit on the ADOPTED block boundary — the
+    engine prefills from position `cached`, so every earlier position's
+    KV must actually be in the table (claiming 7 with one 4-token block
+    adopted made the engine skip computing tokens 4-6: corrupt logits,
+    fixed round 4)."""
     m = make_mgr(num_blocks=20)
     prompt = list(range(8))  # exactly 2 blocks
     t1, _ = m.allocate_prompt(prompt)
@@ -57,7 +62,7 @@ def test_prefix_cache_caps_at_len_minus_one():
     for i in range(2):
         prev = m.register_block(prev, tuple(prompt[i * 4 : (i + 1) * 4]), t1[i])
     t2, cached = m.allocate_prompt(prompt)
-    assert cached == 7  # capped at len-1 -> only 1 full block reused
+    assert cached == 4  # len-1 cap, floored to the 1 reusable block
     assert t2[0] == t1[0]
     assert t2[1] != t1[1]
 
@@ -102,3 +107,27 @@ def test_hit_counters():
     m.allocate_prompt(p + [1, 2, 3, 4])
     assert m.prefix_queries == 8 + 12
     assert m.prefix_hits == 8
+
+
+def test_fully_cached_prompt_refloors_to_block_boundary():
+    """A prompt whose length is an exact block multiple and whose blocks
+    are ALL cached must report cached_tokens on the adopted block
+    boundary — the n-1 cap alone would claim 1 extra cached token whose
+    block was never adopted, making the engine skip computing KV that
+    does not exist (round-4 regression: corrupt first token on repeat
+    requests)."""
+    bm = BlockManager(num_blocks=16, block_size=4)
+    ids = list(range(1, 13))  # 12 tokens = 3 full blocks
+    table, cached = bm.allocate_prompt(ids)
+    assert cached == 0
+    # register all 3 full blocks as if prefill completed
+    prev = 0
+    for i in range(3):
+        prev = bm.register_block(prev, tuple(ids[i * 4:(i + 1) * 4]),
+                                 table[i])
+    bm.free(table)
+    table2, cached2 = bm.allocate_prompt(ids)
+    # capped at n-1=11, then floored to the 2 adopted blocks = 8
+    assert cached2 == 8
+    assert table2[:2] == table[:2]      # shared cached blocks
+    assert table2[2] != table[2] or bm.blocks[table2[2]].ref_count >= 1

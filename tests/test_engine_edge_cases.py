@@ -227,3 +227,26 @@ def test_decode_not_starved_by_long_prefill():
     assert gaps and max(gaps) <= 1, gaps
     # and the bulk prompt finished (prefill made progress too)
     assert engine._seqs.get("bulk") is None
+
+
+def test_repeat_prompt_prefix_cache_exact_match():
+    """Round-4 regression: repeating an identical prompt whose length is
+    an exact block multiple (fully cached) must generate the SAME greedy
+    tokens — the n-1 cached cap must never claim tokens whose KV blocks
+    were not adopted (that skipped computing 3 positions and produced
+    corrupt first-token logits)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    eng = LLMEngine(EngineConfig(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=4, num_kv_blocks=128,
+        max_num_seqs=4, max_prefill_chunk=32, seed=0,
+    ))
+    sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompt = list(range(1, 13))  # 12 tokens = exact 3-block multiple
+    first = eng.generate([prompt], sp)[0]
+    second = eng.generate([prompt], sp)[0]
+    assert second.num_cached_tokens == 8  # floored to adopted blocks
+    assert second.token_ids == first.token_ids

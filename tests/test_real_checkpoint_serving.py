@@ -146,3 +146,79 @@ def test_loaded_weights_not_random(ckpt):
     )
     eng2 = LLMEngine(engine_config(ckpt), params=params)
     assert eng2.generate(["hello world"], sp)[0].token_ids == out
+
+
+def test_context_length_exceeded_is_400(ckpt):
+    """Prompts the KV layout cannot hold must be rejected up front with
+    an OpenAI-style context_length_exceeded 400 (vLLM parity), not
+    admitted and then 200-streamed as finish_reason 'abort'."""
+    from production_stack_tpu.engine.server import EngineServer
+
+    async def scenario():
+        srv = EngineServer(engine_config(ckpt, max_model_len=64))
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            big = "over " * 400
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": big}],
+                "max_tokens": 4,
+            })
+            assert r.status == 400
+            err = (await r.json())["error"]
+            assert err["type"] == "context_length_exceeded"
+            assert "maximum context length is 64" in err["message"]
+            # streamed requests get the same early rejection
+            r = await client.post("/v1/completions", json={
+                "prompt": big, "max_tokens": 4, "stream": True,
+            })
+            assert r.status == 400
+            # a fitting request still serves
+            r = await client.post("/v1/completions", json={
+                "prompt": "ok", "max_tokens": 4,
+            })
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_stream_options_include_usage(ckpt):
+    """stream_options.include_usage must produce a final empty-choices
+    chunk carrying the usage totals (OpenAI/vLLM stream contract)."""
+    from production_stack_tpu.engine.server import EngineServer
+
+    async def scenario():
+        srv = EngineServer(engine_config(ckpt))
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4, "temperature": 0, "stream": True,
+                "stream_options": {"include_usage": True},
+            })
+            assert r.status == 200
+            body = await r.text()
+            chunks = [json.loads(ln[6:]) for ln in body.splitlines()
+                      if ln.startswith("data: ") and ln != "data: [DONE]"]
+            usage_chunks = [c for c in chunks if c.get("usage")]
+            assert len(usage_chunks) == 1
+            u = usage_chunks[0]
+            assert u["choices"] == []
+            assert u["usage"]["completion_tokens"] == 4
+            assert u["usage"]["prompt_tokens"] > 0
+            # without the option no usage chunk appears
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 4, "temperature": 0, "stream": True,
+            })
+            body = await r.text()
+            chunks = [json.loads(ln[6:]) for ln in body.splitlines()
+                      if ln.startswith("data: ") and ln != "data: [DONE]"]
+            assert not any(c.get("usage") for c in chunks)
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -137,6 +137,8 @@ class _FakeOut:
         self.prompt_token_ids = [1, 2, 3]
         self.token_ids = [4, 5]
         self.metrics = None
+        self.logprobs = None
+        self.new_logprobs = None
 
 
 def _make_server(canned_text, finish_reason="stop", **cfg_kw):
@@ -154,6 +156,12 @@ def _make_server(canned_text, finish_reason="stop", **cfg_kw):
     class _Tok:
         def apply_chat_template(self, messages):
             return "".join(m["content"] for m in messages)
+
+        def encode(self, text):
+            # the server pre-tokenizes prompts for the context-length
+            # check before dispatching to the engine; word-level keeps
+            # the tool-injected system prompts inside the tiny context
+            return text.split() or [0]
 
     class _Eng:
         tokenizer = _Tok()
