@@ -1,7 +1,8 @@
 """Model architecture configs for the Llama-class decoder family.
 
-One config dataclass covers Llama 2/3, Mistral, Qwen2 (qkv bias), and TinyLlama
-variants — the family the reference stack's tutorials deploy (Llama-3.1-8B in
+One config dataclass covers Llama 2/3, Mistral, Qwen2 (qkv bias), Mixtral
+(MoE), Phi-3 (fused qkv/gate_up), Gemma (GeGLU + zero-centered norms +
+scaled embeddings), and TinyLlama variants — the family the reference stack's tutorials deploy (Llama-3.1-8B in
 reference: tutorials/08-benchmark-multi-round-qa-multi-gpu.md, opt-125m-sized
 configs for CI-scale tests).
 
@@ -33,6 +34,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention bias
+    # family knobs beyond the Llama defaults:
+    hidden_act: str = "silu"  # "gelu_tanh" for the Gemma family
+    norm_weight_offset: float = 0.0  # Gemma stores RMSNorm w zero-centered
+    embed_scale: float = 1.0  # Gemma scales embeddings by sqrt(hidden)
     # MoE (Mixtral family): 0 experts = dense MLP. capacity_factor 0
     # selects the exact all-experts einsum path; > 0 the GShard
     # static-capacity dispatch (ops/moe.py)
@@ -233,10 +238,16 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         "MistralForCausalLM",
         "Qwen2ForCausalLM",
         "MixtralForCausalLM",
+        "Phi3ForCausalLM",
+        "GemmaForCausalLM",
     ):
         raise ValueError(f"unsupported architecture {arch!r} at {path}")
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    gemma = arch == "GemmaForCausalLM"
+    act = hf.get("hidden_act") or hf.get("hidden_activation") or "silu"
+    if act in ("gelu_pytorch_tanh", "gelu_new", "gelu"):
+        act = "gelu_tanh"
     return ModelConfig(
         name=name or os.path.basename(os.path.normpath(path)),
         vocab_size=hf["vocab_size"],
@@ -249,8 +260,13 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         max_model_len=hf.get("max_position_embeddings", 8192),
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        tie_word_embeddings=(
+            True if gemma else hf.get("tie_word_embeddings", False)
+        ),
         qkv_bias=(arch == "Qwen2ForCausalLM"),
+        hidden_act=act if gemma else "silu",
+        norm_weight_offset=1.0 if gemma else 0.0,
+        embed_scale=float(hf["hidden_size"]) ** 0.5 if gemma else 1.0,
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
     )

@@ -11,7 +11,7 @@ Design (TPU-first, not a torch translation):
   Pallas kernel can be swapped in without touching model code
 - everything is shape-static; bucketing happens in the model runner
 
-Covers Llama 2/3/3.x, Mistral, Qwen2 (qkv_bias), TinyLlama.
+Covers Llama 2/3/3.x, Mistral, Qwen2 (qkv_bias), Mixtral, Phi-3, Gemma, TinyLlama.
 """
 
 from __future__ import annotations
@@ -114,6 +114,10 @@ def forward(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     h = params["embed"][token_ids].astype(dtype)
+    if cfg.embed_scale != 1.0:
+        # Gemma normalizer: hidden states enter the stack scaled by
+        # sqrt(hidden_size)
+        h = (h.astype(jnp.float32) * cfg.embed_scale).astype(dtype)
 
     use_lora = lora is not None
     if use_lora:
@@ -161,7 +165,8 @@ def forward(
                 out = out + delta * lora_scaling
             return out
 
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
+                     cfg.norm_weight_offset)
         q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
         k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
         v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
@@ -188,7 +193,8 @@ def forward(
             attn_out.reshape(n, cfg.q_size).astype(dtype), "wo", None
         ).astype(dtype)
 
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps,
+                     cfg.norm_weight_offset)
         if cfg.is_moe:
             h = h + moe_block(
                 x, lp["moe_gate"], lp["w_gate"], lp["w_up"],
@@ -196,7 +202,8 @@ def forward(
                 cfg.moe_capacity_factor,
             ).astype(dtype)
         else:
-            h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+            h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"],
+                           act=cfg.hidden_act)
         return (h, kc, vc), None
 
     xs = (
@@ -208,7 +215,8 @@ def forward(
         layer, (h, k_cache, v_cache), xs
     )
 
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps,
+                 cfg.norm_weight_offset)
     h_sel = h[logits_rows]  # (r, hidden)
     if return_hidden:
         return h_sel.astype(jnp.float32), k_cache, v_cache

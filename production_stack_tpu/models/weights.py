@@ -178,6 +178,25 @@ def load_hf_weights(
                     )
                     n_loaded += 1
             continue
+        # Phi-3 fuses attention and MLP inputs into single matrices;
+        # split the rows back out to the Llama-layout params
+        if suffix == "self_attn.qkv_proj.weight":
+            arr = np.asarray(tensor, np.float32)
+            q, k, v = np.split(
+                arr, [cfg.q_size, cfg.q_size + cfg.kv_size], axis=0
+            )
+            layers["wq"][int(idx)] = q.T.astype(np_dtype)
+            layers["wk"][int(idx)] = k.T.astype(np_dtype)
+            layers["wv"][int(idx)] = v.T.astype(np_dtype)
+            n_loaded += 3
+            continue
+        if suffix == "mlp.gate_up_proj.weight":
+            arr = np.asarray(tensor, np.float32)
+            gate, up = np.split(arr, 2, axis=0)
+            layers["w_gate"][int(idx)] = gate.T.astype(np_dtype)
+            layers["w_up"][int(idx)] = up.T.astype(np_dtype)
+            n_loaded += 2
+            continue
         mapping = per_layer.get(suffix)
         if mapping is None:
             continue

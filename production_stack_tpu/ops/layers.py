@@ -11,12 +11,16 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm with float32 accumulation, cast back to input dtype."""
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             offset: float = 0.0) -> jax.Array:
+    """RMSNorm with float32 accumulation, cast back to input dtype.
+
+    `offset` supports zero-centered norm weights (Gemma stores w - 1 and
+    the model multiplies by 1 + w)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+    return (normed * (weight.astype(jnp.float32) + offset)).astype(x.dtype)
 
 
 def rope_cos_sin(
@@ -66,9 +70,16 @@ def apply_rope(
 
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
-           w_down: jax.Array) -> jax.Array:
-    """SwiGLU MLP: (silu(x @ w_gate) * (x @ w_up)) @ w_down."""
-    gate = jax.nn.silu(jnp.dot(x, w_gate, preferred_element_type=jnp.float32))
+           w_down: jax.Array, act: str = "silu") -> jax.Array:
+    """Gated MLP: (act(x @ w_gate) * (x @ w_up)) @ w_down.
+
+    act: "silu" (Llama/Mistral/Qwen SwiGLU) or "gelu_tanh" (Gemma
+    GeGLU)."""
+    pre = jnp.dot(x, w_gate, preferred_element_type=jnp.float32)
+    if act == "gelu_tanh":
+        gate = jax.nn.gelu(pre, approximate=True)
+    else:
+        gate = jax.nn.silu(pre)
     up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
     return jnp.dot(
         (gate * up).astype(x.dtype), w_down,

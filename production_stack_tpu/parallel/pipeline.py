@@ -180,7 +180,10 @@ def _pp_prefill(cfg, S, M, mesh, params, tokens, *, chunk):
         nkv, d = cfg.num_kv_heads, cfg.head_dim
         scale = cfg.head_dim**-0.5
 
-        h0 = embed[tokens].astype(dtype).reshape(M, chunk, -1)
+        h0 = embed[tokens].astype(dtype)
+        if cfg.embed_scale != 1.0:  # Gemma normalizer
+            h0 = (h0.astype(jnp.float32) * cfg.embed_scale).astype(dtype)
+        h0 = h0.reshape(M, chunk, -1)
         positions = jnp.arange(T_pad, dtype=jnp.int32).reshape(M, chunk)
 
         # initial carries are constants (replicated-typed); the loop body
@@ -201,7 +204,8 @@ def _pp_prefill(cfg, S, M, mesh, params, tokens, *, chunk):
             def layer(carry, xs):
                 h, kc, vc = carry
                 lp, l = xs
-                x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+                x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
+                             cfg.norm_weight_offset)
                 q = jnp.dot(x, lp["wq"],
                             preferred_element_type=jnp.float32)
                 k = jnp.dot(x, lp["wk"],
@@ -233,8 +237,10 @@ def _pp_prefill(cfg, S, M, mesh, params, tokens, *, chunk):
                     attn.reshape(chunk, cfg.q_size).astype(dtype),
                     lp["wo"], preferred_element_type=jnp.float32,
                 ).astype(dtype)
-                x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-                h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+                x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps,
+                             cfg.norm_weight_offset)
+                h = h + swiglu(x, lp["w_gate"], lp["w_up"],
+                               lp["w_down"], act=cfg.hidden_act)
                 return (h, kc, vc), None
 
             (h, kc, vc), _ = jax.lax.scan(
@@ -300,6 +306,7 @@ def _pp_prefill(cfg, S, M, mesh, params, tokens, *, chunk):
     h = rms_norm(
         hidden.reshape(T_pad, cfg.hidden_size),
         params["final_norm"], cfg.rms_norm_eps,
+        cfg.norm_weight_offset,
     )
     lm_head = (
         params["embed"].T
