@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-scheduler-steps", type=int, default=1,
                    help="fused decode+sample iterations per dispatch "
                         "(on-device sampling; amortises host RTT)")
+    p.add_argument("--num-speculative-tokens", type=int, default=0,
+                   help="ngram prompt-lookup speculative decoding: "
+                        "draft up to this many tokens and verify them "
+                        "in one forward (greedy batch-1 decode; 0=off)")
+    p.add_argument("--ngram-prompt-lookup-max", type=int, default=3)
+    p.add_argument("--ngram-prompt-lookup-min", type=int, default=1)
     p.add_argument("--async-decode", action="store_true", default=True,
                    help="double-buffered decode: dispatch round N+1 on "
                         "round N's on-device tokens before fetching it")
@@ -124,6 +130,9 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         decode_interleave=args.decode_interleave,
         num_scheduler_steps=args.num_scheduler_steps,
         async_decode=args.async_decode,
+        num_speculative_tokens=args.num_speculative_tokens,
+        ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
+        ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
         multihost=args.multihost,

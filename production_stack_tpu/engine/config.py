@@ -50,6 +50,17 @@ class EngineConfig:
     # bit-identical). Ignored under multihost (followers replay host
     # token lists).
     async_decode: bool = True
+    # speculative decoding (vLLM --speculative-config ngram role):
+    # propose up to this many draft tokens by prompt-lookup (the last
+    # n-gram's previous continuation in the context) and verify them in
+    # ONE prefill-shaped forward — each fully-accepted verify replaces
+    # up to K sequential decode dispatches. Greedy-only (temperature 0,
+    # no penalties/logprobs) and engages at decode batch 1, where the
+    # per-step RTT dominates; everything else falls back to the normal
+    # decode path with identical outputs. 0 = off.
+    num_speculative_tokens: int = 0
+    ngram_prompt_lookup_max: int = 3
+    ngram_prompt_lookup_min: int = 1
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1

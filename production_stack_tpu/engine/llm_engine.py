@@ -534,6 +534,15 @@ class LLMEngine:
                         stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
+            if (
+                self.config.num_speculative_tokens > 0
+                and len(seqs) == 1
+            ):
+                spec = self._try_spec_decode(seqs[0])
+                if spec is not None:
+                    stepped.extend(spec)
+                    outputs.extend(self._finalize_stepped(stepped))
+                    return outputs
             tokens = [s.all_token_ids[-1] for s in seqs]
             positions = [s.num_tokens - 1 for s in seqs]
             tables = [s.block_table for s in seqs]
@@ -611,6 +620,85 @@ class LLMEngine:
 
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
+
+    # -- speculative decoding (prompt-lookup n-gram drafts) ----------------
+    def _ngram_drafts(self, seq: Sequence, k: int) -> list[int]:
+        """Draft tokens from the LAST previous occurrence of the
+        context's trailing n-gram (vLLM's ngram prompt-lookup role): no
+        draft model, pure host-side memory of the sequence itself —
+        strongest on repetitive/structured text."""
+        context = seq.all_token_ids
+        arr = np.asarray(context, np.int32)
+        cfg = self.config
+        for n in range(cfg.ngram_prompt_lookup_max,
+                       cfg.ngram_prompt_lookup_min - 1, -1):
+            if len(arr) <= n:
+                continue
+            pattern = arr[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(arr, n)
+            matches = np.nonzero((win == pattern).all(axis=1))[0]
+            matches = matches[matches + n < len(arr)]  # need continuation
+            if len(matches):
+                i = int(matches[-1])
+                return [int(t) for t in context[i + n: i + n + k]]
+        return []
+
+    def _try_spec_decode(self, seq: Sequence) -> list[Sequence] | None:
+        """One speculative round for a lone decode lane; returns the
+        stepped list, or None to fall back to the normal decode path.
+        Outputs are bit-identical to plain greedy decode: every accepted
+        draft equals the argmax the verify forward computed for its
+        position, exactly what sequential steps would have sampled."""
+        sp = seq.sampling_params
+        if (
+            sp.temperature != 0.0
+            or sp.logprobs is not None
+            or sp.presence_penalty != 0.0
+            or sp.frequency_penalty != 0.0
+            or sp.repetition_penalty != 1.0
+        ):
+            return None
+        k = self.config.num_speculative_tokens
+        n0 = seq.num_tokens
+        # drafts must fit the KV layout and the generation budget
+        k = min(
+            k,
+            self.scheduler.config.max_model_len - n0,
+            sp.max_tokens - len(seq.generated_token_ids) - 1,
+        )
+        if k <= 0:
+            return None
+        drafts = self._ngram_drafts(seq, k)
+        if not drafts:
+            return None
+        if not self.block_manager.ensure_capacity(
+            n0 + len(drafts), seq.block_table
+        ):
+            return None  # needs preemption: let schedule() handle it
+        tokens = [seq.all_token_ids[-1]] + drafts
+        greedy = self.runner.greedy_verify(
+            tokens,
+            start_pos=n0 - 1,
+            block_table=seq.block_table,
+            total_len=n0 - 1 + len(tokens),
+            lora_slot=self._lora_slot(seq),
+        )
+        accepted = 0
+        for i, d in enumerate(drafts):
+            if int(greedy[i]) == d:
+                accepted += 1
+            else:
+                break
+        # accepted drafts + the verify forward's own next token (the
+        # correction on mismatch, the bonus token on full acceptance)
+        new_tokens = drafts[:accepted] + [int(greedy[accepted])]
+        for t in new_tokens:
+            if seq.finished:
+                break  # EOS/stop fired mid-acceptance; drop the rest
+            seq.num_computed_tokens = seq.num_tokens
+            self._append_token(seq, int(t))
+        self.last_step_kind = "decode"
+        return [seq]
 
     def _finalize_stepped(
         self, stepped: list[Sequence]

@@ -191,6 +191,7 @@ class ModelRunner:
 
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
+        self._verify_fns: dict[tuple[int, int], object] = {}
         self._prefill_batch_fns: dict[tuple[int, int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
         self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
@@ -384,6 +385,118 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2),
                        **self._step_jit_kwargs(2))
+
+    def _build_verify(self, t_pad: int, c_pad: int):
+        """Speculative-decoding verification: one prefill-shaped forward
+        over [last_token, draft_1..draft_k] that returns the GREEDY next
+        token for EVERY row (the drafts' acceptance references), instead
+        of just the last row. KV for all fed rows is written; the host
+        advances num_computed only over accepted positions, and rejected
+        rows' garbage KV sits beyond every reader's context length until
+        real tokens overwrite it."""
+        mc = self.model_config
+        scale = self._scale
+
+        if self.attention_impl == "pallas":
+            from production_stack_tpu.ops import pallas_attention
+
+            bs = self.block_size
+            interpret = jax.default_backend() != "tpu"
+            mesh = self.mesh
+
+            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
+                if mesh is not None:
+                    return pallas_attention.paged_prefill_attention_tp(
+                        q, kc, vc, l, gather_slots, q_positions[0],
+                        mesh=mesh, block_size=bs, scale=scale,
+                        interpret=interpret,
+                    )
+                return pallas_attention.paged_prefill_attention(
+                    q, kc, vc, l, gather_slots, q_positions[0],
+                    block_size=bs, scale=scale, interpret=interpret,
+                )
+        else:
+
+            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
+                k_ctx = kc[l, :, gather_slots]
+                v_ctx = vc[l, :, gather_slots]
+                return xla_attn.context_attention_prefill(
+                    q, k_ctx, v_ctx, q_positions, total_len, scale
+                )
+
+        def step(params, kc, vc, tokens, positions, write_slots,
+                 gather_slots, total_len, lora=None, lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            attn_fn = functools.partial(
+                attn,
+                gather_slots=gather_slots,
+                q_positions=positions,
+                total_len=total_len,
+            )
+            logits, kc, vc = llama.forward(
+                mc, params, tokens, positions, kc, vc, write_slots,
+                lambda q, l, k, v: attn_fn(q, l, k, v),
+                logits_rows=jnp.arange(t_pad),
+                lora=lora, lora_slots=lora_slots,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return greedy, kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2),
+                       **self._step_jit_kwargs(1))
+
+    def greedy_verify(
+        self,
+        token_ids: list[int],
+        start_pos: int,
+        block_table: list[int],
+        total_len: int,
+        lora_slot: int = 0,
+    ) -> np.ndarray:
+        """Run the verification forward; returns (len(token_ids),) int32
+        greedy next-token per row."""
+        t = len(token_ids)
+        t_pad = self._prefill_bucket(t)
+        c_pad = self._ctx_bucket(total_len)
+
+        tokens = np.zeros((t_pad,), dtype=np.int32)
+        tokens[:t] = token_ids
+        positions = np.full((t_pad,), -1, dtype=np.int32)
+        positions[:t] = np.arange(start_pos, start_pos + t)
+        write_slots = self._slots_for_positions(block_table, positions)
+        positions_dev = np.where(positions < 0, 0, positions).astype(
+            np.int32
+        )
+        if self.attention_impl == "pallas":
+            gather_slots = self._padded_block_table(
+                block_table, c_pad // self.block_size
+            )
+        else:
+            gather_slots = self._gather_slots_for_table(block_table, c_pad)
+
+        key = (t_pad, c_pad)
+        if key not in self._verify_fns:
+            logger.info("compiling verify step t=%d ctx=%d", t_pad, c_pad)
+            self._verify_fns[key] = self._build_verify(t_pad, c_pad)
+        fn = self._verify_fns[key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.int32(lora_slot),
+            }
+        greedy, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions_dev),
+            jnp.asarray(write_slots),
+            jnp.asarray(gather_slots),
+            jnp.int32(total_len),
+            **lora_kw,
+        )
+        return np.asarray(greedy)[:t]
 
     def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
         """Packed cross-sequence prefill: chunks from s_pad sequences run
