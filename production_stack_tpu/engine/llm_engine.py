@@ -253,6 +253,27 @@ class LLMEngine:
             lora_name=lora_name,
             hash_seed=hash_seed,
         )
+        if sp.guided_choice is not None:
+            if not sp.guided_choice or not all(
+                isinstance(c, str) and c for c in sp.guided_choice
+            ):
+                raise ValueError(
+                    "guided_choice must be a non-empty list of "
+                    "non-empty strings"
+                )
+            try:
+                choice_ids = [
+                    self.tokenizer.encode(c, add_bos=False)
+                    for c in sp.guided_choice
+                ]
+            except TypeError:  # tokenizer without the add_bos kwarg
+                choice_ids = [
+                    self.tokenizer.encode(c) for c in sp.guided_choice
+                ]
+            if any(not ids for ids in choice_ids):
+                raise ValueError("guided_choice entries must tokenize "
+                                 "to at least one token")
+            seq._guided_choices = choice_ids  # type: ignore[attr-defined]
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
 
@@ -493,6 +514,8 @@ class LLMEngine:
                 # non-empty at the "first" token) needs the logits.
                 def _needs_host_sample(s: Sequence) -> bool:
                     sp = s.sampling_params
+                    if sp.guided_choice is not None:
+                        return True  # first token must be masked
                     return bool(s.generated_token_ids) and (
                         sp.presence_penalty != 0.0
                         or sp.frequency_penalty != 0.0
@@ -548,7 +571,13 @@ class LLMEngine:
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
             k_steps = self.config.num_scheduler_steps
-            if k_steps > 1:
+            # guided lanes need a host-side logit mask every token, so
+            # they ride the single-step path regardless of K
+            needs_guided = any(
+                getattr(s, "_guided_choices", None) is not None
+                for s in seqs
+            )
+            if k_steps > 1 and not needs_guided:
                 temps, top_ps, top_ks, keys, needs_pen = (
                     self._sampling_arrays(seqs)
                 )
@@ -653,6 +682,7 @@ class LLMEngine:
         if (
             sp.temperature != 0.0
             or sp.logprobs is not None
+            or sp.guided_choice is not None
             or sp.presence_penalty != 0.0
             or sp.frequency_penalty != 0.0
             or sp.repetition_penalty != 1.0
@@ -753,6 +783,35 @@ class LLMEngine:
             )
         return temps, top_ps, top_ks, keys, needs_penalties
 
+    # -- structured output (guided_choice) ---------------------------------
+    def _guided_allowed(self, seq: Sequence) -> set[int] | None:
+        """Tokens that extend a still-matching choice, or None when the
+        sequence is unconstrained."""
+        choices = getattr(seq, "_guided_choices", None)
+        if choices is None:
+            return None
+        g = list(seq.generated_token_ids)
+        allowed: set[int] = set()
+        for ids in choices:
+            if len(ids) > len(g) and list(ids[: len(g)]) == g:
+                allowed.add(int(ids[len(g)]))
+        return allowed
+
+    def _apply_guided_mask(self, seqs: list[Sequence], logits):
+        """-inf everything outside each lane's allowed-token set."""
+        if not any(
+            getattr(s, "_guided_choices", None) is not None for s in seqs
+        ):
+            return logits
+        logits = np.array(logits, np.float32, copy=True)
+        for i, s in enumerate(seqs):
+            allowed = self._guided_allowed(s)
+            if allowed:
+                mask = np.full(logits.shape[-1], -np.inf, np.float32)
+                mask[list(allowed)] = 0.0
+                logits[i] = logits[i] + mask
+        return logits
+
     def _sample(self, seqs: list[Sequence], logits,
                 return_logits: bool = False):
         b = logits.shape[0]
@@ -761,6 +820,7 @@ class LLMEngine:
         )
         if needs_penalties:
             logits = self._apply_penalties(seqs, np.asarray(logits))
+        logits = self._apply_guided_mask(seqs, logits)
         out = sample_tokens(logits, temps, top_ps, top_ks, keys)
         sampled = np.asarray(out)[: len(seqs)]
         if return_logits:
@@ -874,6 +934,15 @@ class LLMEngine:
             getattr(seq, "_pending_ids", []) + [int(token)]
         )  # type: ignore[attr-defined]
         seq.check_stop(new_text)
+        if (
+            not seq.finished
+            and getattr(seq, "_guided_choices", None) is not None
+        ):
+            g = list(seq.generated_token_ids)
+            if any(list(ids) == g for ids in seq._guided_choices):
+                # a choice completed exactly: the structured output is
+                # done (the first complete choice wins)
+                seq.status = SequenceStatus.FINISHED_STOPPED
         # hard cap: the KV layout cannot hold more than max_model_len
         # positions, so stop at the context limit regardless of max_tokens
         if (

@@ -40,8 +40,19 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
             logprobs = int(logprobs)
             if not 0 <= logprobs <= 20:
                 raise ProtocolError("logprobs must be in [0, 20]")
+        guided_choice = body.get("guided_choice")
+        if guided_choice is not None and (
+            not isinstance(guided_choice, list)
+            or not guided_choice
+            or not all(isinstance(c, str) and c for c in guided_choice)
+        ):
+            raise ProtocolError(
+                "guided_choice must be a non-empty list of non-empty "
+                "strings"
+            )
         return SamplingParams(
             logprobs=logprobs,
+            guided_choice=guided_choice,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),

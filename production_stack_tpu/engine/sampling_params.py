@@ -21,6 +21,10 @@ class SamplingParams:
     repetition_penalty: float = 1.0
     logprobs: int | None = None
     min_tokens: int = 0
+    # structured output (vLLM guided_choice role): the generation must
+    # be exactly one of these strings — logits are masked to the tokens
+    # that extend a still-matching choice
+    guided_choice: list[str] | None = None
 
     def __post_init__(self) -> None:
         if self.max_tokens < 1:
