@@ -90,6 +90,8 @@ class LLMEngine:
         self._generation_tokens_total = 0
         self._preemptions_total = 0
         self._finished_total = 0
+        self._spec_drafts_total = 0
+        self._spec_accepted_total = 0
 
         # -- KV offload tiers + controller reporting (LMCache-equivalent) --
         self.kv_reporter = None
@@ -719,6 +721,8 @@ class LLMEngine:
                 accepted += 1
             else:
                 break
+        self._spec_drafts_total += len(drafts)
+        self._spec_accepted_total += accepted
         # accepted drafts + the verify forward's own next token (the
         # correction on mismatch, the bonus token on full acceptance)
         new_tokens = drafts[:accepted] + [int(greedy[accepted])]
@@ -1081,6 +1085,8 @@ class LLMEngine:
             generation_tokens_total=self._generation_tokens_total,
             num_preemptions_total=self._preemptions_total,
             requests_finished_total=self._finished_total,
+            spec_draft_tokens_total=self._spec_drafts_total,
+            spec_accepted_tokens_total=self._spec_accepted_total,
         )
 
     # -- offline convenience (tests, benchmarks) ---------------------------

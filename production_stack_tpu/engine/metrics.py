@@ -76,6 +76,14 @@ class EngineMetrics:
             "vllm:num_preemptions", "Sequence preemptions",
             label, registry=reg,
         )
+        self.spec_drafts = Counter(
+            "vllm:spec_decode_num_draft_tokens",
+            "Speculative draft tokens proposed", label, registry=reg,
+        )
+        self.spec_accepted = Counter(
+            "vllm:spec_decode_num_accepted_tokens",
+            "Speculative draft tokens accepted", label, registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -113,6 +121,14 @@ class EngineMetrics:
         )
         self.preemptions.labels(m).inc(
             max(0, s.num_preemptions_total - prev.num_preemptions_total)
+        )
+        self.spec_drafts.labels(m).inc(
+            max(0, s.spec_draft_tokens_total
+                - prev.spec_draft_tokens_total)
+        )
+        self.spec_accepted.labels(m).inc(
+            max(0, s.spec_accepted_tokens_total
+                - prev.spec_accepted_tokens_total)
         )
         self._counter_state = s
 

@@ -39,6 +39,9 @@ class EngineStatsSnapshot:
     generation_tokens_total: int = 0
     num_preemptions_total: int = 0
     requests_finished_total: int = 0
+    # speculative decoding acceptance (vllm:spec_decode_* role)
+    spec_draft_tokens_total: int = 0
+    spec_accepted_tokens_total: int = 0
 
     @property
     def prefix_cache_hit_rate(self) -> float:

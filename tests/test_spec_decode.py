@@ -123,3 +123,26 @@ def test_ngram_drafts_prefer_longest_match():
     assert eng._ngram_drafts(seq, 4) == [9, 1, 2, 3]
     seq2 = Sequence("s2", [5, 6, 7, 8], SamplingParams(), None)
     assert eng._ngram_drafts(seq2, 4) == []  # no repeat, no draft
+
+
+def test_spec_metrics_exported():
+    """Acceptance counters flow into the engine stats snapshot and the
+    Prometheus surface (vllm:spec_decode_* role)."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from production_stack_tpu.engine.metrics import EngineMetrics
+
+    eng = make_engine(spec=4)
+    sp = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    eng.generate([PROMPT], sp)
+    snap = eng.stats()
+    assert snap.spec_draft_tokens_total > 0
+    assert 0 <= snap.spec_accepted_tokens_total <= (
+        snap.spec_draft_tokens_total
+    )
+    reg = CollectorRegistry()
+    m = EngineMetrics("m", registry=reg)
+    m.update_from_snapshot(snap)
+    text = generate_latest(reg).decode()
+    assert "vllm:spec_decode_num_draft_tokens_total" in text
+    assert "vllm:spec_decode_num_accepted_tokens_total" in text
