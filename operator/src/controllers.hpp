@@ -155,6 +155,8 @@ inline Json engine_container(const Json& cr) {
   arg_if(args, eng, "dtype", "--dtype");
   arg_if(args, eng, "kvCacheDtype", "--kv-cache-dtype");
   arg_if(args, eng, "attentionImpl", "--attention-impl");
+  arg_if(args, eng, "numSchedulerSteps", "--num-scheduler-steps");
+  arg_if(args, eng, "numSpeculativeTokens", "--num-speculative-tokens");
   arg_if(args, eng, "enableLora", "--enable-lora");
   if (!eng.get("hbmUtilization").is_null())
     arg(args, "--hbm-utilization",
