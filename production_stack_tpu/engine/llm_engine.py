@@ -85,6 +85,12 @@ class LLMEngine:
             and config.num_scheduler_steps > 1
             and not config.multihost
         )
+        # speculative decoding is single-host: greedy_verify is not part
+        # of the multihost broadcast protocol, so a spec step on host 0
+        # would desync (and deadlock) the followers' collectives
+        self._spec_enabled = (
+            config.num_speculative_tokens > 0 and not config.multihost
+        )
         # lifetime counters for /metrics
         self._prompt_tokens_total = 0
         self._generation_tokens_total = 0
@@ -559,10 +565,7 @@ class LLMEngine:
                         stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
-            if (
-                self.config.num_speculative_tokens > 0
-                and len(seqs) == 1
-            ):
+            if self._spec_enabled and len(seqs) == 1:
                 spec = self._try_spec_decode(seqs[0])
                 if spec is not None:
                     stepped.extend(spec)

@@ -146,3 +146,17 @@ def test_spec_metrics_exported():
     text = generate_latest(reg).decode()
     assert "vllm:spec_decode_num_draft_tokens_total" in text
     assert "vllm:spec_decode_num_accepted_tokens_total" in text
+
+
+def test_spec_disabled_under_multihost_config():
+    """greedy_verify is not part of the multihost broadcast protocol:
+    a spec step on host 0 would desync follower collectives, so the
+    engine must gate speculation off when multihost is set."""
+    import dataclasses
+
+    eng = make_engine(spec=4)
+    assert eng._spec_enabled
+    # the gate re-derived over a multihost config must be off
+    mh_cfg = dataclasses.replace(eng.config, multihost=True)
+    assert not (mh_cfg.num_speculative_tokens > 0
+                and not mh_cfg.multihost)
