@@ -700,6 +700,8 @@ class LLMEngine:
             k,
             self.scheduler.config.max_model_len - n0,
             sp.max_tokens - len(seq.generated_token_ids) - 1,
+            # verify feeds k+1 tokens through the prefill buckets
+            self.config.max_prefill_chunk - 1,
         )
         if k <= 0:
             return None
@@ -799,9 +801,18 @@ class LLMEngine:
             return None
         g = list(seq.generated_token_ids)
         allowed: set[int] = set()
+        complete = False
         for ids in choices:
             if len(ids) > len(g) and list(ids[: len(g)]) == g:
                 allowed.add(int(ids[len(g)]))
+            elif list(ids) == g:
+                complete = True
+        if complete and allowed and seq.eos_token_id is not None:
+            # one choice is complete but a longer one still extends it
+            # ("go" vs "gone"): let the MODEL decide by offering EOS as
+            # the stop-here option instead of silently making the longer
+            # choice unreachable
+            allowed.add(int(seq.eos_token_id))
         return allowed
 
     def _apply_guided_mask(self, seqs: list[Sequence], logits):
@@ -946,9 +957,18 @@ class LLMEngine:
             and getattr(seq, "_guided_choices", None) is not None
         ):
             g = list(seq.generated_token_ids)
-            if any(list(ids) == g for ids in seq._guided_choices):
-                # a choice completed exactly: the structured output is
-                # done (the first complete choice wins)
+            complete = any(list(ids) == g for ids in seq._guided_choices)
+            extendable = any(
+                len(ids) > len(g) and list(ids[: len(g)]) == g
+                for ids in seq._guided_choices
+            )
+            # finish when a choice completed and nothing longer extends
+            # it, or when no choice matches any more (the model chose
+            # EOS at a complete-but-extendable prefix — the appended EOS
+            # ends the stream like any other stop)
+            if (complete and not (
+                extendable and seq.eos_token_id is not None
+            )) or (not complete and not extendable):
                 seq.status = SequenceStatus.FINISHED_STOPPED
         # hard cap: the KV layout cannot hold more than max_model_len
         # positions, so stop at the context limit regardless of max_tokens

@@ -318,11 +318,17 @@ class ModelRunner:
                 with_layout_constraint(vc, fmt))
 
     # -- jitted step builders ---------------------------------------------
-    def _build_prefill(self, t_pad: int, c_pad: int):
-        mc = self.model_config
-        scale = self._scale
-        from production_stack_tpu.engine.sampler import sample_tokens
+    def _prefill_attn_closure(self):
+        """The per-layer attention callback shared by the prefill and
+        verify step builders (pallas paged kernel or XLA gather path).
 
+        `gather_slots` = this sequence's padded block table (P,) on the
+        pallas path (the kernel streams context pages from HBM once per
+        chunk — the per-layer (ctx, nkv, d) gathered copy is never
+        built; q row 0 is always a real token, so positions[0] is the
+        chunk's absolute start position), or the flat slot gather on the
+        XLA path."""
+        scale = self._scale
         if self.attention_impl == "pallas":
             from production_stack_tpu.ops import pallas_attention
 
@@ -330,11 +336,6 @@ class ModelRunner:
             interpret = jax.default_backend() != "tpu"
             mesh = self.mesh
 
-            # `gather_slots` = this sequence's padded block table (P,);
-            # the kernel streams context pages from HBM once per chunk —
-            # the per-layer (ctx, nkv, d) gathered copy is never built.
-            # q row 0 is always a real token, so positions[0] is the
-            # chunk's absolute start position.
             def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
                 if mesh is not None:
                     return pallas_attention.paged_prefill_attention_tp(
@@ -357,6 +358,40 @@ class ModelRunner:
                 return xla_attn.context_attention_prefill(
                     q, k_ctx, v_ctx, q_positions, total_len, scale
                 )
+
+        return attn
+
+    def _prefill_host_prep(
+        self, token_ids: list[int], block_table: list[int],
+        start_pos: int, total_len: int,
+    ):
+        """Shared host-side argument prep for prefill/verify dispatches:
+        (tokens, positions_dev, write_slots, gather_slots, t_pad, c_pad).
+        Padded rows carry position -1 -> rope of 0, write to trash."""
+        t = len(token_ids)
+        t_pad = self._prefill_bucket(t)
+        c_pad = self._ctx_bucket(total_len)
+        tokens = np.zeros((t_pad,), dtype=np.int32)
+        tokens[:t] = token_ids
+        positions = np.full((t_pad,), -1, dtype=np.int32)
+        positions[:t] = np.arange(start_pos, start_pos + t)
+        write_slots = self._slots_for_positions(block_table, positions)
+        positions_dev = np.where(positions < 0, 0, positions).astype(
+            np.int32
+        )
+        if self.attention_impl == "pallas":
+            gather_slots = self._padded_block_table(
+                block_table, c_pad // self.block_size
+            )
+        else:
+            gather_slots = self._gather_slots_for_table(block_table, c_pad)
+        return tokens, positions_dev, write_slots, gather_slots, t_pad, c_pad
+
+    def _build_prefill(self, t_pad: int, c_pad: int):
+        mc = self.model_config
+        from production_stack_tpu.engine.sampler import sample_tokens
+
+        attn = self._prefill_attn_closure()
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, last_row, temps, top_ps,
@@ -395,34 +430,7 @@ class ModelRunner:
         rows' garbage KV sits beyond every reader's context length until
         real tokens overwrite it."""
         mc = self.model_config
-        scale = self._scale
-
-        if self.attention_impl == "pallas":
-            from production_stack_tpu.ops import pallas_attention
-
-            bs = self.block_size
-            interpret = jax.default_backend() != "tpu"
-            mesh = self.mesh
-
-            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
-                if mesh is not None:
-                    return pallas_attention.paged_prefill_attention_tp(
-                        q, kc, vc, l, gather_slots, q_positions[0],
-                        mesh=mesh, block_size=bs, scale=scale,
-                        interpret=interpret,
-                    )
-                return pallas_attention.paged_prefill_attention(
-                    q, kc, vc, l, gather_slots, q_positions[0],
-                    block_size=bs, scale=scale, interpret=interpret,
-                )
-        else:
-
-            def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
-                k_ctx = kc[l, :, gather_slots]
-                v_ctx = vc[l, :, gather_slots]
-                return xla_attn.context_attention_prefill(
-                    q, k_ctx, v_ctx, q_positions, total_len, scale
-                )
+        attn = self._prefill_attn_closure()
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, lora=None, lora_slots=None):
@@ -456,24 +464,10 @@ class ModelRunner:
         """Run the verification forward; returns (len(token_ids),) int32
         greedy next-token per row."""
         t = len(token_ids)
-        t_pad = self._prefill_bucket(t)
-        c_pad = self._ctx_bucket(total_len)
-
-        tokens = np.zeros((t_pad,), dtype=np.int32)
-        tokens[:t] = token_ids
-        positions = np.full((t_pad,), -1, dtype=np.int32)
-        positions[:t] = np.arange(start_pos, start_pos + t)
-        write_slots = self._slots_for_positions(block_table, positions)
-        positions_dev = np.where(positions < 0, 0, positions).astype(
-            np.int32
+        (tokens, positions_dev, write_slots, gather_slots,
+         t_pad, c_pad) = self._prefill_host_prep(
+            token_ids, block_table, start_pos, total_len
         )
-        if self.attention_impl == "pallas":
-            gather_slots = self._padded_block_table(
-                block_table, c_pad // self.block_size
-            )
-        else:
-            gather_slots = self._gather_slots_for_table(block_table, c_pad)
-
         key = (t_pad, c_pad)
         if key not in self._verify_fns:
             logger.info("compiling verify step t=%d ctx=%d", t_pad, c_pad)
@@ -828,26 +822,10 @@ class ModelRunner:
         (vocab,) for penalty/debug paths. K/V for the chunk is written
         into the cache."""
         t = len(token_ids)
-        t_pad = self._prefill_bucket(t)
-        c_pad = self._ctx_bucket(total_len)
-
-        tokens = np.zeros((t_pad,), dtype=np.int32)
-        tokens[:t] = token_ids
-        positions = np.full((t_pad,), -1, dtype=np.int32)
-        positions[:t] = np.arange(start_pos, start_pos + t)
-        write_slots = self._slots_for_positions(block_table, positions)
-        # padded rows: position -1 -> rope of position 0, write to trash
-        positions_dev = np.where(positions < 0, 0, positions).astype(np.int32)
-        if self.attention_impl == "pallas":
-            # pallas path takes the padded block table (pages); padding
-            # pages hold positions beyond every real query's causal
-            # horizon, so they are masked out
-            gather_slots = self._padded_block_table(
-                block_table, c_pad // self.block_size
-            )
-        else:
-            gather_slots = self._gather_slots_for_table(block_table, c_pad)
-
+        (tokens, positions_dev, write_slots, gather_slots,
+         t_pad, c_pad) = self._prefill_host_prep(
+            token_ids, block_table, start_pos, total_len
+        )
         key = (t_pad, c_pad)
         if key not in self._prefill_fns:
             logger.info("compiling prefill step t=%d ctx=%d", t_pad, c_pad)

@@ -245,6 +245,14 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
     gemma = arch == "GemmaForCausalLM"
+    max_len = hf.get("max_position_embeddings", 8192)
+    window = hf.get("sliding_window")
+    if window:
+        # the attention paths are full-context; within the window that
+        # IS sliding-window attention, beyond it the logits would
+        # diverge from the reference — cap the context so serving stays
+        # exact (Phi-3-mini 4k ships window 2047, Mistral-7B-v0.1 4096)
+        max_len = min(max_len, int(window))
     act = hf.get("hidden_act") or hf.get("hidden_activation") or "silu"
     if act in ("gelu_pytorch_tanh", "gelu_new", "gelu"):
         act = "gelu_tanh"
@@ -257,7 +265,7 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         num_heads=num_heads,
         num_kv_heads=hf.get("num_key_value_heads", num_heads),
         head_dim=head_dim,
-        max_model_len=hf.get("max_position_embeddings", 8192),
+        max_model_len=max_len,
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=(

@@ -68,12 +68,22 @@ def test_guided_and_free_lanes_coexist():
 
 
 def test_prefix_sharing_choices():
-    """One choice a prefix of another: the first complete match wins."""
+    """One choice a prefix of another: BOTH stay reachable — at the
+    complete-but-extendable point the model chooses between EOS (stop
+    at the short choice) and the extension tokens (review finding r4:
+    first-match-wins silently made the longer choice impossible)."""
     eng = make_engine()
     sp = SamplingParams(max_tokens=16, temperature=0.0,
                         guided_choice=["go", "gone"])
     out = eng.generate(["x"], sp)[0]
-    assert out.text == "go"  # byte tokenizer: 'go' completes first
+    assert out.text in ("go", "gone")
+    assert out.finish_reason == "stop"
+    # force the short choice: make EOS the only allowed continuation by
+    # offering choices where the extension path is pruned
+    sp2 = SamplingParams(max_tokens=16, temperature=0.0,
+                         guided_choice=["go"])
+    out2 = eng.generate(["x"], sp2)[0]
+    assert out2.text == "go"
 
 
 def test_api_surface():

@@ -125,3 +125,28 @@ def test_engine_runs_loaded_checkpoint(tmp_path):
         np.asarray(eng_path.runner.params["layers"]["wq"]),
         np.asarray(params["layers"]["wq"]),
     )
+
+
+def test_sliding_window_caps_context(tmp_path):
+    """Configs shipping sliding_window (Phi-3-mini 2047, Mistral-v0.1
+    4096) must cap max_model_len to the window: within it, full-context
+    attention IS sliding-window attention; beyond it the logits would
+    silently diverge from the reference (review finding r4)."""
+    import json as _json
+
+    from production_stack_tpu.models.config import from_hf_config
+
+    d = tmp_path / "win"
+    d.mkdir()
+    cfg = dict(HF_CONFIG)
+    cfg["architectures"] = ["Phi3ForCausalLM"]
+    cfg["max_position_embeddings"] = 4096
+    cfg["sliding_window"] = 2047
+    with open(d / "config.json", "w") as f:
+        _json.dump(cfg, f)
+    mc = from_hf_config(str(d))
+    assert mc.max_model_len == 2047
+    cfg["sliding_window"] = None  # explicit null must not cap
+    with open(d / "config.json", "w") as f:
+        _json.dump(cfg, f)
+    assert from_hf_config(str(d)).max_model_len == 4096
