@@ -159,6 +159,15 @@ class ModelRunner:
                 " using the XLA gather path", mc.head_dim,
             )
             impl = "xla"
+        if impl == "pallas" and mc.sliding_window:
+            # the paged kernels attend over the full context; windowed
+            # models (Phi-3-mini, Mistral-v0.1) need the mask the XLA
+            # path implements
+            logger.warning(
+                "model %s uses sliding-window attention (window=%d); "
+                "using the XLA gather path", mc.name, mc.sliding_window,
+            )
+            impl = "xla"
         if impl == "pallas" and jax.default_backend() == "tpu":
             # compile-check the kernel on tiny shapes before committing:
             # if this TPU generation/toolchain rejects it, serve on the
@@ -349,6 +358,8 @@ class ModelRunner:
                 )
         else:
 
+            window = self.model_config.sliding_window
+
             def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
                 # head-major cache + traced `l`: [l, :, slots] has two
                 # advanced indices split by a slice, so numpy hoists them
@@ -356,7 +367,8 @@ class ModelRunner:
                 k_ctx = kc[l, :, gather_slots]
                 v_ctx = vc[l, :, gather_slots]
                 return xla_attn.context_attention_prefill(
-                    q, k_ctx, v_ctx, q_positions, total_len, scale
+                    q, k_ctx, v_ctx, q_positions, total_len, scale,
+                    window=window,
                 )
 
         return attn
@@ -548,7 +560,10 @@ class ModelRunner:
                 v_ctx = vc[l, :, tables]
                 qs = q.reshape(s_pad, t_pad, mc.num_heads, mc.head_dim)
                 out = jax.vmap(
-                    xla_attn.context_attention_prefill,
+                    functools.partial(
+                        xla_attn.context_attention_prefill,
+                        window=self.model_config.sliding_window,
+                    ),
                     in_axes=(0, 0, 0, 0, 0, None),
                 )(qs, k_ctx, v_ctx, positions2d, total_lens, scale)
                 return out.reshape(
@@ -614,7 +629,8 @@ class ModelRunner:
                 k_ctx = kc[l, :, tables]
                 v_ctx = vc[l, :, tables]
                 return xla_attn.context_attention_decode(
-                    q, k_ctx, v_ctx, context_lens, scale
+                    q, k_ctx, v_ctx, context_lens, scale,
+                    window=self.model_config.sliding_window,
                 )
 
         def step(params, kc, vc, tokens, positions, write_slots,
@@ -680,7 +696,8 @@ class ModelRunner:
                 k_ctx = kc[l, :, gather_tables]
                 v_ctx = vc[l, :, gather_tables]
                 return xla_attn.context_attention_decode(
-                    q, k_ctx, v_ctx, context_lens, scale
+                    q, k_ctx, v_ctx, context_lens, scale,
+                    window=self.model_config.sliding_window,
                 )
 
         use_pages = self.attention_impl == "pallas"
@@ -1192,7 +1209,8 @@ class ModelRunner:
             def attn(q, l, kcache, vcache):
                 return xla_attn.context_attention_prefill(
                     q, kcache[l].swapaxes(0, 1), vcache[l].swapaxes(0, 1),
-                    positions, total_len, scale
+                    positions, total_len, scale,
+                    window=self.model_config.sliding_window,
                 )
 
             # scratch cache row == absolute position; padded chunk rows

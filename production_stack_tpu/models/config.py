@@ -38,6 +38,11 @@ class ModelConfig:
     hidden_act: str = "silu"  # "gelu_tanh" for the Gemma family
     norm_weight_offset: float = 0.0  # Gemma stores RMSNorm w zero-centered
     embed_scale: float = 1.0  # Gemma scales embeddings by sqrt(hidden)
+    # sliding-window attention (Phi-3-mini, Mistral-v0.1): each token
+    # attends to at most this many predecessors; None = full context.
+    # Served on the XLA attention path (the paged kernels are
+    # full-context); parity-tested against transformers beyond the window
+    sliding_window: int | None = None
     # MoE (Mixtral family): 0 experts = dense MLP. capacity_factor 0
     # selects the exact all-experts einsum path; > 0 the GShard
     # static-capacity dispatch (ops/moe.py)
@@ -247,12 +252,6 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
     gemma = arch == "GemmaForCausalLM"
     max_len = hf.get("max_position_embeddings", 8192)
     window = hf.get("sliding_window")
-    if window:
-        # the attention paths are full-context; within the window that
-        # IS sliding-window attention, beyond it the logits would
-        # diverge from the reference — cap the context so serving stays
-        # exact (Phi-3-mini 4k ships window 2047, Mistral-7B-v0.1 4096)
-        max_len = min(max_len, int(window))
     act = hf.get("hidden_act") or hf.get("hidden_activation") or "silu"
     if act in ("gelu_pytorch_tanh", "gelu_new", "gelu"):
         act = "gelu_tanh"
@@ -275,6 +274,7 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
         hidden_act=act if gemma else "silu",
         norm_weight_offset=1.0 if gemma else 0.0,
         embed_scale=float(hf["hidden_size"]) ** 0.5 if gemma else 1.0,
+        sliding_window=int(window) if window else None,
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
     )

@@ -70,11 +70,19 @@ def context_attention_decode(
     v_ctx: jax.Array,
     context_lens: jax.Array,  # (batch,) valid positions incl. the new token
     scale: float,
+    window: int | None = None,  # sliding-window size; None = full context
 ) -> jax.Array:
-    """One decode step over gathered per-sequence context. -> (b, nq, d)."""
+    """One decode step over gathered per-sequence context. -> (b, nq, d).
+
+    With `window`, the query (at position context_len-1) attends only
+    its last `window` predecessors incl. itself (HF sliding-window
+    semantics: keys j with q_pos - window < j <= q_pos)."""
     scores = _gqa_scores(q, k_ctx, scale)  # (b, nkv, g, c)
     c = k_ctx.shape[1]
-    valid = jnp.arange(c)[None, :] < context_lens[:, None]  # (b, c)
+    key_pos = jnp.arange(c)[None, :]
+    valid = key_pos < context_lens[:, None]  # (b, c)
+    if window is not None:
+        valid = valid & (key_pos > context_lens[:, None] - 1 - window)
     scores = jnp.where(valid[:, None, None, :], scores, MASK_VALUE)
     p = jax.nn.softmax(scores, axis=-1)
     return _gqa_output(p, v_ctx).astype(q.dtype)
@@ -87,15 +95,23 @@ def context_attention_prefill(
     q_positions: jax.Array,  # (t,) absolute positions of the chunk tokens
     total_len: jax.Array,  # scalar: valid context positions (prefix + chunk)
     scale: float,
+    window: int | None = None,  # sliding-window size; None = full context
 ) -> jax.Array:
     """Chunked-prefill attention for one sequence; causal over absolute
-    positions (context rows ARE absolute positions). -> (t, nq, d)."""
+    positions (context rows ARE absolute positions). -> (t, nq, d).
+
+    With `window`, each query attends only its last `window` positions
+    incl. itself (keys j with q_pos - window < j <= q_pos)."""
     scores = _gqa_scores(q, k_ctx, scale)  # (t, nkv, g, c)
     c = k_ctx.shape[0]
     key_pos = jnp.arange(c)
     mask = (key_pos[None, :] <= q_positions[:, None]) & (
         key_pos[None, :] < total_len
     )  # (t, c)
+    if window is not None:
+        mask = mask & (
+            key_pos[None, :] > q_positions[:, None] - window
+        )
     scores = jnp.where(mask[:, None, None, :], scores, MASK_VALUE)
     p = jax.nn.softmax(scores, axis=-1)
     return _gqa_output(p, v_ctx).astype(q.dtype)

@@ -111,6 +111,11 @@ class LongContextPrefiller:
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
         if SP_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh must carry an '{SP_AXIS}' axis")
+        if cfg.sliding_window:
+            raise ValueError(
+                f"model {cfg.name}: sliding-window attention is served "
+                "by the engine's XLA path; the ring attends full context"
+            )
         if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
             sharding_rules.validate_tp(cfg, mesh.shape["tp"])
             params = jax.device_put(

@@ -75,6 +75,12 @@ def validate_pp(cfg: ModelConfig, pp_size: int) -> None:
             "pipeline parallelism covers dense decoders; shard MoE models "
             "with expert parallelism instead (parallel/sharding.py)"
         )
+    if cfg.sliding_window:
+        raise ValueError(
+            f"model {cfg.name}: sliding-window attention is served by "
+            "the engine's XLA path; the pipeline prefiller attends full "
+            "context"
+        )
 
 
 def pp_param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:

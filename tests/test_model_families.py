@@ -71,6 +71,7 @@ def our_logits(model_dir: str, token_ids: list[int]) -> np.ndarray:
         return context_attention_prefill(
             q, kc[l].swapaxes(0, 1), vc[l].swapaxes(0, 1),
             positions, jnp.int32(T), scale,
+            window=cfg.sliding_window,
         )
 
     logits, _, _ = llama.forward(
@@ -119,3 +120,54 @@ def test_engine_serves_family(kind, tmp_path):
         SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
     )[0]
     assert len(out.token_ids) == 4
+
+
+def test_phi3_sliding_window_parity_beyond_window(tmp_path):
+    """Sequences LONGER than the sliding window: our masked XLA
+    attention must match the transformers reference token for token —
+    the case a full-context fallback would silently get wrong."""
+    import torch
+    from transformers import AutoModelForCausalLM, Phi3Config
+
+    torch.manual_seed(3)
+    cfg = Phi3Config(**COMMON, rope_theta=10000.0, pad_token_id=0,
+                     sliding_window=8)
+    model = AutoModelForCausalLM.from_config(
+        cfg, attn_implementation="eager"
+    ).float().eval()
+    d = str(tmp_path / "phi3-win")
+    model.save_pretrained(d, safe_serialization=True)
+
+    mc = get_model_config(d)
+    assert mc.sliding_window == 8
+
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, COMMON["vocab_size"], size=40).tolist()  # >> 8
+    ours = our_logits(d, ids)
+    theirs = hf_logits(d, ids)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_windowed_engine_generates(tmp_path):
+    """Engine serves a windowed checkpoint end-to-end past the window."""
+    import torch
+    from transformers import AutoModelForCausalLM, Phi3Config
+
+    torch.manual_seed(5)
+    cfg = Phi3Config(**COMMON, rope_theta=10000.0, pad_token_id=0,
+                     sliding_window=8)
+    d = str(tmp_path / "phi3-win2")
+    AutoModelForCausalLM.from_config(cfg).float().eval().save_pretrained(
+        d, safe_serialization=True
+    )
+    eng = LLMEngine(EngineConfig(
+        model=d, tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=4, num_kv_blocks=64,
+        max_num_seqs=2, max_prefill_chunk=16, seed=0,
+    ))
+    assert eng.runner.attention_impl == "xla"
+    out = eng.generate(
+        [list(range(1, 21))],  # prompt alone exceeds the window
+        SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True),
+    )[0]
+    assert len(out.token_ids) == 6

@@ -127,11 +127,11 @@ def test_engine_runs_loaded_checkpoint(tmp_path):
     )
 
 
-def test_sliding_window_caps_context(tmp_path):
+def test_sliding_window_parsed_from_config(tmp_path):
     """Configs shipping sliding_window (Phi-3-mini 2047, Mistral-v0.1
-    4096) must cap max_model_len to the window: within it, full-context
-    attention IS sliding-window attention; beyond it the logits would
-    silently diverge from the reference (review finding r4)."""
+    4096) must carry it into ModelConfig — the engine serves them on
+    the XLA attention path with the window mask, full context length
+    retained."""
     import json as _json
 
     from production_stack_tpu.models.config import from_hf_config
@@ -145,8 +145,9 @@ def test_sliding_window_caps_context(tmp_path):
     with open(d / "config.json", "w") as f:
         _json.dump(cfg, f)
     mc = from_hf_config(str(d))
-    assert mc.max_model_len == 2047
-    cfg["sliding_window"] = None  # explicit null must not cap
+    assert mc.sliding_window == 2047
+    assert mc.max_model_len == 4096  # NOT capped: the mask handles it
+    cfg["sliding_window"] = None
     with open(d / "config.json", "w") as f:
         _json.dump(cfg, f)
-    assert from_hf_config(str(d)).max_model_len == 4096
+    assert from_hf_config(str(d)).sliding_window is None
