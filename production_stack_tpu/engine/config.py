@@ -94,6 +94,22 @@ class EngineConfig:
     kv_controller_url: str | None = None
     kv_instance_id: str = "default-instance"
 
+    def __post_init__(self) -> None:
+        # n=0 would make the prompt-lookup window match every position
+        # (arr[-0:] is the whole context), degenerating drafts to noise.
+        if self.num_speculative_tokens:
+            if not (
+                1
+                <= self.ngram_prompt_lookup_min
+                <= self.ngram_prompt_lookup_max
+            ):
+                raise ValueError(
+                    "require 1 <= ngram_prompt_lookup_min <= "
+                    f"ngram_prompt_lookup_max, got min="
+                    f"{self.ngram_prompt_lookup_min} max="
+                    f"{self.ngram_prompt_lookup_max}"
+                )
+
     def model_config(self) -> ModelConfig:
         return get_model_config(self.model)
 

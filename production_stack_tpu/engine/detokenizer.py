@@ -86,3 +86,12 @@ class IncrementalDetokenizer:
                 self._committed += chunk[len(ctx_text):]
                 self._c = t
                 return
+        # No candidate cut within 4 ids was safe — a tokenizer violating
+        # the CONTEXT-locality assumption could hit this on every append
+        # and grow the uncommitted window without bound (back to the
+        # O(n^2) behavior this module exists to avoid). Bound the window
+        # with a forced full-decode commit; `_render` stays correct
+        # because `_committed` equals decode(ids[:c]) by construction.
+        if len(self._ids) - self._c > 4 * WINDOW:
+            self._committed = self._tok.decode(self._ids[:target])
+            self._c = target

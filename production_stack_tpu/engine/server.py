@@ -609,6 +609,15 @@ class EngineServer:
                  for i, s, ids in plan]
         completion_tokens = 0
         lp_pos: dict[int, int] = {}  # per-choice text_offset seeds
+
+        async def send_finish(idx: int, reason: str) -> None:
+            await send(
+                proto.chat_chunk(request_id, model, {}, reason, index=idx)
+                if chat
+                else proto.completion_chunk(
+                    request_id, model, "", reason, index=idx
+                )
+            )
         try:
             if chat:
                 for idx, _, _ in plan:
@@ -631,20 +640,13 @@ class EngineServer:
                     if payload is not None:
                         self._observe_finish(payload, arrival)
                         completion_tokens += len(payload.token_ids)
-                        await send(
-                            proto.chat_chunk(
-                                request_id, model, {},
-                                payload.finish_reason, index=idx,
-                            )
-                            if chat
-                            else proto.completion_chunk(
-                                request_id, model, "",
-                                payload.finish_reason, index=idx,
-                            )
-                        )
+                        await send_finish(idx, payload.finish_reason)
                 else:  # error
                     remaining -= 1
                     await send(proto.error_json(str(payload)))
+                    # close the choice so clients waiting on a
+                    # finish_reason for every index don't hang
+                    await send_finish(idx, "stop")
             if include_usage:
                 await send(proto.usage_tail_chunk(
                     request_id, model, chat,

@@ -252,6 +252,13 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
     gemma = arch == "GemmaForCausalLM"
     max_len = hf.get("max_position_embeddings", 8192)
     window = hf.get("sliding_window")
+    # Qwen2-family configs ship a sliding_window value alongside
+    # use_sliding_window=false; a window >= max_position_embeddings is
+    # also a no-op mask that would only cost us the paged-attention path.
+    if not hf.get("use_sliding_window", True):
+        window = None
+    if window and window >= max_len:
+        window = None
     act = hf.get("hidden_act") or hf.get("hidden_activation") or "silu"
     if act in ("gelu_pytorch_tanh", "gelu_new", "gelu"):
         act = "gelu_tanh"

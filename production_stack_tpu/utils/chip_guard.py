@@ -101,7 +101,12 @@ def engage(lock_path: str | None = None) -> ChipLock | None:
     """The one chip-session ritual for TPU-owning entry points:
     SIGTERM-only teardown + exclusive chip lock. Returns the held lock
     (keep it for process lifetime) or None when no guard is needed;
-    raises ChipBusyError when another process owns the chip."""
+    raises ChipBusyError when another process owns the chip.
+
+    CPU-only runs (JAX_PLATFORMS=cpu) take no lock and keep their
+    default SIGTERM semantics (e.g. aiohttp's graceful shutdown)."""
+    if not chip_guard_needed():
+        return None
     install_sigterm_handler()
     return acquire_chip_lock(lock_path)
 
