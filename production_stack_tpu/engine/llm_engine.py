@@ -85,12 +85,10 @@ class LLMEngine:
             and config.num_scheduler_steps > 1
             and not config.multihost
         )
-        # speculative decoding is single-host: greedy_verify is not part
-        # of the multihost broadcast protocol, so a spec step on host 0
-        # would desync (and deadlock) the followers' collectives
-        self._spec_enabled = (
-            config.num_speculative_tokens > 0 and not config.multihost
-        )
+        # speculative decoding works under multihost too: verify_batch
+        # is part of the broadcast protocol (multihost_engine.py), so
+        # followers replay the same packed verify host 0 dispatches
+        self._spec_enabled = config.num_speculative_tokens > 0
         # lifetime counters for /metrics
         self._prompt_tokens_total = 0
         self._generation_tokens_total = 0
@@ -565,8 +563,8 @@ class LLMEngine:
                         stepped.append(w.seq)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
-            if self._spec_enabled and len(seqs) == 1:
-                spec = self._try_spec_decode(seqs[0])
+            if self._spec_enabled:
+                spec = self._try_spec_decode_batch(seqs)
                 if spec is not None:
                     stepped.extend(spec)
                     outputs.extend(self._finalize_stepped(stepped))
@@ -656,12 +654,17 @@ class LLMEngine:
         return outputs
 
     # -- speculative decoding (prompt-lookup n-gram drafts) ----------------
+    # haystack bound for prompt-lookup: the scan runs per lane per step
+    # on the step-loop critical path, so cap it to a recent suffix —
+    # beyond this, matches are stale context anyway
+    NGRAM_SCAN_WINDOW = 8192
+
     def _ngram_drafts(self, seq: Sequence, k: int) -> list[int]:
         """Draft tokens from the LAST previous occurrence of the
         context's trailing n-gram (vLLM's ngram prompt-lookup role): no
         draft model, pure host-side memory of the sequence itself —
         strongest on repetitive/structured text."""
-        context = seq.all_token_ids
+        context = seq.all_token_ids[-self.NGRAM_SCAN_WINDOW:]
         arr = np.asarray(context, np.int32)
         cfg = self.config
         for n in range(cfg.ngram_prompt_lookup_max,
@@ -677,67 +680,98 @@ class LLMEngine:
                 return [int(t) for t in context[i + n: i + n + k]]
         return []
 
-    def _try_spec_decode(self, seq: Sequence) -> list[Sequence] | None:
-        """One speculative round for a lone decode lane; returns the
-        stepped list, or None to fall back to the normal decode path.
-        Outputs are bit-identical to plain greedy decode: every accepted
-        draft equals the argmax the verify forward computed for its
-        position, exactly what sequential steps would have sampled."""
-        sp = seq.sampling_params
-        if (
-            sp.temperature != 0.0
-            or sp.logprobs is not None
-            or sp.guided_choice is not None
-            or sp.presence_penalty != 0.0
-            or sp.frequency_penalty != 0.0
-            or sp.repetition_penalty != 1.0
-        ):
+    def _try_spec_decode_batch(
+        self, seqs: list[Sequence]
+    ) -> list[Sequence] | None:
+        """One speculative round over the whole decode batch; returns
+        the stepped list, or None to fall back to the normal path.
+
+        All lanes' draft chunks [last_token, d_1..d_k_i] (ragged per
+        lane; zero-draft lanes feed just their last token) verify in ONE
+        packed forward, and every row is sampled ON DEVICE with the key
+        autoregressive decode would have used — the engine's keys depend
+        only on (seed, generated_len), so acceptance-by-equality keeps
+        outputs bit-identical to sequential decode at ANY temperature,
+        not just greedy (parity asserted by tests/test_spec_decode.py).
+        Eligibility is whole-batch: lanes needing per-step host logits
+        (logprobs, guided masks, logit penalties) fall the batch back to
+        the normal path."""
+        for s in seqs:
+            sp = s.sampling_params
+            if (
+                sp.logprobs is not None
+                or sp.guided_choice is not None
+                or sp.presence_penalty != 0.0
+                or sp.frequency_penalty != 0.0
+                or sp.repetition_penalty != 1.0
+            ):
+                return None
+        k_cfg = self.config.num_speculative_tokens
+        drafts_by_lane: list[list[int]] = []
+        any_drafts = False
+        for s in seqs:
+            n0 = s.num_tokens
+            # drafts must fit the KV layout and the generation budget
+            k = min(
+                k_cfg,
+                self.scheduler.config.max_model_len - n0,
+                s.sampling_params.max_tokens
+                - len(s.generated_token_ids) - 1,
+                # verify feeds k+1 tokens through the prefill buckets
+                self.config.max_prefill_chunk - 1,
+            )
+            d = self._ngram_drafts(s, k) if k > 0 else []
+            if d and not self.block_manager.ensure_capacity(
+                n0 + len(d), s.block_table
+            ):
+                d = []  # no room to grow: this lane rides draft-free
+            drafts_by_lane.append(d)
+            any_drafts = any_drafts or bool(d)
+        if not any_drafts:
             return None
-        k = self.config.num_speculative_tokens
-        n0 = seq.num_tokens
-        # drafts must fit the KV layout and the generation budget
-        k = min(
-            k,
-            self.scheduler.config.max_model_len - n0,
-            sp.max_tokens - len(seq.generated_token_ids) - 1,
-            # verify feeds k+1 tokens through the prefill buckets
-            self.config.max_prefill_chunk - 1,
+        chunks = [
+            [s.all_token_ids[-1]] + d
+            for s, d in zip(seqs, drafts_by_lane)
+        ]
+        temps, top_ps, top_ks, _keys, _pen = self._sampling_arrays(seqs)
+        seeds = np.asarray(
+            [self._seq_seed(s) & 0xFFFFFFFF for s in seqs], np.uint32
         )
-        if k <= 0:
-            return None
-        drafts = self._ngram_drafts(seq, k)
-        if not drafts:
-            return None
-        if not self.block_manager.ensure_capacity(
-            n0 + len(drafts), seq.block_table
-        ):
-            return None  # needs preemption: let schedule() handle it
-        tokens = [seq.all_token_ids[-1]] + drafts
-        greedy = self.runner.greedy_verify(
-            tokens,
-            start_pos=n0 - 1,
-            block_table=seq.block_table,
-            total_len=n0 - 1 + len(tokens),
-            lora_slot=self._lora_slot(seq),
+        starts = np.asarray(
+            [len(s.generated_token_ids) for s in seqs], np.int64
         )
-        accepted = 0
-        for i, d in enumerate(drafts):
-            if int(greedy[i]) == d:
-                accepted += 1
-            else:
-                break
-        self._spec_drafts_total += len(drafts)
-        self._spec_accepted_total += accepted
-        # accepted drafts + the verify forward's own next token (the
-        # correction on mismatch, the bonus token on full acceptance)
-        new_tokens = drafts[:accepted] + [int(greedy[accepted])]
-        for t in new_tokens:
-            if seq.finished:
-                break  # EOS/stop fired mid-acceptance; drop the rest
-            seq.num_computed_tokens = seq.num_tokens
-            self._append_token(seq, int(t))
+        sampled = self.runner.verify_batch(
+            chunks,
+            start_positions=[s.num_tokens - 1 for s in seqs],
+            block_tables=[s.block_table for s in seqs],
+            total_lens=[
+                s.num_tokens - 1 + len(c) for s, c in zip(seqs, chunks)
+            ],
+            row_sampling=(temps, top_ps, top_ks, seeds, starts),
+            lora_slots=[self._lora_slot(s) for s in seqs],
+        )
+        stepped: list[Sequence] = []
+        for i, (seq, drafts) in enumerate(zip(seqs, drafts_by_lane)):
+            row = sampled[i]
+            accepted = 0
+            for d in drafts:
+                if int(row[accepted]) == d:
+                    accepted += 1
+                else:
+                    break
+            self._spec_drafts_total += len(drafts)
+            self._spec_accepted_total += accepted
+            # accepted drafts + the verify forward's own next token (the
+            # correction on mismatch, the bonus token on full acceptance)
+            new_tokens = drafts[:accepted] + [int(row[accepted])]
+            for t in new_tokens:
+                if seq.finished:
+                    break  # EOS/stop fired mid-acceptance; drop the rest
+                seq.num_computed_tokens = seq.num_tokens
+                self._append_token(seq, int(t))
+            stepped.append(seq)
         self.last_step_kind = "decode"
-        return [seq]
+        return stepped
 
     def _finalize_stepped(
         self, stepped: list[Sequence]
@@ -781,16 +815,19 @@ class LLMEngine:
                 or sp.repetition_penalty != 1.0
             ):
                 needs_penalties = True
-            seed = (
-                sp.seed
-                if sp.seed is not None
-                else (self.config.seed ^ (hash(s.request_id) & 0x7FFFFFFF))
-            )
             keys[i] = (
-                np.uint32(seed & 0xFFFFFFFF),
+                np.uint32(self._seq_seed(s) & 0xFFFFFFFF),
                 np.uint32(len(s.generated_token_ids)),
             )
         return temps, top_ps, top_ks, keys, needs_penalties
+
+    def _seq_seed(self, s: Sequence) -> int:
+        sp = s.sampling_params
+        return (
+            sp.seed
+            if sp.seed is not None
+            else (self.config.seed ^ (hash(s.request_id) & 0x7FFFFFFF))
+        )
 
     # -- structured output (guided_choice) ---------------------------------
     def _guided_allowed(self, seq: Sequence) -> set[int] | None:

@@ -200,7 +200,7 @@ class ModelRunner:
 
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
-        self._verify_fns: dict[tuple[int, int], object] = {}
+        self._verify_batch_fns: dict[tuple[int, int, int], object] = {}
         self._prefill_batch_fns: dict[tuple[int, int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
         self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
@@ -433,94 +433,195 @@ class ModelRunner:
         return jax.jit(step, donate_argnums=(1, 2),
                        **self._step_jit_kwargs(2))
 
-    def _build_verify(self, t_pad: int, c_pad: int):
-        """Speculative-decoding verification: one prefill-shaped forward
-        over [last_token, draft_1..draft_k] that returns the GREEDY next
-        token for EVERY row (the drafts' acceptance references), instead
-        of just the last row. KV for all fed rows is written; the host
-        advances num_computed only over accepted positions, and rejected
-        rows' garbage KV sits beyond every reader's context length until
-        real tokens overwrite it."""
-        mc = self.model_config
-        attn = self._prefill_attn_closure()
+    def _build_verify_batch(self, s_pad: int, t_pad: int, c_pad: int):
+        """Batched speculative verification: s_pad lanes' draft chunks
+        [last_token, d_1..d_k] run in ONE packed prefill-shaped forward,
+        and EVERY row is sampled on device with its own PRNG key.
 
-        def step(params, kc, vc, tokens, positions, write_slots,
-                 gather_slots, total_len, lora=None, lora_slots=None):
+        Because the engine's sampling keys depend only on
+        (seed, generated_len) — not on sampled history — row j of a lane
+        samples with the exact key autoregressive step j would have
+        used, so acceptance-by-equality yields outputs bit-identical to
+        sequential sampling at any temperature (greedy rows reduce to
+        argmax inside sample_tokens). The host fetches (s_pad*t_pad,)
+        int32 instead of per-row vocab logits."""
+        mc = self.model_config
+        from production_stack_tpu.engine.sampler import sample_tokens
+
+        attn = self._packed_attn_closure(s_pad, t_pad)
+
+        def step(params, kc, vc, tokens, positions, write_slots, tables,
+                 q_starts, total_lens, temps, top_ps, top_ks, keys,
+                 lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
-                gather_slots=gather_slots,
-                q_positions=positions,
-                total_len=total_len,
+                tables=tables,
+                q_starts=q_starts,
+                positions2d=positions.reshape(s_pad, t_pad),
+                total_lens=total_lens,
             )
             logits, kc, vc = llama.forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
-                logits_rows=jnp.arange(t_pad),
+                logits_rows=jnp.arange(s_pad * t_pad),
                 lora=lora, lora_slots=lora_slots,
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return greedy, kc, vc
+            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys)
+            return sampled, kc, vc
 
         return jax.jit(step, donate_argnums=(1, 2),
                        **self._step_jit_kwargs(1))
 
-    def greedy_verify(
+    def verify_batch(
         self,
-        token_ids: list[int],
-        start_pos: int,
-        block_table: list[int],
-        total_len: int,
-        lora_slot: int = 0,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+        row_sampling: tuple,
+        lora_slots: list[int] | None = None,
     ) -> np.ndarray:
-        """Run the verification forward; returns (len(token_ids),) int32
-        greedy next-token per row."""
-        t = len(token_ids)
-        (tokens, positions_dev, write_slots, gather_slots,
-         t_pad, c_pad) = self._prefill_host_prep(
-            token_ids, block_table, start_pos, total_len
+        """Run one packed verification forward over n lanes' draft
+        chunks; returns (n, t_pad) int32 — row (s, j) is the token the
+        seeded sampler picks from lane s's distribution after consuming
+        chunk row j. `row_sampling` = per-lane (temps, top_ps, top_ks,
+        seeds, key_starts) arrays; row j of lane s samples with key
+        (seeds[s], key_starts[s] + j), the key autoregressive step j
+        would use. KV for every fed row is written; rejected rows'
+        garbage KV sits beyond every reader's context length until real
+        tokens overwrite it."""
+        n = len(chunks)
+        (s_pad, t_pad, c_pad, tokens, positions_dev, write_slots,
+         q_starts, tl_full, tables) = self._packed_host_prep(
+            chunks, start_positions, block_tables, total_lens
         )
-        key = (t_pad, c_pad)
-        if key not in self._verify_fns:
-            logger.info("compiling verify step t=%d ctx=%d", t_pad, c_pad)
-            self._verify_fns[key] = self._build_verify(t_pad, c_pad)
-        fn = self._verify_fns[key]
-        lora_kw = {}
-        if self.lora_manager is not None:
-            lora_kw = {
-                "lora": self.lora_manager.buffers,
-                "lora_slots": jnp.int32(lora_slot),
-            }
-        greedy, self.k_cache, self.v_cache = fn(
+
+        # per-ROW sampling arrays, padded lane-major to (s_pad * t_pad,)
+        l_temps, l_top_ps, l_top_ks, l_seeds, l_starts = row_sampling
+        temps = np.zeros((s_pad, t_pad), np.float32)
+        top_ps = np.ones((s_pad, t_pad), np.float32)
+        top_ks = np.full((s_pad, t_pad), -1, np.int32)
+        keys = np.zeros((s_pad, t_pad, 2), np.uint32)
+        temps[:n] = np.asarray(l_temps, np.float32)[:, None]
+        top_ps[:n] = np.asarray(l_top_ps, np.float32)[:, None]
+        top_ks[:n] = np.asarray(l_top_ks, np.int32)[:, None]
+        keys[:n, :, 0] = np.asarray(l_seeds, np.uint32)[:, None]
+        keys[:n, :, 1] = (
+            np.asarray(l_starts, np.int64)[:, None]
+            + np.arange(t_pad, dtype=np.int64)[None, :]
+        ).astype(np.uint32)
+
+        key = (s_pad, t_pad, c_pad)
+        if key not in self._verify_batch_fns:
+            logger.info(
+                "compiling batched verify step s=%d t=%d ctx=%d",
+                s_pad, t_pad, c_pad,
+            )
+            self._verify_batch_fns[key] = self._build_verify_batch(
+                s_pad, t_pad, c_pad
+            )
+        fn = self._verify_batch_fns[key]
+        lora_kw = self._packed_lora_kwargs(lora_slots, n, s_pad, t_pad)
+        sampled, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions_dev),
-            jnp.asarray(write_slots),
-            jnp.asarray(gather_slots),
-            jnp.int32(total_len),
+            jnp.asarray(tokens.reshape(-1)),
+            jnp.asarray(positions_dev.reshape(-1)),
+            jnp.asarray(write_slots.reshape(-1)),
+            jnp.asarray(tables),
+            jnp.asarray(q_starts),
+            jnp.asarray(tl_full),
+            jnp.asarray(temps.reshape(-1)),
+            jnp.asarray(top_ps.reshape(-1)),
+            jnp.asarray(top_ks.reshape(-1)),
+            jnp.asarray(keys.reshape(-1, 2)),
             **lora_kw,
         )
-        return np.asarray(greedy)[:t]
+        return np.asarray(sampled).reshape(s_pad, t_pad)[:n]
 
-    def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
-        """Packed cross-sequence prefill: chunks from s_pad sequences run
-        in ONE device program (one dispatch instead of s_pad — burst-TTFT
-        fix; reference capability bar is vLLM's batched chunked prefill,
-        reference: helm/templates/deployment-vllm-multi.yaml:140-146).
+    def _packed_host_prep(
+        self,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+    ):
+        """Host-side packing shared by prefill_batch and verify_batch:
+        bucket n ragged chunks to (s_pad, t_pad), build per-row
+        positions/write-slots (padded rows park at position 0 writing
+        the trash slot) and per-lane attention tables for the active
+        impl. Returns (s_pad, t_pad, c_pad, tokens, positions_dev,
+        write_slots, q_starts, tl_full, tables)."""
+        n = len(chunks)
+        s_pad = next_pow2(max(n, 1))
+        t_pad = self._prefill_bucket(max(len(c) for c in chunks))
+        c_pad = max(self._ctx_bucket(tl) for tl in total_lens)
 
-        The flat token axis carries the s_pad chunks back to back
-        (row s*t_pad + r is row r of chunk s): the embedding, projections,
-        MLP, and cache scatters are already per-token, so they batch for
-        free on the MXU; only attention needs per-sequence handling. The
-        Pallas path unrolls the hardware-validated single-sequence kernel
-        s_pad times inside the jitted step — TPU grid programs run
-        sequentially on the core anyway, so this matches a batched-grid
-        kernel's schedule without forking a second Mosaic kernel."""
+        tokens = np.zeros((s_pad, t_pad), dtype=np.int32)
+        positions = np.full((s_pad, t_pad), -1, dtype=np.int32)
+        write_slots = np.zeros((s_pad, t_pad), dtype=np.int32)
+        q_starts = np.zeros((s_pad,), dtype=np.int32)
+        tl_full = np.ones((s_pad,), dtype=np.int32)
+        for s, (ids, start) in enumerate(zip(chunks, start_positions)):
+            t = len(ids)
+            tokens[s, :t] = ids
+            positions[s, :t] = np.arange(start, start + t)
+            write_slots[s] = self._slots_for_positions(
+                block_tables[s], positions[s]
+            )
+            q_starts[s] = start
+            tl_full[s] = total_lens[s]
+        # padded rows/sequences: position -1 -> rope of position 0, write
+        # to the trash slot; their attention output is never read
+        positions_dev = np.where(positions < 0, 0, positions).astype(
+            np.int32
+        )
+        if self.attention_impl == "pallas":
+            n_pages = c_pad // self.block_size
+            tables = np.stack([
+                self._padded_block_table(
+                    block_tables[s] if s < n else [], n_pages
+                )
+                for s in range(s_pad)
+            ])
+        else:
+            tables = np.zeros((s_pad, c_pad), dtype=np.int32)
+            for s in range(n):
+                tables[s] = self._gather_slots_for_table(
+                    block_tables[s], c_pad
+                )
+        return (s_pad, t_pad, c_pad, tokens, positions_dev, write_slots,
+                q_starts, tl_full, tables)
+
+    def _packed_lora_kwargs(
+        self, lora_slots, n: int, s_pad: int, t_pad: int
+    ) -> dict:
+        """Uniform-adapter fast path vs per-token slot vector, shared by
+        the packed prefill/verify entries."""
+        if self.lora_manager is None:
+            return {}
+        slots = lora_slots if lora_slots is not None else [0] * n
+        if len(set(slots)) <= 1:
+            # whole group shares one adapter: uniform fast path
+            slots_arg = jnp.int32(slots[0] if slots else 0)
+        else:
+            per_tok = np.zeros((s_pad, t_pad), dtype=np.int32)
+            for s, slot in enumerate(slots):
+                per_tok[s] = slot
+            slots_arg = jnp.asarray(per_tok.reshape(-1))
+        return {
+            "lora": self.lora_manager.buffers,
+            "lora_slots": slots_arg,
+        }
+
+    def _packed_attn_closure(self, s_pad: int, t_pad: int):
+        """Attention over s_pad back-to-back chunks on one flat token
+        axis (row s*t_pad + r is row r of chunk s) — shared by the
+        packed-prefill and batched-verify builders."""
         mc = self.model_config
         scale = self._scale
-        from production_stack_tpu.engine.sampler import sample_tokens
 
         if self.attention_impl == "pallas":
             from production_stack_tpu.ops import pallas_attention
@@ -569,6 +670,27 @@ class ModelRunner:
                 return out.reshape(
                     s_pad * t_pad, mc.num_heads, mc.head_dim
                 )
+
+        return attn
+
+    def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
+        """Packed cross-sequence prefill: chunks from s_pad sequences run
+        in ONE device program (one dispatch instead of s_pad — burst-TTFT
+        fix; reference capability bar is vLLM's batched chunked prefill,
+        reference: helm/templates/deployment-vllm-multi.yaml:140-146).
+
+        The flat token axis carries the s_pad chunks back to back
+        (row s*t_pad + r is row r of chunk s): the embedding, projections,
+        MLP, and cache scatters are already per-token, so they batch for
+        free on the MXU; only attention needs per-sequence handling. The
+        Pallas path unrolls the hardware-validated single-sequence kernel
+        s_pad times inside the jitted step — TPU grid programs run
+        sequentially on the core anyway, so this matches a batched-grid
+        kernel's schedule without forking a second Mosaic kernel."""
+        mc = self.model_config
+        from production_stack_tpu.engine.sampler import sample_tokens
+
+        attn = self._packed_attn_closure(s_pad, t_pad)
 
         def step(params, kc, vc, tokens, positions, write_slots, tables,
                  q_starts, total_lens, last_rows, temps, top_ps, top_ks,
@@ -891,47 +1013,15 @@ class ModelRunner:
         for penalty/debug paths (rows >= n are padding). K/V for every
         chunk is written into the cache."""
         n = len(chunks)
-        s_pad = next_pow2(max(n, 1))
-        t_pad = self._prefill_bucket(max(len(c) for c in chunks))
-        c_pad = max(self._ctx_bucket(tl) for tl in total_lens)
-
-        tokens = np.zeros((s_pad, t_pad), dtype=np.int32)
-        positions = np.full((s_pad, t_pad), -1, dtype=np.int32)
-        write_slots = np.zeros((s_pad, t_pad), dtype=np.int32)
-        q_starts = np.zeros((s_pad,), dtype=np.int32)
-        tl_full = np.ones((s_pad,), dtype=np.int32)
+        (s_pad, t_pad, c_pad, tokens, positions_dev, write_slots,
+         q_starts, tl_full, tables) = self._packed_host_prep(
+            chunks, start_positions, block_tables, total_lens
+        )
         last_rows = np.zeros((s_pad,), dtype=np.int32)
-        for s, (ids, start) in enumerate(zip(chunks, start_positions)):
-            t = len(ids)
-            tokens[s, :t] = ids
-            positions[s, :t] = np.arange(start, start + t)
-            write_slots[s] = self._slots_for_positions(
-                block_tables[s], positions[s]
-            )
-            q_starts[s] = start
-            tl_full[s] = total_lens[s]
-            last_rows[s] = s * t_pad + (t - 1)
+        for s, ids in enumerate(chunks):
+            last_rows[s] = s * t_pad + (len(ids) - 1)
         for s in range(n, s_pad):
             last_rows[s] = s * t_pad
-        # padded rows/sequences: position -1 -> rope of position 0, write
-        # to the trash slot; their attention output is never read
-        positions_dev = np.where(positions < 0, 0, positions).astype(
-            np.int32
-        )
-        if self.attention_impl == "pallas":
-            n_pages = c_pad // self.block_size
-            tables = np.stack([
-                self._padded_block_table(
-                    block_tables[s] if s < n else [], n_pages
-                )
-                for s in range(s_pad)
-            ])
-        else:
-            tables = np.zeros((s_pad, c_pad), dtype=np.int32)
-            for s in range(n):
-                tables[s] = self._gather_slots_for_table(
-                    block_tables[s], c_pad
-                )
 
         key = (s_pad, t_pad, c_pad)
         if key not in self._prefill_batch_fns:
@@ -943,21 +1033,7 @@ class ModelRunner:
                 s_pad, t_pad, c_pad
             )
         fn = self._prefill_batch_fns[key]
-        lora_kw = {}
-        if self.lora_manager is not None:
-            slots = lora_slots if lora_slots is not None else [0] * n
-            if len(set(slots)) <= 1:
-                # whole group shares one adapter: uniform fast path
-                slots_arg = jnp.int32(slots[0] if slots else 0)
-            else:
-                per_tok = np.zeros((s_pad, t_pad), dtype=np.int32)
-                for s, slot in enumerate(slots):
-                    per_tok[s] = slot
-                slots_arg = jnp.asarray(per_tok.reshape(-1))
-            lora_kw = {
-                "lora": self.lora_manager.buffers,
-                "lora_slots": slots_arg,
-            }
+        lora_kw = self._packed_lora_kwargs(lora_slots, n, s_pad, t_pad)
         temps, top_ps, top_ks, keys = self._sampling_args(s_pad, sampling)
         sampled, logits, self.k_cache, self.v_cache = fn(
             self.params,

@@ -15,10 +15,11 @@ follower hosts run `follower_loop`, replaying each descriptor against
 their local ModelRunner so all hosts issue identical device programs in
 identical order (the SPMD contract).
 
-v1 scope (documented, loudly enforced in config validation below):
-base-model serving only — KV offload tiers, PD transfer, LoRA hot-load
-and /v1/embeddings are single-host features for now (each needs its own
-broadcast/addressability story).
+Scope (documented, loudly enforced in config validation below):
+base-model serving, /v1/embeddings, and speculative decoding (embed and
+verify_batch steps broadcast like decode) — KV offload tiers, PD
+transfer, and LoRA hot-load remain single-host features for now (each
+needs its own broadcast/addressability story).
 """
 
 from __future__ import annotations
@@ -165,10 +166,38 @@ class BroadcastingRunner:
             penalties=penalties, want_logprobs=want_logprobs,
         )
 
-    def embed(self, *a, **kw):
-        raise NotImplementedError(
-            "/v1/embeddings is not yet supported in multihost mode"
+    def verify_batch(self, chunks, start_positions, block_tables,
+                     total_lens, row_sampling, lora_slots=None):
+        temps, top_ps, top_ks, seeds, starts = row_sampling
+        msg = {
+            "kind": "verify_batch",
+            "chunks": [[int(t) for t in c] for c in chunks],
+            "start_positions": [int(p) for p in start_positions],
+            "block_tables": [[int(b) for b in t] for t in block_tables],
+            "total_lens": [int(t) for t in total_lens],
+            "row_sampling": [
+                np.asarray(temps, np.float32).tolist(),
+                np.asarray(top_ps, np.float32).tolist(),
+                np.asarray(top_ks, np.int32).tolist(),
+                np.asarray(seeds, np.uint32).tolist(),
+                np.asarray(starts, np.int64).tolist(),
+            ],
+        }
+        if lora_slots is not None:
+            msg["lora_slots"] = [int(s) for s in lora_slots]
+        self._bc.publish(msg)
+        return self._runner.verify_batch(
+            chunks, start_positions, block_tables, total_lens,
+            row_sampling=row_sampling, lora_slots=lora_slots,
         )
+
+    def embed(self, token_ids, lora_slot=0):
+        self._bc.publish({
+            "kind": "embed",
+            "token_ids": [int(t) for t in token_ids],
+            "lora_slot": int(lora_slot),
+        })
+        return self._runner.embed(token_ids, lora_slot=lora_slot)
 
     def shutdown_followers(self) -> None:
         self._bc.publish({"kind": "shutdown"})
@@ -218,5 +247,17 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
                     np.asarray(pen["rep"], np.float32),
                 )
             runner.decode_multi(**msg)
+        elif kind == "verify_batch":
+            rs = msg.pop("row_sampling")
+            msg["row_sampling"] = (
+                np.asarray(rs[0], np.float32),
+                np.asarray(rs[1], np.float32),
+                np.asarray(rs[2], np.int32),
+                np.asarray(rs[3], np.uint32),
+                np.asarray(rs[4], np.int64),
+            )
+            runner.verify_batch(**msg)
+        elif kind == "embed":
+            runner.embed(**msg)
         else:  # future step kinds must fail loudly, not silently desync
             raise RuntimeError(f"unknown multihost step kind {kind!r}")

@@ -18,6 +18,10 @@ import json
 import os
 from dataclasses import dataclass
 
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -257,6 +261,22 @@ def from_hf_config(path: str, name: str | None = None) -> ModelConfig:
     # also a no-op mask that would only cost us the paged-attention path.
     if not hf.get("use_sliding_window", True):
         window = None
+    # HF Qwen2 slides only layers >= max_window_layers; the shipped
+    # default (== num_hidden_layers) means NO layer slides. Mixed
+    # per-layer windows aren't representable here: all-full when no
+    # layer slides, else keep the window for every layer (the majority
+    # behavior) and say so.
+    mwl = hf.get("max_window_layers")
+    if window and mwl is not None:
+        if mwl >= hf["num_hidden_layers"]:
+            window = None
+        elif mwl > 0:
+            logger.warning(
+                "max_window_layers=%d < num_hidden_layers=%d: applying "
+                "sliding_window=%d to ALL layers (per-layer windows "
+                "unsupported); first %d layers will differ from HF",
+                mwl, hf["num_hidden_layers"], window, mwl,
+            )
     if window and window >= max_len:
         window = None
     act = hf.get("hidden_act") or hf.get("hidden_activation") or "silu"

@@ -57,10 +57,13 @@ ENGINE_CFG = EngineConfig(
     max_prefill_chunk=16,
     tensor_parallel_size=4,
     multihost=True,
+    # spec decode rides the broadcast protocol (verify_batch steps)
+    num_speculative_tokens=2,
     seed=0,
 )
 
-PROMPTS = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7, 6, 5]]
+# repetitive prompts so ngram prompt-lookup actually drafts
+PROMPTS = [[1, 2, 3, 1, 2, 3, 1], [9, 8, 7, 9, 8, 7, 9]]
 
 if pid == 0:
     from production_stack_tpu.engine.llm_engine import LLMEngine
@@ -71,8 +74,18 @@ if pid == 0:
         PROMPTS,
         SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
     )
+    # /v1/embeddings rides the broadcast protocol too (embed steps)
+    vec, n_toks = engine.embed_one("hello")
     engine.shutdown()
-    print("RESULT " + json.dumps([o.token_ids for o in outs]), flush=True)
+    print(
+        "RESULT " + json.dumps({
+            "tokens": [o.token_ids for o in outs],
+            "spec_drafts": engine._spec_drafts_total,
+            "embed_dim": len(vec),
+            "embed_norm": float((vec ** 2).sum()) ** 0.5,
+        }),
+        flush=True,
+    )
 else:
     from production_stack_tpu.engine.model_runner import ModelRunner
     from production_stack_tpu.engine.multihost_engine import follower_loop
