@@ -68,14 +68,48 @@ class AsyncLLMEngine:
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
                 continue
-            with self._lock:
-                busy = self.engine.has_unfinished()
-                outputs = self.engine.step() if busy else []
+            try:
+                with self._lock:
+                    busy = self.engine.has_unfinished()
+                    outputs = self.engine.step() if busy else []
+            except Exception:  # noqa: BLE001 — a step failure must fail
+                # the in-flight REQUESTS, not the serving thread: a dead
+                # step loop wedges every current and future request
+                logger.exception(
+                    "engine step failed; aborting in-flight requests"
+                )
+                outputs = self._fail_inflight()
+                busy = True
             if outputs and self._loop is not None:
                 self._loop.call_soon_threadsafe(self._deliver, outputs)
             if not busy:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+
+    def _fail_inflight(self) -> list[RequestOutput]:
+        """Abort every engine request and emit finished error outputs so
+        waiting streams terminate instead of hanging forever."""
+        from production_stack_tpu.engine.sequence import RequestMetrics
+
+        outs: list[RequestOutput] = []
+        with self._lock:
+            for request_id in list(self._streams):
+                try:
+                    self.engine.abort_request(request_id)
+                except Exception:  # noqa: BLE001 — state may be corrupt
+                    logger.exception("abort failed for %s", request_id)
+                outs.append(RequestOutput(
+                    request_id=request_id,
+                    prompt_token_ids=[],
+                    token_ids=[],
+                    new_token_ids=[],
+                    text="",
+                    delta_text="",
+                    finished=True,
+                    finish_reason="error",
+                    metrics=RequestMetrics(arrival_time=time.time()),
+                ))
+        return outs
 
     def _deliver(self, outputs: list[RequestOutput]) -> None:
         for out in outputs:

@@ -280,6 +280,26 @@ class LLMEngine:
                 raise ValueError("guided_choice entries must tokenize "
                                  "to at least one token")
             seq._guided_choices = choice_ids  # type: ignore[attr-defined]
+        if sp.guided_json is not None or sp.guided_regex is not None:
+            from production_stack_tpu.engine import structured
+
+            if self.tokenizer.eos_token_id is None:
+                # the mask offers EOS as the stop-here move at accepting
+                # states; without one a finished constraint would leave
+                # the lane unstoppable (and unmaskable at dead ends)
+                raise ValueError(
+                    "guided decoding requires a tokenizer with an EOS "
+                    "token"
+                )
+            # compile (or fetch cached) the constraint machine; schema/
+            # pattern errors surface here as ValueError -> HTTP 400
+            machine = structured.get_machine(
+                "json" if sp.guided_json is not None else "regex",
+                sp.guided_json if sp.guided_json is not None
+                else sp.guided_regex,
+            )
+            seq._guided_machine = machine  # type: ignore[attr-defined]
+            seq._guided_state = machine.initial()  # type: ignore[attr-defined]
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
 
@@ -520,7 +540,7 @@ class LLMEngine:
                 # non-empty at the "first" token) needs the logits.
                 def _needs_host_sample(s: Sequence) -> bool:
                     sp = s.sampling_params
-                    if sp.guided_choice is not None:
+                    if self._is_guided(s):
                         return True  # first token must be masked
                     return bool(s.generated_token_ids) and (
                         sp.presence_penalty != 0.0
@@ -575,11 +595,10 @@ class LLMEngine:
             ctx_lens = [s.num_tokens for s in seqs]
             k_steps = self.config.num_scheduler_steps
             # guided lanes need a host-side logit mask every token, so
-            # they ride the single-step path regardless of K
-            needs_guided = any(
-                getattr(s, "_guided_choices", None) is not None
-                for s in seqs
-            )
+            # they ride the single-step path regardless of K (the
+            # documented guided-vs-multistep cliff; masks are cached per
+            # machine state so steady-state cost is one dict lookup)
+            needs_guided = any(self._is_guided(s) for s in seqs)
             if k_steps > 1 and not needs_guided:
                 temps, top_ps, top_ks, keys, needs_pen = (
                     self._sampling_arrays(seqs)
@@ -700,7 +719,7 @@ class LLMEngine:
             sp = s.sampling_params
             if (
                 sp.logprobs is not None
-                or sp.guided_choice is not None
+                or self._is_guided(s)
                 or sp.presence_penalty != 0.0
                 or sp.frequency_penalty != 0.0
                 or sp.repetition_penalty != 1.0
@@ -829,10 +848,40 @@ class LLMEngine:
             else (self.config.seed ^ (hash(s.request_id) & 0x7FFFFFFF))
         )
 
-    # -- structured output (guided_choice) ---------------------------------
+    # -- structured output (guided choice/json/regex) ----------------------
+    @staticmethod
+    def _is_guided(seq: Sequence) -> bool:
+        return (
+            getattr(seq, "_guided_choices", None) is not None
+            or getattr(seq, "_guided_machine", None) is not None
+        )
+
+    def _mask_cache(self):
+        """Lazy per-engine vocab trie for constraint masking."""
+        mc = getattr(self, "_token_mask_cache", None)
+        if mc is None:
+            from production_stack_tpu.engine.structured import (
+                TokenMaskCache,
+            )
+
+            mc = TokenMaskCache(self.tokenizer)
+            self._token_mask_cache = mc
+        return mc
+
     def _guided_allowed(self, seq: Sequence) -> set[int] | None:
-        """Tokens that extend a still-matching choice, or None when the
-        sequence is unconstrained."""
+        """Tokens the constraint allows next, or None when the sequence
+        is unconstrained."""
+        machine = getattr(seq, "_guided_machine", None)
+        if machine is not None:
+            states = seq._guided_state
+            allowed = set(self._mask_cache().allowed(machine, states))
+            if machine.accepting(states) and seq.eos_token_id is not None:
+                allowed.add(int(seq.eos_token_id))
+            if not allowed and seq.eos_token_id is not None:
+                # dead end (should not happen for live machines): the
+                # only legal move is to stop
+                allowed.add(int(seq.eos_token_id))
+            return allowed
         choices = getattr(seq, "_guided_choices", None)
         if choices is None:
             return None
@@ -854,9 +903,7 @@ class LLMEngine:
 
     def _apply_guided_mask(self, seqs: list[Sequence], logits):
         """-inf everything outside each lane's allowed-token set."""
-        if not any(
-            getattr(s, "_guided_choices", None) is not None for s in seqs
-        ):
+        if not any(self._is_guided(s) for s in seqs):
             return logits
         logits = np.array(logits, np.float32, copy=True)
         for i, s in enumerate(seqs):
@@ -939,6 +986,18 @@ class LLMEngine:
             seq.metrics.first_token_time = time.time()
         seq.append_token(int(token))
         self._generation_tokens_total += 1
+        machine = getattr(seq, "_guided_machine", None)
+        if machine is not None and int(token) != (
+            seq.eos_token_id if seq.eos_token_id is not None else -1
+        ):
+            ts = self._mask_cache().token_str(int(token))
+            if ts:
+                ns = machine.step_str(seq._guided_state, ts)
+                if ns:
+                    seq._guided_state = ns  # type: ignore[attr-defined]
+                # empty set = the token strayed off-machine (only
+                # possible via an unmasked path); freeze the state so
+                # masking stays well-defined
         if seq.sampling_params.logprobs is not None:
             entries = getattr(seq, "_logprob_entries", None)
             if entries is None:

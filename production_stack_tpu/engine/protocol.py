@@ -50,9 +50,44 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
                 "guided_choice must be a non-empty list of non-empty "
                 "strings"
             )
+        guided_json = body.get("guided_json")
+        if guided_json is not None and not isinstance(
+            guided_json, (dict, str)
+        ):
+            raise ProtocolError(
+                "guided_json must be a JSON schema object or string"
+            )
+        guided_regex = body.get("guided_regex")
+        if guided_regex is not None and not isinstance(guided_regex, str):
+            raise ProtocolError("guided_regex must be a string")
+        # OpenAI response_format: json_object / json_schema map onto the
+        # same constraint machinery (vLLM accepts both spellings)
+        rf = body.get("response_format")
+        if isinstance(rf, dict) and rf.get("type") in (
+            "json_object", "json_schema"
+        ):
+            if guided_json is None and guided_choice is None and (
+                guided_regex is None
+            ):
+                if rf["type"] == "json_object":
+                    guided_json = {"type": "object"}
+                else:
+                    try:
+                        guided_json = rf["json_schema"]["schema"]
+                    except (KeyError, TypeError):
+                        raise ProtocolError(
+                            "response_format.json_schema.schema required"
+                        ) from None
+                    if not isinstance(guided_json, (dict, str)):
+                        raise ProtocolError(
+                            "response_format.json_schema.schema must be "
+                            "a JSON schema object"
+                        )
         return SamplingParams(
             logprobs=logprobs,
             guided_choice=guided_choice,
+            guided_json=guided_json,
+            guided_regex=guided_regex,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),

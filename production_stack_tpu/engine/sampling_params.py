@@ -25,8 +25,25 @@ class SamplingParams:
     # be exactly one of these strings — logits are masked to the tokens
     # that extend a still-matching choice
     guided_choice: list[str] | None = None
+    # structured output (vLLM guided_json / guided_regex roles): the
+    # generation must parse against this JSON schema (dict or JSON
+    # string; {} / True = any JSON value) / fully match this regex.
+    # Compiled to a character-level machine whose per-state token masks
+    # constrain sampling (engine/structured.py).
+    guided_json: dict | str | None = None
+    guided_regex: str | None = None
 
     def __post_init__(self) -> None:
+        n_guided = sum(
+            x is not None
+            for x in (self.guided_choice, self.guided_json,
+                      self.guided_regex)
+        )
+        if n_guided > 1:
+            raise ValueError(
+                "at most one of guided_choice / guided_json / "
+                "guided_regex may be set"
+            )
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if self.temperature < 0:

@@ -1,0 +1,912 @@
+"""Structured output: JSON-schema and regex constrained decoding.
+
+The role of vLLM's guided decoding backends (outlines/xgrammar wired
+through `guided_json` / `guided_regex` request fields; the reference
+stack forwards these to its engines — reference:
+src/vllm_router/services/request_service/request.py routes request
+bodies verbatim, tutorials use guided choice/JSON against them). Those
+backends are CUDA-era CPU libraries; this is a self-contained TPU-stack
+implementation built for the engine's host-side masking hook:
+
+- A **character-level machine** per constraint. JSON is not a regular
+  language, so `guided_json` compiles the schema to a lazily-expanded
+  pushdown automaton: a state is a frozenset of frame-stacks (subset
+  construction absorbs every ambiguity — optional properties, enum
+  alternation, number termination), each frame-stack an immutable tuple
+  whose head is a consuming frame (literal run, string body, escape,
+  number phase). Recursive schemas work naturally: $ref loops intern to
+  the same schema id, and stacks grow only as deep as the emitted JSON
+  actually nests. `guided_regex` compiles a practical regex subset to a
+  Thompson NFA driven through the same frozenset-of-states interface.
+
+- A **vocab trie x machine product** turns character machines into
+  token masks: walking the tokenizer's string trie in lockstep with the
+  machine visits exactly the viable token prefixes, so one walk yields
+  every allowed token id — including multi-part tokens like `"},` that
+  cross JSON structure boundaries. Allowed sets are memoized per
+  machine state; JSON's literal runs revisit few states, so steady
+  state is a dict lookup per step.
+
+Whitespace: generated JSON is canonical-compact (no inter-token
+whitespace). This keeps outputs short (TPU decode steps are the scarce
+resource) and matches what schema consumers parse.
+
+EOS: allowed exactly when the machine is in an accepting state; the
+engine adds it to the mask so generation can only stop on valid output.
+"""
+
+from __future__ import annotations
+
+import json
+
+# ---------------------------------------------------------------------------
+# JSON-schema machine
+
+
+_ANY = -1  # schema id for "any JSON value"
+
+# number phases that may also end the number (epsilon-pop)
+_NUM_POPPABLE = frozenset({"z", "idig", "fdig", "edig"})
+_NUM_DIGIT_CAP = 15  # digits per number part; floats lose precision past this
+_HEX = set("0123456789abcdefABCDEF")
+_DIGITS = set("0123456789")
+
+
+class JsonSchemaMachine:
+    """Character-level acceptor for canonical-compact JSON matching a
+    schema subset: object (properties/required, free-form when no
+    properties), array (items/minItems/maxItems), string (minLength/
+    maxLength), integer, number, boolean, null, enum, const,
+    anyOf/oneOf, type lists, local $ref (#/$defs, #/definitions), and
+    the empty schema (any value).
+    """
+
+    def __init__(self, schema: dict | bool):
+        if schema is True or schema == {}:
+            schema = {"__any__": True}
+        if schema is False:
+            raise ValueError("schema `false` matches nothing")
+        if not isinstance(schema, dict):
+            raise ValueError(
+                f"guided_json schema must be an object, got "
+                f"{type(schema).__name__}"
+            )
+        self._root_doc = schema
+        self._schemas: list[dict] = []
+        self._sid_by_obj: dict[int, int] = {}
+        root = self._intern(schema)
+        self._alts_cache: dict = {}
+        self._closure_cache: dict = {}
+        # eager validation: every reachable subschema's alternatives
+        # build NOW, so malformed constructs raise ValueError at request
+        # admission (HTTP 400), never inside the serving step loop
+        for sid in range(len(self._schemas)):
+            self._validate(self._schemas[sid])
+            self._value_alts(sid)
+        self._init = self._closure((("value", root),))
+
+    # -- schema interning ---------------------------------------------------
+    def _resolve_ref(self, sch: dict) -> dict:
+        seen = set()
+        while "$ref" in sch:
+            ref = sch["$ref"]
+            if not ref.startswith("#/"):
+                raise ValueError(f"only local $ref supported, got {ref!r}")
+            if ref in seen:
+                raise ValueError(f"$ref cycle through {ref!r}")
+            seen.add(ref)
+            node = self._root_doc
+            for part in ref[2:].split("/"):
+                part = part.replace("~1", "/").replace("~0", "~")
+                try:
+                    node = node[part]
+                except (KeyError, TypeError, IndexError):
+                    raise ValueError(
+                        f"unresolvable $ref {ref!r}"
+                    ) from None
+            sch = node
+        return sch
+
+    def _intern(self, sch: dict | bool) -> int:
+        if sch is True or sch == {}:
+            sch = {"__any__": True}
+        if sch is False:
+            raise ValueError("schema `false` matches nothing")
+        sch = self._resolve_ref(sch)
+        key = id(sch)
+        if key in self._sid_by_obj:
+            return self._sid_by_obj[key]
+        sid = len(self._schemas)
+        self._schemas.append(sch)
+        self._sid_by_obj[key] = sid
+        # intern children now so ids exist before first expansion
+        for sub in sch.get("anyOf", []) or sch.get("oneOf", []):
+            if not (sub is True or sub == {}):
+                self._intern(sub)
+        if "properties" in sch:
+            for sub in sch["properties"].values():
+                if not (sub is True or sub == {}):
+                    self._intern(sub)
+        items = sch.get("items")
+        if isinstance(items, dict) and items != {}:
+            self._intern(items)
+        return sid
+
+    def _sid_of(self, sch) -> int:
+        if sch is True or sch == {}:
+            return _ANY  # the any-value machine needs no interning
+        return self._sid_by_obj[id(self._resolve_ref(sch))]
+
+    @staticmethod
+    def _validate(sch: dict) -> None:
+        """Reject unsupported constructs with ValueError (-> HTTP 400)
+        instead of degrading silently or failing mid-decode."""
+        if "items" in sch:
+            items = sch["items"]
+            if isinstance(items, list):
+                raise ValueError(
+                    "tuple-form `items: [...]` (draft-07 positional "
+                    "validation) is not supported; use a single schema"
+                )
+            if items is False:
+                raise ValueError(
+                    "`items: false` is not supported; use maxItems: 0"
+                )
+            if not isinstance(items, (dict, bool)):
+                raise ValueError(f"bad items schema: {items!r}")
+        for key in ("minItems", "maxItems", "minLength", "maxLength"):
+            if key in sch and not isinstance(sch[key], int):
+                raise ValueError(f"{key} must be an integer")
+        props = sch.get("properties")
+        if props is not None and not isinstance(props, dict):
+            raise ValueError("properties must be an object")
+        if props:
+            for name, sub in props.items():
+                if not isinstance(sub, (dict, bool)):
+                    raise ValueError(
+                        f"property {name!r} schema must be an object"
+                    )
+        req = sch.get("required")
+        if req is not None and not isinstance(req, list):
+            raise ValueError("required must be a list")
+        for key in ("anyOf", "oneOf"):
+            subs = sch.get(key)
+            if subs is None:
+                continue
+            if not isinstance(subs, list) or not subs:
+                raise ValueError(f"{key} must be a non-empty list")
+            for sub in subs:
+                if not isinstance(sub, (dict, bool)):
+                    raise ValueError(f"{key} entries must be schemas")
+
+    # -- nonterminal expansion ---------------------------------------------
+    @staticmethod
+    def _lit(s: str) -> tuple:
+        return ("lit", s, 0)
+
+    def _value_alts(self, sid: int) -> list[tuple]:
+        """Alternative frame-tuples a ("value", sid) frame rewrites to."""
+        if sid in self._alts_cache:
+            return self._alts_cache[sid]
+        alts: list[tuple] = []
+        if sid == _ANY:
+            sch: dict = {"__any__": True}
+        else:
+            sch = self._schemas[sid]
+        if "const" in sch:
+            alts.append((self._lit(_cjson(sch["const"])),))
+        elif "enum" in sch:
+            for v in sch["enum"]:
+                alts.append((self._lit(_cjson(v)),))
+        elif "anyOf" in sch or "oneOf" in sch:
+            for sub in sch.get("anyOf", []) or sch.get("oneOf", []):
+                alts.append((("value", self._sid_of(sub)),))
+        elif "__any__" in sch:
+            alts += [
+                (self._lit('"'), ("sb", 0, None)),
+                (("num", "start", True, True, _NUM_DIGIT_CAP),),
+                (self._lit("true"),),
+                (self._lit("false"),),
+                (self._lit("null"),),
+                (self._lit("["), ("arrany", 0)),
+                (self._lit("{"), ("objany", 0)),
+            ]
+        else:
+            # a bare `properties` block implies type: object (common
+            # shorthand in the wild)
+            t = sch.get("type") or (
+                "object" if "properties" in sch else None
+            )
+            types = t if isinstance(t, list) else [t]
+            for ty in types:
+                if ty == "object":
+                    if sch.get("properties"):
+                        alts.append((self._lit("{"), ("obj", sid, 0, 0)))
+                    else:
+                        alts.append((self._lit("{"), ("objany", 0)))
+                elif ty == "array":
+                    alts.append((self._lit("["), ("arr", sid, 0)))
+                elif ty == "string":
+                    hi = sch.get("maxLength")
+                    alts.append((
+                        self._lit('"'),
+                        ("sb", int(sch.get("minLength", 0)),
+                         int(hi) if hi is not None else None),
+                    ))
+                elif ty in ("integer", "number"):
+                    isnum = ty == "number"
+                    alts.append(
+                        (("num", "start", isnum, isnum, _NUM_DIGIT_CAP),)
+                    )
+                elif ty == "boolean":
+                    alts.append((self._lit("true"),))
+                    alts.append((self._lit("false"),))
+                elif ty == "null":
+                    alts.append((self._lit("null"),))
+                elif ty is None:
+                    raise ValueError(
+                        f"schema needs type/enum/const/anyOf: {sch!r}"
+                    )
+                else:
+                    raise ValueError(f"unsupported type {ty!r}")
+        if not alts:
+            raise ValueError(f"schema matches nothing: {sch!r}")
+        self._alts_cache[sid] = alts
+        return alts
+
+    def _obj_alts(self, frame: tuple) -> list[tuple]:
+        _, sid, idx, emitted = frame
+        sch = self._schemas[sid]
+        props = list(sch["properties"].items())
+        required = set(sch.get("required", []))
+        alts: list[tuple] = []
+        if idx == len(props):
+            return [(self._lit("}"),)]
+        name, sub = props[idx]
+        sep = "," if emitted else ""
+        alts.append((
+            self._lit(sep + _cjson(name) + ":"),
+            ("value", self._sid_of(sub)),
+            ("obj", sid, idx + 1, 1),
+        ))
+        if name not in required:
+            alts.append((("obj", sid, idx + 1, emitted),))
+        return alts
+
+    def _arr_alts(self, frame: tuple) -> list[tuple]:
+        _, sid, count = frame
+        sch = self._schemas[sid]
+        items = sch.get("items", True)
+        items_sid = (
+            self._sid_of(items) if isinstance(items, (dict, bool)) else _ANY
+        )
+        mn = int(sch.get("minItems", 0))
+        mx = sch.get("maxItems")
+        alts: list[tuple] = []
+        if count >= mn:
+            alts.append((self._lit("]"),))
+        if mx is None or count < int(mx):
+            nxt = count + 1
+            if mx is None:
+                # beyond minItems the count no longer matters: clamp so
+                # unbounded arrays revisit one state per extra item
+                nxt = min(nxt, max(mn, 1))
+            item = (("value", items_sid), ("arr", sid, nxt))
+            alts.append(
+                item if count == 0 else (self._lit(","),) + item
+            )
+        return alts
+
+    def _objany_alts(self, frame: tuple) -> list[tuple]:
+        emitted = frame[1]
+        sep = "," if emitted else ""
+        return [
+            (self._lit("}"),),
+            (
+                self._lit(sep + '"'), ("sb", 0, None), self._lit(":"),
+                ("value", _ANY), ("objany", 1),
+            ),
+        ]
+
+    def _arrany_alts(self, frame: tuple) -> list[tuple]:
+        count = frame[1]
+        item = (("value", _ANY), ("arrany", 1))
+        return [
+            (self._lit("]"),),
+            item if count == 0 else (self._lit(","),) + item,
+        ]
+
+    # -- closure + stepping -------------------------------------------------
+    def _closure(self, *stacks: tuple) -> frozenset:
+        """Rewrite nonterminal heads until every member stack starts
+        with a consuming frame (or is the empty = accepting stack)."""
+        out: set[tuple] = set()
+        work = list(stacks)
+        seen: set[tuple] = set()
+        while work:
+            st = work.pop()
+            if st in seen:
+                continue
+            seen.add(st)
+            if not st:
+                out.add(st)
+                continue
+            head = st[0]
+            kind = head[0]
+            if kind in ("lit", "sb", "sbe", "sbu"):
+                out.add(st)
+            elif kind == "num":
+                out.add(st)
+                if head[1] in _NUM_POPPABLE:
+                    work.append(st[1:])  # the number may end here
+            elif kind == "value":
+                for alt in self._value_alts(head[1]):
+                    work.append(alt + st[1:])
+            elif kind == "obj":
+                for alt in self._obj_alts(head):
+                    work.append(alt + st[1:])
+            elif kind == "arr":
+                for alt in self._arr_alts(head):
+                    work.append(alt + st[1:])
+            elif kind == "objany":
+                for alt in self._objany_alts(head):
+                    work.append(alt + st[1:])
+            elif kind == "arrany":
+                for alt in self._arrany_alts(head):
+                    work.append(alt + st[1:])
+            else:  # pragma: no cover — frame kinds are closed above
+                raise AssertionError(f"unknown frame {head!r}")
+        return frozenset(out)
+
+    @staticmethod
+    def _step_consuming(st: tuple, ch: str) -> list[tuple]:
+        head, rest = st[0], st[1:]
+        kind = head[0]
+        if kind == "lit":
+            _, s, i = head
+            if ch != s[i]:
+                return []
+            return [rest] if i + 1 == len(s) else [(("lit", s, i + 1),) + rest]
+        if kind == "sb":
+            _, lo, hi = head
+            if ch == '"':
+                return [rest] if lo == 0 else []
+            if hi is not None and hi <= 0:
+                return []  # maxLength reached: only the close quote
+            nlo = lo - 1 if lo else 0
+            nhi = hi - 1 if hi is not None else None
+            if ch == "\\":
+                return [(("sbe", nlo, nhi),) + rest]
+            return [(("sb", nlo, nhi),) + rest] if ord(ch) >= 0x20 else []
+        if kind == "sbe":
+            _, lo, hi = head
+            if ch in '"\\/bfnrt':
+                return [(("sb", lo, hi),) + rest]
+            if ch == "u":
+                return [(("sbu", 4, lo, hi),) + rest]
+            return []
+        if kind == "sbu":
+            if ch not in _HEX:
+                return []
+            _, k, lo, hi = head
+            if k == 1:
+                return [(("sb", lo, hi),) + rest]
+            return [(("sbu", k - 1, lo, hi),) + rest]
+        # number phase machine; d = digits left in the current part
+        # (_NUM_DIGIT_CAP keeps an aimless model from spending its whole
+        # token budget on one literal — beyond float64 precision anyway)
+        _, phase, frac, exp, d = head
+
+        def ph(p: str, nd: int = _NUM_DIGIT_CAP) -> list[tuple]:
+            return [(("num", p, frac, exp, nd),) + rest]
+
+        if phase == "start":
+            if ch == "-":
+                return ph("istart")
+            if ch == "0":
+                return ph("z")
+            return ph("idig", d - 1) if ch in _DIGITS else []
+        if phase == "istart":
+            if ch == "0":
+                return ph("z")
+            return ph("idig", d - 1) if ch in _DIGITS else []
+        if phase in ("z", "idig"):
+            if phase == "idig" and ch in _DIGITS and d > 0:
+                return ph("idig", d - 1)
+            if ch == "." and frac:
+                return ph("dot")
+            if ch in "eE" and exp:
+                return ph("e0")
+            return []
+        if phase == "dot":
+            return ph("fdig", d - 1) if ch in _DIGITS else []
+        if phase == "fdig":
+            if ch in _DIGITS and d > 0:
+                return ph("fdig", d - 1)
+            return ph("e0") if (ch in "eE" and exp) else []
+        if phase == "e0":
+            if ch in "+-":
+                return ph("e1")
+            return ph("edig", 2) if ch in _DIGITS else []
+        if phase == "e1":
+            return ph("edig", 2) if ch in _DIGITS else []
+        if phase == "edig":
+            return ph("edig", d - 1) if (ch in _DIGITS and d > 0) else []
+        return []  # pragma: no cover
+
+    # -- public machine interface -------------------------------------------
+    def initial(self) -> frozenset:
+        return self._init
+
+    def step(self, states: frozenset, ch: str) -> frozenset:
+        nxt: list[tuple] = []
+        for st in states:
+            if st:
+                nxt.extend(self._step_consuming(st, ch))
+        if not nxt:
+            return frozenset()
+        key = (ch, states)
+        cached = self._closure_cache.get(key)
+        if cached is None:
+            cached = self._closure(*nxt)
+            self._closure_cache[key] = cached
+        return cached
+
+    def accepting(self, states: frozenset) -> bool:
+        return () in states
+
+    def step_str(self, states: frozenset, s: str) -> frozenset:
+        for ch in s:
+            if not states:
+                return states
+            states = self.step(states, ch)
+        return states
+
+
+def _cjson(v) -> str:
+    """Canonical-compact JSON rendering for literals."""
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+# ---------------------------------------------------------------------------
+# Regex machine (Thompson NFA, practical subset)
+
+
+class _RegexNode:
+    __slots__ = ("eps", "edges")
+
+    def __init__(self):
+        self.eps: list[int] = []  # epsilon successors
+        self.edges: list[tuple] = []  # (matcher, target)
+
+
+class RegexMachine:
+    """Whole-string regex acceptor over the machine interface.
+
+    Subset: literals, escapes (\\d \\D \\w \\W \\s \\S \\n \\t \\r and
+    escaped metachars), `.`, character classes `[...]` with ranges and
+    negation, groups `(...)` (non-capturing semantics), alternation
+    `|`, quantifiers `* + ? {m} {m,} {m,n}`. Anchors are implicit: the
+    pattern must match the ENTIRE generation (vLLM guided_regex
+    semantics)."""
+
+    _MAX_REPEAT = 256
+
+    def __init__(self, pattern: str):
+        self._nodes: list[_RegexNode] = []
+        self._pat = pattern
+        self._pos = 0
+        start, end = self._parse_alt()
+        if self._pos != len(pattern):
+            raise ValueError(
+                f"regex parse error at {self._pos}: {pattern!r}"
+            )
+        self._accept = end
+        self._init = self._eps_closure(frozenset({start}))
+
+    # -- NFA construction ---------------------------------------------------
+    def _new(self) -> int:
+        self._nodes.append(_RegexNode())
+        return len(self._nodes) - 1
+
+    def _peek(self) -> str | None:
+        return self._pat[self._pos] if self._pos < len(self._pat) else None
+
+    def _take(self) -> str:
+        ch = self._pat[self._pos]
+        self._pos += 1
+        return ch
+
+    def _parse_alt(self) -> tuple[int, int]:
+        s, e = self._parse_concat()
+        while self._peek() == "|":
+            self._take()
+            s2, e2 = self._parse_concat()
+            ns, ne = self._new(), self._new()
+            self._nodes[ns].eps += [s, s2]
+            self._nodes[e].eps.append(ne)
+            self._nodes[e2].eps.append(ne)
+            s, e = ns, ne
+        return s, e
+
+    def _parse_concat(self) -> tuple[int, int]:
+        s = e = self._new()
+        while True:
+            ch = self._peek()
+            if ch is None or ch in "|)":
+                return s, e
+            fs, fe = self._parse_repeat()
+            self._nodes[e].eps.append(fs)
+            e = fe
+
+    def _parse_repeat(self) -> tuple[int, int]:
+        s, e = self._parse_atom()
+        ch = self._peek()
+        if ch not in ("*", "+", "?", "{"):
+            return s, e
+        if ch == "{":
+            save = self._pos
+            self._take()
+            spec = ""
+            while self._peek() is not None and self._peek() != "}":
+                spec += self._take()
+            if self._peek() != "}" or not _valid_repeat(spec):
+                # literal brace, not a quantifier
+                self._pos = save
+                return s, e
+            self._take()
+            lo, hi = _parse_repeat_spec(spec, self._MAX_REPEAT)
+            return self._repeat(s, e, lo, hi)
+        self._take()
+        if ch == "*":
+            return self._repeat(s, e, 0, None)
+        if ch == "+":
+            return self._repeat(s, e, 1, None)
+        return self._repeat(s, e, 0, 1)
+
+    def _repeat(
+        self, s: int, e: int, lo: int, hi: int | None
+    ) -> tuple[int, int]:
+        """Expand bounded repeats by copying; `hi=None` loops the last."""
+        frag = self._extract(s, e)
+        ns = cur = self._new()
+        for _ in range(lo):
+            fs, fe = self._paste(frag)
+            self._nodes[cur].eps.append(fs)
+            cur = fe
+        ne = self._new()
+        if hi is None:
+            fs, fe = self._paste(frag)
+            self._nodes[cur].eps += [fs, ne]
+            self._nodes[fe].eps += [fs, ne]
+        else:
+            self._nodes[cur].eps.append(ne)
+            for _ in range(hi - lo):
+                fs, fe = self._paste(frag)
+                self._nodes[cur].eps.append(fs)
+                self._nodes[fe].eps.append(ne)
+                cur = fe
+        return ns, ne
+
+    def _extract(self, s: int, e: int):
+        """Snapshot the fragment rooted at s..e for copying."""
+        reach = set()
+        stack = [s]
+        while stack:
+            n = stack.pop()
+            if n in reach:
+                continue
+            reach.add(n)
+            nd = self._nodes[n]
+            for t in nd.eps:
+                stack.append(t)
+            for _, t in nd.edges:
+                stack.append(t)
+        return (sorted(reach), s, e)
+
+    def _paste(self, frag) -> tuple[int, int]:
+        nodes, s, e = frag
+        remap = {n: self._new() for n in nodes}
+        for n in nodes:
+            nd = self._nodes[n]
+            cp = self._nodes[remap[n]]
+            cp.eps = [remap[t] for t in nd.eps if t in remap]
+            cp.edges = [(m, remap[t]) for m, t in nd.edges if t in remap]
+        return remap[s], remap[e]
+
+    def _parse_atom(self) -> tuple[int, int]:
+        ch = self._take()
+        s, e = self._new(), self._new()
+        if ch == "(":
+            if self._pat[self._pos:self._pos + 2] == "?:":
+                self._pos += 2
+            gs, ge = self._parse_alt()
+            if self._peek() != ")":
+                raise ValueError("unclosed group")
+            self._take()
+            self._nodes[s].eps.append(gs)
+            self._nodes[ge].eps.append(e)
+            return s, e
+        if ch == "[":
+            matcher = self._parse_class()
+        elif ch == ".":
+            matcher = ("dot",)
+        elif ch == "\\":
+            matcher = _escape_matcher(self._take())
+        elif ch in "*+?{":
+            # bare quantifier chars at atom position: treat { literally
+            if ch == "{":
+                matcher = ("ch", "{")
+            else:
+                raise ValueError(f"dangling quantifier {ch!r}")
+        else:
+            matcher = ("ch", ch)
+        self._nodes[s].edges.append((matcher, e))
+        return s, e
+
+    def _parse_class(self) -> tuple:
+        negate = False
+        if self._peek() == "^":
+            self._take()
+            negate = True
+        items: list[tuple] = []
+        first = True
+        while True:
+            ch = self._peek()
+            if ch is None:
+                raise ValueError("unclosed character class")
+            if ch == "]" and not first:
+                self._take()
+                break
+            first = False
+            ch = self._take()
+            if ch == "\\":
+                items.append(_escape_matcher(self._take()))
+                continue
+            if (
+                self._peek() == "-"
+                and self._pos + 1 < len(self._pat)
+                and self._pat[self._pos + 1] != "]"
+            ):
+                self._take()
+                hi = self._take()
+                if hi == "\\":
+                    hi = self._take()
+                items.append(("range", ch, hi))
+            else:
+                items.append(("ch", ch))
+        return ("class", negate, tuple(items))
+
+    # -- machine interface --------------------------------------------------
+    def _eps_closure(self, states: frozenset) -> frozenset:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            n = stack.pop()
+            for t in self._nodes[n].eps:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    def initial(self) -> frozenset:
+        return self._init
+
+    def step(self, states: frozenset, ch: str) -> frozenset:
+        nxt = set()
+        for n in states:
+            for matcher, t in self._nodes[n].edges:
+                if _matches(matcher, ch):
+                    nxt.add(t)
+        if not nxt:
+            return frozenset()
+        return self._eps_closure(frozenset(nxt))
+
+    def accepting(self, states: frozenset) -> bool:
+        return self._accept in states
+
+    def step_str(self, states: frozenset, s: str) -> frozenset:
+        for ch in s:
+            if not states:
+                return states
+            states = self.step(states, ch)
+        return states
+
+
+def _valid_repeat(spec: str) -> bool:
+    parts = spec.split(",")
+    if len(parts) == 1:
+        return parts[0].isdigit()
+    if len(parts) == 2:
+        return parts[0].isdigit() and (parts[1] == "" or parts[1].isdigit())
+    return False
+
+
+def _parse_repeat_spec(spec: str, cap: int) -> tuple[int, int | None]:
+    parts = spec.split(",")
+    lo = int(parts[0])
+    if len(parts) == 1:
+        hi: int | None = lo
+    else:
+        hi = int(parts[1]) if parts[1] else None
+    if lo > cap or (hi is not None and hi > cap):
+        raise ValueError(f"repeat bound above {cap}: {{{spec}}}")
+    if hi is not None and hi < lo:
+        raise ValueError(f"bad repeat {{{spec}}}")
+    return lo, hi
+
+
+def _escape_matcher(ch: str) -> tuple:
+    simple = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v",
+              "0": "\0"}
+    if ch in simple:
+        return ("ch", simple[ch])
+    if ch in "dDwWsS":
+        return ("esc", ch)
+    return ("ch", ch)
+
+
+def _matches(matcher: tuple, ch: str) -> bool:
+    kind = matcher[0]
+    if kind == "ch":
+        return ch == matcher[1]
+    if kind == "dot":
+        return ch != "\n"
+    if kind == "esc":
+        e = matcher[1]
+        if e == "d":
+            return ch.isdigit()
+        if e == "D":
+            return not ch.isdigit()
+        if e == "w":
+            return ch.isalnum() or ch == "_"
+        if e == "W":
+            return not (ch.isalnum() or ch == "_")
+        if e == "s":
+            return ch.isspace()
+        return not ch.isspace()  # S
+    if kind == "range":
+        return matcher[1] <= ch <= matcher[2]
+    # class
+    _, negate, items = matcher
+    hit = any(_matches(item, ch) for item in items)
+    return hit != negate
+
+
+# ---------------------------------------------------------------------------
+# Token masks: vocab trie x machine product
+
+
+class TokenMaskCache:
+    """Per-tokenizer vocab trie + per-(machine, state) allowed-token
+    memo. Built lazily on the first guided request; shared by every
+    request against the same engine."""
+
+    def __init__(self, tokenizer):
+        self._strs = _token_strings(tokenizer)
+        # trie nodes: dict char -> child; ids ending at a node under
+        # the int key 0 (chars are str keys, so no collision)
+        root: dict = {}
+        for tid, s in enumerate(self._strs):
+            if not s:
+                continue  # specials/unused ids never constrained-in
+            node = root
+            for ch in s:
+                node = node.setdefault(ch, {})
+            node.setdefault(0, []).append(tid)
+        self._root = root
+        # keyed by (machine, states) — the MACHINE OBJECT, not id():
+        # holding the reference prevents CPython id reuse from serving a
+        # dead machine's masks to a new one. LRU-bounded so a server
+        # cycling many schemas cannot grow this without bound.
+        from collections import OrderedDict
+
+        self._memo: OrderedDict = OrderedDict()
+        self._memo_cap = 4096
+
+    def token_str(self, token_id: int) -> str:
+        return self._strs[token_id]
+
+    def allowed(self, machine, states: frozenset) -> list[int]:
+        """Token ids whose string keeps the machine alive from
+        `states` — one trie x machine depth-first product walk."""
+        key = (machine, states)
+        hit = self._memo.get(key)
+        if hit is not None:
+            self._memo.move_to_end(key)
+            return hit
+        out: list[int] = []
+        stack: list[tuple[dict, frozenset]] = [(self._root, states)]
+        while stack:
+            node, sts = stack.pop()
+            for ch, child in node.items():
+                if ch == 0:
+                    out.extend(child)  # ids ending here: viable prefix
+                    continue
+                ns = machine.step(sts, ch)
+                if ns:
+                    stack.append((child, ns))
+        self._memo[key] = out
+        if len(self._memo) > self._memo_cap:
+            self._memo.popitem(last=False)
+        return out
+
+
+def _token_strings(tokenizer) -> list[str]:
+    """Best-effort per-token string table for the trie.
+
+    ByteTokenizer ids map exactly; HF tokenizers go through the
+    token-level vocabulary with GPT-2 byte-decoder / sentencepiece
+    metaspace normalization (the same approximation outlines-class
+    libraries make: constrained decoding operates on per-token strings,
+    the joint decode differing only for pathological tokenizers)."""
+    if hasattr(tokenizer, "token_strings"):
+        return tokenizer.token_strings()
+    inner = getattr(tokenizer, "_tok", None)
+    vocab = tokenizer.vocab_size
+    if inner is not None and hasattr(inner, "convert_ids_to_tokens"):
+        toks = inner.convert_ids_to_tokens(list(range(vocab)))
+        special = set(getattr(inner, "all_special_ids", []) or [])
+        byte_dec = _gpt2_byte_decoder()
+        out = []
+        for tid, t in enumerate(toks):
+            if t is None or tid in special:
+                out.append("")
+                continue
+            if all(c in byte_dec for c in t):  # GPT-2-style byte level
+                out.append(
+                    bytes(byte_dec[c] for c in t).decode(
+                        "utf-8", errors="replace"
+                    )
+                )
+            else:  # sentencepiece metaspace convention
+                out.append(t.replace("▁", " "))
+        return out
+    # fallback: decode each id alone
+    return [tokenizer.decode([i]) for i in range(vocab)]
+
+
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """Inverse of the GPT-2 bytes->unicode visible-char mapping."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+# ---------------------------------------------------------------------------
+# compiled-machine cache (schemas repeat across requests)
+
+_MACHINE_CACHE: dict = {}
+_MACHINE_CACHE_CAP = 64
+
+
+def get_machine(kind: str, spec) -> JsonSchemaMachine | RegexMachine:
+    """Compile (or fetch) the machine for a guided_json / guided_regex
+    constraint. `spec` is a schema dict/str for json, a pattern for
+    regex."""
+    if kind == "json":
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        key = ("json", json.dumps(spec, sort_keys=True))
+    else:
+        key = ("regex", spec)
+    m = _MACHINE_CACHE.get(key)
+    if m is None:
+        if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
+            _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
+        m = (
+            JsonSchemaMachine(spec) if kind == "json"
+            else RegexMachine(spec)
+        )
+        _MACHINE_CACHE[key] = m
+    return m

@@ -45,6 +45,14 @@ class ByteTokenizer:
         data = bytes(t for t in token_ids if 0 <= t < 256)
         return data.decode("utf-8", errors="replace")
 
+    def token_strings(self) -> list[str]:
+        """Per-token strings for constrained decoding (structured.py):
+        byte ids render alone; specials/unused ids are never forced."""
+        return [
+            bytes([i]).decode("utf-8", errors="replace") if i < 256 else ""
+            for i in range(self.vocab_size)
+        ]
+
     def apply_chat_template(self, messages: list[dict]) -> str:
         parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
         parts.append("<|assistant|>\n")
