@@ -80,6 +80,11 @@ class AsyncLLMEngine:
                 )
                 outputs = self._fail_inflight()
                 busy = True
+                # if the engine state is corrupt enough that aborts
+                # also fail, has_unfinished() can stay true forever —
+                # backoff bounds the retry/log rate instead of pegging
+                # the thread in a no-sleep exception loop
+                time.sleep(0.5)
             if outputs and self._loop is not None:
                 self._loop.call_soon_threadsafe(self._deliver, outputs)
             if not busy:

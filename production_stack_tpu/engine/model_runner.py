@@ -159,15 +159,10 @@ class ModelRunner:
                 " using the XLA gather path", mc.head_dim,
             )
             impl = "xla"
-        if impl == "pallas" and mc.sliding_window:
-            # the paged kernels attend over the full context; windowed
-            # models (Phi-3-mini, Mistral-v0.1) need the mask the XLA
-            # path implements
-            logger.warning(
-                "model %s uses sliding-window attention (window=%d); "
-                "using the XLA gather path", mc.name, mc.sliding_window,
-            )
-            impl = "xla"
+        # sliding-window models (Phi-3-mini, Mistral-v0.1) ride the
+        # pallas kernels too: the page walk starts at the window's first
+        # page and masks within the boundary page (the smoke test below
+        # compiles the windowed variant on hardware before committing)
         if impl == "pallas" and jax.default_backend() == "tpu":
             # compile-check the kernel on tiny shapes before committing:
             # if this TPU generation/toolchain rejects it, serve on the
@@ -252,6 +247,9 @@ class ModelRunner:
 
         bs = self.block_size
         d, nkv = mc.head_dim, mc.num_kv_heads
+        # probe the exact kernel variant serving will compile — the
+        # windowed page walk included (traced loop start + guarded DMA)
+        window = mc.sliding_window
         kc = jnp.zeros((1, nkv, 4 * bs, d), self.cache_dtype)
         q = jnp.zeros((1, mc.num_heads, d), self.dtype)
         tables = jnp.zeros((1, 2), jnp.int32)
@@ -266,19 +264,21 @@ class ModelRunner:
             out = pallas_attention.paged_decode_attention_tp(
                 q, kc, kc, jnp.int32(0), tables, lens,
                 mesh=self.mesh, block_size=bs, scale=self._scale,
+                window=window,
             )
             out2 = pallas_attention.paged_prefill_attention_tp(
                 qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
                 mesh=self.mesh, block_size=bs, scale=self._scale,
+                window=window,
             )
         else:
             out = pallas_attention.paged_decode_attention(
                 q, kc, kc, jnp.int32(0), tables, lens,
-                block_size=bs, scale=self._scale,
+                block_size=bs, scale=self._scale, window=window,
             )
             out2 = pallas_attention.paged_prefill_attention(
                 qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
-                block_size=bs, scale=self._scale,
+                block_size=bs, scale=self._scale, window=window,
             )
         jax.block_until_ready((out, out2))
 
@@ -344,17 +344,19 @@ class ModelRunner:
             bs = self.block_size
             interpret = jax.default_backend() != "tpu"
             mesh = self.mesh
+            window = self.model_config.sliding_window
 
             def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
                 if mesh is not None:
                     return pallas_attention.paged_prefill_attention_tp(
                         q, kc, vc, l, gather_slots, q_positions[0],
                         mesh=mesh, block_size=bs, scale=scale,
-                        interpret=interpret,
+                        interpret=interpret, window=window,
                     )
                 return pallas_attention.paged_prefill_attention(
                     q, kc, vc, l, gather_slots, q_positions[0],
                     block_size=bs, scale=scale, interpret=interpret,
+                    window=window,
                 )
         else:
 
@@ -629,6 +631,7 @@ class ModelRunner:
             bs = self.block_size
             interpret = jax.default_backend() != "tpu"
             mesh = self.mesh
+            window = self.model_config.sliding_window
 
             # tables: (s_pad, P) per-sequence padded block tables;
             # q_starts: (s_pad,) absolute position of each chunk's row 0
@@ -641,13 +644,13 @@ class ModelRunner:
                         o = pallas_attention.paged_prefill_attention_tp(
                             qs[s], kc, vc, l, tables[s], q_starts[s],
                             mesh=mesh, block_size=bs, scale=scale,
-                            interpret=interpret,
+                            interpret=interpret, window=window,
                         )
                     else:
                         o = pallas_attention.paged_prefill_attention(
                             qs[s], kc, vc, l, tables[s], q_starts[s],
                             block_size=bs, scale=scale,
-                            interpret=interpret,
+                            interpret=interpret, window=window,
                         )
                     outs.append(o)
                 return jnp.concatenate(outs, axis=0)
@@ -727,6 +730,7 @@ class ModelRunner:
             bs = self.block_size
             interpret = jax.default_backend() != "tpu"
             mesh = self.mesh
+            window = self.model_config.sliding_window
 
             # `tables` = padded per-sequence block tables (b, pages)
             def attn(q, l, kc, vc, tables, context_lens):
@@ -738,10 +742,12 @@ class ModelRunner:
                     return pallas_attention.paged_decode_attention_tp(
                         q, kc, vc, l, tables, context_lens, mesh=mesh,
                         block_size=bs, scale=scale, interpret=interpret,
+                        window=window,
                     )
                 return pallas_attention.paged_decode_attention(
                     q, kc, vc, l, tables, context_lens,
                     block_size=bs, scale=scale, interpret=interpret,
+                    window=window,
                 )
         else:
 
@@ -806,11 +812,12 @@ class ModelRunner:
                     return pallas_attention.paged_decode_attention_tp(
                         q, kc, vc, l, page_tables, context_lens,
                         mesh=mesh, block_size=bs, scale=scale,
-                        interpret=interpret,
+                        interpret=interpret, window=window,
                     )
                 return pallas_attention.paged_decode_attention(
                     q, kc, vc, l, page_tables, context_lens,
                     block_size=bs, scale=scale, interpret=interpret,
+                    window=window,
                 )
         else:
 

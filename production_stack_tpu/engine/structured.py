@@ -155,8 +155,16 @@ class JsonSchemaMachine:
             if not isinstance(items, (dict, bool)):
                 raise ValueError(f"bad items schema: {items!r}")
         for key in ("minItems", "maxItems", "minLength", "maxLength"):
-            if key in sch and not isinstance(sch[key], int):
-                raise ValueError(f"{key} must be an integer")
+            if key in sch:
+                v = sch[key]
+                if not isinstance(v, int) or v < 0:
+                    raise ValueError(
+                        f"{key} must be a non-negative integer"
+                    )
+        for lo_k, hi_k in (("minItems", "maxItems"),
+                           ("minLength", "maxLength")):
+            if lo_k in sch and hi_k in sch and sch[lo_k] > sch[hi_k]:
+                raise ValueError(f"{lo_k} > {hi_k}: matches nothing")
         props = sch.get("properties")
         if props is not None and not isinstance(props, dict):
             raise ValueError("properties must be an object")
@@ -167,8 +175,11 @@ class JsonSchemaMachine:
                         f"property {name!r} schema must be an object"
                     )
         req = sch.get("required")
-        if req is not None and not isinstance(req, list):
-            raise ValueError("required must be a list")
+        if req is not None:
+            if not isinstance(req, list) or not all(
+                isinstance(r, str) for r in req
+            ):
+                raise ValueError("required must be a list of strings")
         for key in ("anyOf", "oneOf"):
             subs = sch.get(key)
             if subs is None:

@@ -60,6 +60,7 @@ def _decode_kernel(
     block_size: int,
     num_pages: int,
     scale: float,
+    window: int | None = None,
 ):
     i = pl.program_id(0)
     layer = layer_ref[0]
@@ -73,6 +74,13 @@ def _decode_kernel(
     n_used = jnp.minimum(
         (ctx_len + bs - 1) // bs, jnp.int32(num_pages)
     )
+    # sliding window (HF semantics: keys j > q_pos - window, q_pos =
+    # ctx_len-1): pages wholly below the window are never even DMA'd —
+    # the page walk starts at the window's first page
+    if window is None:
+        n_start = jnp.int32(0)
+    else:
+        n_start = jnp.maximum(ctx_len - window, 0) // bs
 
     # one strided DMA per page: all heads' rows for the page's slot
     # range (the head-major cache makes this a tile-aligned slice)
@@ -84,10 +92,11 @@ def _decode_kernel(
             sem.at[slot, which],
         )
 
-    @pl.when(n_used > 0)
+    @pl.when(n_used > n_start)
     def _():
-        page_dma(0, 0, k_buf, k_cache_ref, 0).start()
-        page_dma(0, 0, v_buf, v_cache_ref, 1).start()
+        s0 = jax.lax.rem(n_start, 2)
+        page_dma(s0, n_start, k_buf, k_cache_ref, 0).start()
+        page_dma(s0, n_start, v_buf, v_cache_ref, 1).start()
 
     q = q_ref[0].astype(jnp.float32).reshape(nkv, g, d) * scale
 
@@ -113,7 +122,11 @@ def _decode_kernel(
             preferred_element_type=jnp.float32,
         )
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
-        s = jnp.where(pos < ctx_len, s, MASK_VALUE)
+        valid = pos < ctx_len
+        if window is not None:
+            # mask within the boundary page of the window
+            valid &= pos > ctx_len - 1 - window
+        s = jnp.where(valid, s, MASK_VALUE)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
@@ -131,7 +144,7 @@ def _decode_kernel(
     m0 = jnp.full((nkv, g, 1), MASK_VALUE, jnp.float32)
     l0 = jnp.zeros((nkv, g, 1), jnp.float32)
     acc0 = jnp.zeros((nkv, g, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(n_start, n_used, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)
     out_ref[0] = out.reshape(nq, d).astype(out_ref.dtype)
@@ -155,6 +168,7 @@ def _prefill_kernel(
     block_size: int,
     num_pages: int,
     scale: float,
+    window: int | None = None,
 ):
     """Ragged chunked-prefill attention for ONE sequence over the paged
     HBM cache (SURVEY §7 hard-part #1, prefill half).
@@ -181,6 +195,13 @@ def _prefill_kernel(
     n_used = jnp.minimum(
         (tile_base + tq + bs - 1) // bs, jnp.int32(num_pages)
     )
+    # sliding window: the tile's EARLIEST row needs keys down to
+    # tile_base - window + 1; pages wholly below that never stream in.
+    # n_start < n_used always (a tile's own page is inside its window).
+    if window is None:
+        n_start = jnp.int32(0)
+    else:
+        n_start = jnp.maximum(tile_base - window + 1, 0) // bs
 
     def page_dma(slot, page_idx, buf, cache_ref, which):
         row0 = block_table_ref[page_idx] * bs
@@ -190,8 +211,9 @@ def _prefill_kernel(
             sem.at[slot, which],
         )
 
-    page_dma(0, 0, k_buf, k_cache_ref, 0).start()
-    page_dma(0, 0, v_buf, v_cache_ref, 1).start()
+    s0 = jax.lax.rem(n_start, 2)
+    page_dma(s0, n_start, k_buf, k_cache_ref, 0).start()
+    page_dma(s0, n_start, v_buf, v_cache_ref, 1).start()
 
     # (Tq, nq, d) -> (nkv, Tq*g, d): batch kv heads on the MXU; row r of
     # the fused axis belongs to query row r // g
@@ -230,7 +252,10 @@ def _prefill_kernel(
         k_pos = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, bs), 2
         )
-        s = jnp.where(k_pos <= q_pos, s, MASK_VALUE)
+        valid = k_pos <= q_pos
+        if window is not None:
+            valid &= k_pos > q_pos - window
+        s = jnp.where(valid, s, MASK_VALUE)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
@@ -246,7 +271,7 @@ def _prefill_kernel(
     m0 = jnp.full((nkv, tq * g, 1), MASK_VALUE, jnp.float32)
     l0 = jnp.zeros((nkv, tq * g, 1), jnp.float32)
     acc0 = jnp.zeros((nkv, tq * g, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(n_start, n_used, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)
     out = (
@@ -272,7 +297,7 @@ def _prefill_q_tile(t: int, nq: int, d: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_size", "scale", "interpret"),
+    static_argnames=("block_size", "scale", "interpret", "window"),
 )
 def paged_prefill_attention(
     q: jax.Array,            # (t, nq, d) — one chunk, contiguous positions
@@ -285,6 +310,7 @@ def paged_prefill_attention(
     block_size: int,
     scale: float,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Chunked-prefill paged attention for one sequence. -> (t, nq, d)."""
     t, nq, d = q.shape
@@ -317,6 +343,7 @@ def paged_prefill_attention(
         block_size=block_size,
         num_pages=num_pages,
         scale=scale,
+        window=window,
     )
     meta = jnp.stack(
         [jnp.asarray(layer, jnp.int32), jnp.asarray(q_start, jnp.int32)]
@@ -353,6 +380,7 @@ def paged_prefill_attention_tp(
     block_size: int,
     scale: float,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Tensor-parallel chunked-prefill paged attention via shard_map (same
     head-congruence argument as paged_decode_attention_tp: GQA groups are
@@ -362,6 +390,7 @@ def paged_prefill_attention_tp(
     body = functools.partial(
         paged_prefill_attention,
         block_size=block_size, scale=scale, interpret=interpret,
+        window=window,
     )
     return jax.shard_map(
         body,
@@ -405,6 +434,7 @@ def paged_decode_attention_tp(
     block_size: int,
     scale: float,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Tensor-parallel paged decode attention via shard_map.
 
@@ -422,6 +452,7 @@ def paged_decode_attention_tp(
     body = functools.partial(
         paged_decode_attention,
         block_size=block_size, scale=scale, interpret=interpret,
+        window=window,
     )
     return jax.shard_map(
         body,
@@ -441,7 +472,7 @@ def paged_decode_attention_tp(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_size", "scale", "interpret"),
+    static_argnames=("block_size", "scale", "interpret", "window"),
 )
 def paged_decode_attention(
     q: jax.Array,             # (b, nq, d)
@@ -454,6 +485,7 @@ def paged_decode_attention(
     block_size: int,
     scale: float,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """One decode step of paged attention. Returns (b, nq, d) in q.dtype."""
     b, nq, d = q.shape
@@ -485,6 +517,7 @@ def paged_decode_attention(
         block_size=block_size,
         num_pages=num_pages,
         scale=scale,
+        window=window,
     )
     return pl.pallas_call(
         kernel,
