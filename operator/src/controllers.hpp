@@ -149,6 +149,7 @@ inline Json engine_container(const Json& cr) {
     arg(args, "--served-model-name",
         model.get("servedModelName").as_string());
   arg_if(args, eng, "tensorParallelSize", "--tensor-parallel-size");
+  arg_if(args, eng, "pipelineParallelSize", "--pipeline-parallel-size");
   arg_if(args, eng, "maxModelLen", "--max-model-len");
   arg_if(args, eng, "maxNumSeqs", "--max-num-seqs");
   arg_if(args, eng, "blockSize", "--block-size");

@@ -66,6 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-enable-prefix-caching",
                    dest="enable_prefix_caching", action="store_false")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1)
     p.add_argument("--enable-lora", action="store_true")
     p.add_argument("--max-loras", type=int, default=4)
     p.add_argument("--enable-sleep-mode", action="store_true",
@@ -135,6 +136,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
+        pipeline_parallel_size=args.pipeline_parallel_size,
         multihost=args.multihost,
         served_model_name=args.served_model_name,
         enable_lora=args.enable_lora,

@@ -64,6 +64,11 @@ class EngineConfig:
 
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1
+    # pipeline parallelism: layers (and their KV) shard over a pp mesh
+    # axis; every engine step is one SPMD program with ppermute stage
+    # handoffs (parallel/pp_serving.py; the reference's ray-cluster
+    # pipelineParallelSize capability). Composes with tp: pp x tp chips.
+    pipeline_parallel_size: int = 1
     # one engine spanning the hosts of a multi-host slice (jax.distributed
     # SPMD; host 0 schedules + serves HTTP, followers replay its steps)
     multihost: bool = False

@@ -78,11 +78,30 @@ class ModelRunner:
                 "lanes park inside the trash block)"
             )
         tp = config.tensor_parallel_size
-        if mesh is None and tp > 1:
-            mesh = sharding_rules.make_mesh(tp)
+        pp = config.pipeline_parallel_size
+        if mesh is None and (tp > 1 or pp > 1):
+            mesh = sharding_rules.make_serving_mesh(tp, pp)
         self.mesh = mesh
         if self.mesh is not None:
-            sharding_rules.validate_tp(mc, self.mesh.size)
+            sharding_rules.validate_tp(mc, tp if pp > 1 else self.mesh.size)
+        # forward implementation: the plain layer scan, or the
+        # pipeline-staged phase loop when layers shard over pp
+        if pp > 1:
+            from production_stack_tpu.parallel import pp_serving
+
+            pp_serving.validate_pp_serving(mc, pp, config)
+            if config.attention_impl == "pallas":
+                raise ValueError(
+                    "attention_impl=pallas does not compose with "
+                    "pipeline_parallel_size>1 yet (the kernels' own "
+                    "shard_map cannot nest in the pp manual region); "
+                    "use auto or xla"
+                )
+            self._forward = functools.partial(
+                pp_serving.forward_pp, mesh=self.mesh
+            )
+        else:
+            self._forward = llama.forward
 
         if params is None:
             # real checkpoints load from disk (local dir or HF cache);
@@ -94,9 +113,9 @@ class ModelRunner:
             params = weight_loader.maybe_load(config.model, mc, self.dtype)
         if params is None:
             logger.info(
-                "initializing random %s params (%.2fB params, %s, tp=%d)",
-                mc.name, mc.num_params() / 1e9, config.dtype,
-                self.mesh.size if self.mesh else 1,
+                "initializing random %s params (%.2fB params, %s, "
+                "tp=%d, pp=%d)",
+                mc.name, mc.num_params() / 1e9, config.dtype, tp, pp,
             )
             init_fn = lambda key: llama.init_params(mc, key, self.dtype)
             if self.mesh is not None:
@@ -142,6 +161,8 @@ class ModelRunner:
         impl = config.attention_impl
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if config.pipeline_parallel_size > 1:
+            impl = "xla"  # see the pp validation above
         if impl not in ("xla", "pallas"):
             raise ValueError(
                 f"attention_impl must be auto|xla|pallas, got {impl!r}"
@@ -417,7 +438,7 @@ class ModelRunner:
                 q_positions=positions,
                 total_len=total_len,
             )
-            logits, kc, vc = llama.forward(
+            logits, kc, vc = self._forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=last_row[None],
@@ -463,7 +484,7 @@ class ModelRunner:
                 positions2d=positions.reshape(s_pad, t_pad),
                 total_lens=total_lens,
             )
-            logits, kc, vc = llama.forward(
+            logits, kc, vc = self._forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=jnp.arange(s_pad * t_pad),
@@ -706,7 +727,7 @@ class ModelRunner:
                 positions2d=positions.reshape(s_pad, t_pad),
                 total_lens=total_lens,
             )
-            logits, kc, vc = llama.forward(
+            logits, kc, vc = self._forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=last_rows,
@@ -767,7 +788,7 @@ class ModelRunner:
             attn_fn = functools.partial(
                 attn, tables=tables, context_lens=context_lens
             )
-            logits, kc, vc = llama.forward(
+            logits, kc, vc = self._forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
                 logits_rows=jnp.arange(b),
@@ -806,6 +827,7 @@ class ModelRunner:
 
             interpret = jax.default_backend() != "tpu"
             mesh = self.mesh
+            window = self.model_config.sliding_window
 
             def attn(q, l, kc, vc, page_tables, context_lens):
                 if mesh is not None:
@@ -864,7 +886,7 @@ class ModelRunner:
                 ) if use_pages else functools.partial(
                     attn, gather_tables=attn_tables, context_lens=ctx,
                 )
-                logits, kc, vc = llama.forward(
+                logits, kc, vc = self._forward(
                     mc, params, tokens, positions, kc, vc, write_slots,
                     lambda q, l, k, v: attn_fn(q, l, k, v),
                     logits_rows=lane,

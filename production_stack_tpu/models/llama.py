@@ -81,6 +81,97 @@ def init_params(
     return params
 
 
+def decoder_layer(
+    cfg: ModelConfig,
+    h: jax.Array,          # (n, hidden)
+    kc: jax.Array,         # cache (local or full layer axis)
+    vc: jax.Array,
+    lp: dict,              # this layer's param slice
+    l: jax.Array,          # layer index INTO kc/vc (local under pp)
+    *,
+    cos: jax.Array,
+    sin: jax.Array,
+    write_slots: jax.Array,
+    attn_fn,
+    dtype,
+    cache_dtype,
+    lora_ctx: tuple | None = None,  # (lz, scaling, uniform, slots)
+):
+    """One decoder layer over n token rows — the shared body of
+    forward()'s layer scan and the pipeline-parallel phase loop
+    (parallel/pp_serving.py). Writes the rows' K/V into the cache at
+    `write_slots` BEFORE attn_fn runs, so attention sees them."""
+    n = h.shape[0]
+
+    def proj(x, target, base):
+        out = jnp.dot(x, lp[target], preferred_element_type=jnp.float32)
+        if base is not None:
+            out = out + base.astype(jnp.float32)
+        if lora_ctx is not None:
+            lz, lora_scaling, lora_uniform, lora_slots = lora_ctx
+            if lora_uniform:
+                A = lz[f"{target}_A"][lora_slots]  # (in, r)
+                B = lz[f"{target}_B"][lora_slots]  # (r, out)
+                delta = jnp.dot(
+                    jnp.dot(x, A, preferred_element_type=jnp.float32),
+                    B.astype(jnp.float32),
+                )
+            else:
+                A = lz[f"{target}_A"][lora_slots]  # (n, in, r)
+                B = lz[f"{target}_B"][lora_slots]  # (n, r, out)
+                t = jnp.einsum(
+                    "ni,nir->nr", x, A,
+                    preferred_element_type=jnp.float32,
+                )
+                delta = jnp.einsum(
+                    "nr,nro->no", t, B,
+                    preferred_element_type=jnp.float32,
+                )
+            out = out + delta * lora_scaling
+        return out
+
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
+                 cfg.norm_weight_offset)
+    q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
+    k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
+    v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
+    q = q.astype(dtype).reshape(n, cfg.num_heads, cfg.head_dim)
+    k = k.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
+    v = v.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, k, cos, sin)
+
+    # head-major cache writes, one scatter per kv head (nkv is tiny
+    # and static). The single fused scatter [l, :, write_slots] makes
+    # XLA prefer a slot-major physical layout for the cache inside
+    # the scan while the Pallas kernels constrain it row-major — XLA
+    # then inserts a FULL-CACHE layout copy per step (2 x 3.8 GiB on
+    # the 3B model; HBM OOM). Per-head 2D-plane scatters keep the
+    # default layout: AOT-verified 7.62 GiB -> 0 temp.
+    kh = k.astype(cache_dtype).swapaxes(0, 1)  # (nkv, n, d)
+    vh = v.astype(cache_dtype).swapaxes(0, 1)
+    for head in range(cfg.num_kv_heads):
+        kc = kc.at[l, head, write_slots].set(kh[head])
+        vc = vc.at[l, head, write_slots].set(vh[head])
+
+    attn_out = attn_fn(q, l, kc, vc)  # (n, nq, d)
+    h = h + proj(
+        attn_out.reshape(n, cfg.q_size).astype(dtype), "wo", None
+    ).astype(dtype)
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps,
+                 cfg.norm_weight_offset)
+    if cfg.is_moe:
+        h = h + moe_block(
+            x, lp["moe_gate"], lp["w_gate"], lp["w_up"],
+            lp["w_down"], cfg.num_experts_per_tok,
+            cfg.moe_capacity_factor,
+        ).astype(dtype)
+    else:
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"],
+                       act=cfg.hidden_act)
+    return h, kc, vc
+
+
 def forward(
     cfg: ModelConfig,
     params: dict,
@@ -136,74 +227,15 @@ def forward(
         h, kc, vc = carry
         if use_lora:
             lp, l, lz = xs
+            lora_ctx = (lz, lora_scaling, lora_uniform, lora_slots)
         else:
             lp, l = xs
-
-        def proj(x, target, base):
-            out = jnp.dot(x, lp[target], preferred_element_type=jnp.float32)
-            if base is not None:
-                out = out + base.astype(jnp.float32)
-            if use_lora:
-                if lora_uniform:
-                    A = lz[f"{target}_A"][lora_slots]  # (in, r)
-                    B = lz[f"{target}_B"][lora_slots]  # (r, out)
-                    delta = jnp.dot(
-                        jnp.dot(x, A, preferred_element_type=jnp.float32),
-                        B.astype(jnp.float32),
-                    )
-                else:
-                    A = lz[f"{target}_A"][lora_slots]  # (n, in, r)
-                    B = lz[f"{target}_B"][lora_slots]  # (n, r, out)
-                    t = jnp.einsum(
-                        "ni,nir->nr", x, A,
-                        preferred_element_type=jnp.float32,
-                    )
-                    delta = jnp.einsum(
-                        "nr,nro->no", t, B,
-                        preferred_element_type=jnp.float32,
-                    )
-                out = out + delta * lora_scaling
-            return out
-
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps,
-                     cfg.norm_weight_offset)
-        q = proj(x, "wq", lp["bq"] if cfg.qkv_bias else None)
-        k = proj(x, "wk", lp["bk"] if cfg.qkv_bias else None)
-        v = proj(x, "wv", lp["bv"] if cfg.qkv_bias else None)
-        q = q.astype(dtype).reshape(n, cfg.num_heads, cfg.head_dim)
-        k = k.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
-        v = v.astype(dtype).reshape(n, cfg.num_kv_heads, cfg.head_dim)
-        q, k = apply_rope(q, k, cos, sin)
-
-        # head-major cache writes, one scatter per kv head (nkv is tiny
-        # and static). The single fused scatter [l, :, write_slots] makes
-        # XLA prefer a slot-major physical layout for the cache inside
-        # the scan while the Pallas kernels constrain it row-major — XLA
-        # then inserts a FULL-CACHE layout copy per step (2 x 3.8 GiB on
-        # the 3B model; HBM OOM). Per-head 2D-plane scatters keep the
-        # default layout: AOT-verified 7.62 GiB -> 0 temp.
-        kh = k.astype(cache_dtype).swapaxes(0, 1)  # (nkv, n, d)
-        vh = v.astype(cache_dtype).swapaxes(0, 1)
-        for head in range(cfg.num_kv_heads):
-            kc = kc.at[l, head, write_slots].set(kh[head])
-            vc = vc.at[l, head, write_slots].set(vh[head])
-
-        attn_out = attn_fn(q, l, kc, vc)  # (n, nq, d)
-        h = h + proj(
-            attn_out.reshape(n, cfg.q_size).astype(dtype), "wo", None
-        ).astype(dtype)
-
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps,
-                     cfg.norm_weight_offset)
-        if cfg.is_moe:
-            h = h + moe_block(
-                x, lp["moe_gate"], lp["w_gate"], lp["w_up"],
-                lp["w_down"], cfg.num_experts_per_tok,
-                cfg.moe_capacity_factor,
-            ).astype(dtype)
-        else:
-            h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"],
-                           act=cfg.hidden_act)
+            lora_ctx = None
+        h, kc, vc = decoder_layer(
+            cfg, h, kc, vc, lp, l,
+            cos=cos, sin=sin, write_slots=write_slots, attn_fn=attn_fn,
+            dtype=dtype, cache_dtype=cache_dtype, lora_ctx=lora_ctx,
+        )
         return (h, kc, vc), None
 
     xs = (

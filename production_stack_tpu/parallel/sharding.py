@@ -42,6 +42,32 @@ def make_mesh(
     return Mesh(np.asarray(devs[:tp_size]), (TP_AXIS,))
 
 
+def make_serving_mesh(
+    tp_size: int, pp_size: int, devices: list | None = None
+) -> Mesh:
+    """("pp", "tp") mesh for the engine: TP groups ICI-contiguous within
+    a stage (activation collectives stay on the fastest links), stages
+    across the outer axis. pp_size == 1 keeps the single-axis tp mesh so
+    every existing tp path (pallas shard_map, cache shardings) is
+    byte-identical."""
+    if pp_size <= 1:
+        return make_mesh(tp_size, devices)
+    devs = devices if devices is not None else jax.devices()
+    need = tp_size * pp_size
+    if need > len(devs):
+        raise ValueError(
+            f"pp({pp_size}) x tp({tp_size}) = {need} > available "
+            f"devices {len(devs)}"
+        )
+    arr = np.asarray(devs[:need]).reshape(pp_size, tp_size)
+    return Mesh(arr, ("pp", TP_AXIS))
+
+
+def _layer_axis(mesh: Mesh):
+    """'pp' when the mesh pipelines the stacked layer axis, else None."""
+    return "pp" if "pp" in mesh.axis_names else None
+
+
 def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
     if cfg.num_heads % tp_size or cfg.num_kv_heads % tp_size:
         raise ValueError(
@@ -67,35 +93,40 @@ def validate_tp(cfg: ModelConfig, tp_size: int) -> None:
 
 
 def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
-    """NamedSharding pytree matching models.llama.init_params."""
+    """NamedSharding pytree matching models.llama.init_params.
+
+    On a ("pp", "tp") serving mesh the stacked LAYER axis (axis 0 of
+    every per-layer array) additionally shards over pp — each pipeline
+    stage holds its own layer slice of the Megatron-sharded weights."""
+    la = _layer_axis(mesh)
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
     layers = {
-        "attn_norm": ns(None, None),
-        "mlp_norm": ns(None, None),
-        "wq": ns(None, None, TP_AXIS),  # column: heads split
-        "wk": ns(None, None, TP_AXIS),
-        "wv": ns(None, None, TP_AXIS),
-        "wo": ns(None, TP_AXIS, None),  # row: psum after
+        "attn_norm": ns(la, None),
+        "mlp_norm": ns(la, None),
+        "wq": ns(la, None, TP_AXIS),  # column: heads split
+        "wk": ns(la, None, TP_AXIS),
+        "wv": ns(la, None, TP_AXIS),
+        "wo": ns(la, TP_AXIS, None),  # row: psum after
     }
     if cfg.is_moe:
         # expert parallelism over the same mesh axis: each chip holds
         # E/tp whole experts ((L, E, h, f) split on E); the router stays
         # replicated and XLA turns dispatch/combine into all_to_alls
-        layers["moe_gate"] = ns(None, None, None)
-        layers["w_gate"] = ns(None, TP_AXIS, None, None)
-        layers["w_up"] = ns(None, TP_AXIS, None, None)
-        layers["w_down"] = ns(None, TP_AXIS, None, None)
+        layers["moe_gate"] = ns(la, None, None)
+        layers["w_gate"] = ns(la, TP_AXIS, None, None)
+        layers["w_up"] = ns(la, TP_AXIS, None, None)
+        layers["w_down"] = ns(la, TP_AXIS, None, None)
     else:
-        layers["w_gate"] = ns(None, None, TP_AXIS)
-        layers["w_up"] = ns(None, None, TP_AXIS)
-        layers["w_down"] = ns(None, TP_AXIS, None)
+        layers["w_gate"] = ns(la, None, TP_AXIS)
+        layers["w_up"] = ns(la, None, TP_AXIS)
+        layers["w_down"] = ns(la, TP_AXIS, None)
     if cfg.qkv_bias:
-        layers["bq"] = ns(None, TP_AXIS)
-        layers["bk"] = ns(None, TP_AXIS)
-        layers["bv"] = ns(None, TP_AXIS)
+        layers["bq"] = ns(la, TP_AXIS)
+        layers["bk"] = ns(la, TP_AXIS)
+        layers["bv"] = ns(la, TP_AXIS)
     out = {
         "embed": ns(None, None),  # replicated (logits need full hidden)
         "layers": layers,
@@ -107,11 +138,12 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict:
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV cache (layers, kv_heads, slots, head_dim): split kv heads.
+    """KV cache (layers, kv_heads, slots, head_dim): split kv heads
+    (and the layer axis per pipeline stage on a ("pp", "tp") mesh).
 
     Head-major layout — see ops/pallas_attention.py module docstring for
     why the hardware wants the slot run contiguous per head."""
-    return NamedSharding(mesh, P(None, TP_AXIS, None, None))
+    return NamedSharding(mesh, P(_layer_axis(mesh), TP_AXIS, None, None))
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:

@@ -386,3 +386,27 @@ def test_engine_swa_selects_pallas_and_matches_xla():
         assert out_p == out_x
     finally:
         mcfg._PRESETS.pop(cfg.name, None)
+
+
+def test_engine_multistep_pallas_path():
+    """pallas + num_scheduler_steps>1 (the TPU default serving config)
+    must trace and match the XLA engine — regression for the undefined
+    `window` NameError in the decode_multi closure (review r5)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    kw = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=32,
+        max_num_seqs=2, max_prefill_chunk=32,
+        num_scheduler_steps=4, async_decode=False,
+    )
+    sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = ["multi step pallas"]
+    out_x = [o.token_ids for o in LLMEngine(
+        EngineConfig(attention_impl="xla", **kw)).generate(prompts, sp)]
+    eng_p = LLMEngine(EngineConfig(attention_impl="pallas", **kw))
+    assert eng_p.runner.attention_impl == "pallas"
+    out_p = [o.token_ids for o in eng_p.generate(prompts, sp)]
+    assert out_p == out_x
