@@ -147,13 +147,18 @@ class Benchmark:
         self.sessions = [
             UserSession(i, args) for i in range(args.num_users)
         ]
+        self._convs = None
         if getattr(args, "sharegpt_path", None):
-            convs = load_sharegpt(args.sharegpt_path)
+            self._convs = load_sharegpt(args.sharegpt_path)
             for s in self.sessions:
-                s.sharegpt_conv = convs[s.user_id % len(convs)]
+                s.sharegpt_conv = self._convs[s.user_id % len(self._convs)]
+        self._next_user_id = args.num_users
+        self.sessions_completed = 0
+        # sessions enter the free queue in run(): all at t=0, or
+        # staggered over --ramp-up-time (reference's user ramp-up,
+        # multi-round-qa.py:386 — a thundering herd at t=0 measures the
+        # cold-start queue, not steady-state serving)
         self.free_sessions = asyncio.Queue()
-        for s in self.sessions:
-            self.free_sessions.put_nowait(s)
 
     async def run_request(self, session: UserSession,
                           http: aiohttp.ClientSession) -> None:
@@ -236,6 +241,35 @@ class Benchmark:
             self.records.append(rec)
             if session.rounds_done < self.args.num_rounds:
                 self.free_sessions.put_nowait(session)
+            else:
+                self.sessions_completed += 1
+                if self.args.recycle:
+                    # session recycling (reference multi-round-qa.py:407):
+                    # replace the finished user with a FRESH one so
+                    # concurrency holds constant for the whole run
+                    fresh = UserSession(self._next_user_id, self.args)
+                    self._next_user_id += 1
+                    if self._convs is not None:
+                        fresh.sharegpt_conv = self._convs[
+                            fresh.user_id % len(self._convs)
+                        ]
+                    self.sessions.append(fresh)
+                    self.free_sessions.put_nowait(fresh)
+
+    async def _admit_sessions(self, t_start: float) -> None:
+        """Feed users into the free queue: all at once, or staggered
+        over --ramp-up-time."""
+        ramp = self.args.ramp_up_time
+        if ramp <= 0:
+            for s in self.sessions:
+                self.free_sessions.put_nowait(s)
+            return
+        gap = ramp / max(1, len(self.sessions))
+        for i, s in enumerate(list(self.sessions)):
+            delay = t_start + i * gap - time.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self.free_sessions.put_nowait(s)
 
     async def run(self) -> dict:
         timeout = aiohttp.ClientTimeout(total=self.args.request_timeout)
@@ -245,6 +279,7 @@ class Benchmark:
         interval = 1.0 / self.args.qps if self.args.qps > 0 else 0.0
         pending: set[asyncio.Task] = set()
         launched = 0
+        admitter = asyncio.create_task(self._admit_sessions(t_start))
         async with aiohttp.ClientSession(
             timeout=timeout, connector=conn
         ) as http:
@@ -261,20 +296,24 @@ class Benchmark:
                 try:
                     sess = self.free_sessions.get_nowait()
                 except asyncio.QueueEmpty:
-                    # all users busy or finished: yield and retry
+                    # all users busy or finished: exit early when the
+                    # whole workload is done (without recycling the run
+                    # would otherwise idle to the deadline, inflating
+                    # `elapsed` and underreporting qps/throughput)
+                    if not self.args.recycle and not pending and all(
+                        s.rounds_done >= self.args.num_rounds
+                        for s in self.sessions
+                    ):
+                        break
                     await asyncio.sleep(0.005)
                     continue
                 task = asyncio.create_task(self.run_request(sess, http))
                 pending.add(task)
                 task.add_done_callback(pending.discard)
                 launched += 1
-                if all(
-                    s.rounds_done >= self.args.num_rounds
-                    for s in self.sessions
-                ) and not pending:
-                    break
             if pending:
                 await asyncio.wait(pending, timeout=self.args.request_timeout)
+        admitter.cancel()
         elapsed = time.time() - t_start
         return self.summary(elapsed, launched)
 
@@ -294,6 +333,7 @@ class Benchmark:
             "duration_s": round(elapsed, 2),
             "requests_launched": launched,
             "requests_completed": len(done),
+            "sessions_completed": self.sessions_completed,
             "errors": self.errors,
             "qps": round(len(done) / elapsed, 3) if elapsed else 0,
             "prompt_throughput_tok_s":
@@ -330,6 +370,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="words of unique per-user first-round context")
     p.add_argument("--answer-len", type=int, default=100)
     p.add_argument("--duration", type=float, default=120.0)
+    p.add_argument("--ramp-up-time", type=float, default=0.0,
+                   help="stagger user starts over this many seconds "
+                        "instead of a thundering herd at t=0 "
+                        "(reference ramp-up, multi-round-qa.py:386)")
+    p.add_argument("--recycle", action="store_true",
+                   help="replace users that finish their rounds with "
+                        "fresh ones so concurrency holds constant "
+                        "(reference session recycling, "
+                        "multi-round-qa.py:407)")
     p.add_argument("--request-timeout", type=float, default=120.0)
     p.add_argument("--sharegpt-path", default=None,
                    help="ShareGPT-format JSON: replay real conversations "

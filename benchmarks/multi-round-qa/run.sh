@@ -14,6 +14,9 @@ HIST_LEN="${HIST_LEN:-20000}"
 ANSWER_LEN="${ANSWER_LEN:-100}"
 DURATION="${DURATION:-120}"
 QPS_SWEEP="${QPS_SWEEP:-1 2 4 8}"
+# stagger user starts + hold concurrency constant via session
+# recycling (reference multi-round-qa.py ramp-up/recycling semantics)
+RAMP="${RAMP:-20}"
 
 echo "== warmup =="
 python3 multi_round_qa.py --base-url "$BASE_URL" --model "$MODEL" \
@@ -27,6 +30,7 @@ for qps in $QPS_SWEEP; do
     --num-users "$USERS" --num-rounds "$ROUNDS" --qps "$qps" \
     --shared-system-prompt-len "$SYS_LEN" --user-history-len "$HIST_LEN" \
     --answer-len "$ANSWER_LEN" --duration "$DURATION" \
+    --ramp-up-time "$RAMP" --recycle \
     --output "summary_qps${qps}.json"
 done
 

@@ -1319,8 +1319,11 @@ class ModelRunner:
                 )
 
             # scratch cache row == absolute position; padded chunk rows
-            # carry position c_pad, landing in the extra trash row
-            h, kc, vc = llama.forward(
+            # carry position c_pad, landing in the extra trash row.
+            # self._forward so pipeline-parallel engines stage this too
+            # (a plain scan over pp-sharded params would make GSPMD
+            # all-gather the full layer stack per device)
+            h, kc, vc = self._forward(
                 mc, params, toks, positions, kc, vc,
                 write_slots=positions,
                 attn_fn=attn,

@@ -198,10 +198,8 @@ def forward(
     wq/wk/wv/wo projections (slot 0 is all-zero = no adapter), so one
     batch can mix adapters freely (see engine/lora.py).
     """
-    n = token_ids.shape[0]
     dtype = params["embed"].dtype
     cache_dtype = k_cache.dtype
-    scale = cfg.head_dim**-0.5
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     h = params["embed"][token_ids].astype(dtype)

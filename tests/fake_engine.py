@@ -112,6 +112,32 @@ class FakeEngine:
                         f"data: {json.dumps(delta)}\n\n".encode()
                     )
                     await asyncio.sleep(interval)
+                # close the stream per the OpenAI contract: a
+                # finish_reason chunk (+usage when requested) before
+                # [DONE] — clients (and our benchmark harness) treat a
+                # stream without one as aborted
+                tail = {
+                    "choices": [
+                        {"index": 0, "delta": {}, "finish_reason":
+                         "length"}
+                        if chat else
+                        {"index": 0, "text": "", "finish_reason":
+                         "length"}
+                    ],
+                    "id": rid, "model": self.model,
+                    "object": ("chat.completion.chunk" if chat
+                               else "text_completion"),
+                }
+                if (body.get("stream_options") or {}).get(
+                    "include_usage"
+                ):
+                    tail["usage"] = {
+                        "prompt_tokens": 16, "completion_tokens": n,
+                        "total_tokens": 16 + n,
+                    }
+                await resp.write(
+                    f"data: {json.dumps(tail)}\n\n".encode()
+                )
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
                 return resp

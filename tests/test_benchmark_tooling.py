@@ -122,3 +122,73 @@ def test_itl_percentiles_in_summary():
     s = b.summary(elapsed=1.0, launched=1)
     assert s["p50_itl_s"] == 0.02
     assert s["p99_itl_s"] == 0.03
+
+
+def test_ramp_up_staggers_admission():
+    """--ramp-up-time: users enter the free queue staggered over the
+    window, not as a thundering herd at t=0 (reference ramp-up,
+    multi-round-qa.py:386)."""
+    import asyncio
+    import time
+
+    mqa = load("multi_round_qa")
+    args = mqa.parse_args([
+        "--model", "m", "--num-users", "4", "--ramp-up-time", "0.4",
+    ])
+    b = mqa.Benchmark(args)
+
+    async def scenario():
+        t0 = time.time()
+        await b._admit_sessions(t0)
+        return time.time() - t0
+
+    took = asyncio.new_event_loop().run_until_complete(scenario())
+    assert b.free_sessions.qsize() == 4
+    assert took >= 0.25  # staggered, not instantaneous
+
+    # ramp 0 = all admitted immediately
+    args0 = mqa.parse_args(["--model", "m", "--num-users", "4"])
+    b0 = mqa.Benchmark(args0)
+
+    async def scenario0():
+        t0 = time.time()
+        await b0._admit_sessions(t0)
+        return time.time() - t0
+
+    took0 = asyncio.new_event_loop().run_until_complete(scenario0())
+    assert b0.free_sessions.qsize() == 4 and took0 < 0.1
+
+
+def test_recycle_holds_concurrency(tmp_path):
+    """--recycle: a finished user is replaced by a FRESH session with a
+    new id so concurrency stays constant (reference session recycling,
+    multi-round-qa.py:407)."""
+    import asyncio
+
+    mqa = load("multi_round_qa")
+    args = mqa.parse_args([
+        "--model", "m", "--num-users", "2", "--num-rounds", "1",
+        "--recycle",
+    ])
+    b = mqa.Benchmark(args)
+    sess = b.sessions[0]
+    sess.rounds_done = 1  # finished its rounds
+
+    class _FakeHTTP:
+        def post(self, *a, **kw):
+            raise RuntimeError("no network in this test")
+
+    async def scenario():
+        # run_request errors out (fake http), but the finally-block
+        # bookkeeping must still recycle the finished session
+        import contextlib
+
+        with contextlib.suppress(RuntimeError):
+            await b.run_request(sess, _FakeHTTP())
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+    assert b.sessions_completed == 1
+    fresh = b.sessions[-1]
+    assert fresh.user_id == 2  # new identity, fresh history
+    assert fresh.history == [] and fresh.rounds_done == 0
+    assert b.free_sessions.qsize() == 1  # concurrency held

@@ -82,3 +82,15 @@ def test_pp_validation():
     # config carries the knob (helm/CRD expose it)
     cfg = EngineConfig(model="pst-tiny-debug", pipeline_parallel_size=4)
     assert dataclasses.asdict(cfg)["pipeline_parallel_size"] == 4
+
+
+def test_pp_embeddings_staged():
+    """/v1/embeddings under pp rides the staged forward too (review r5:
+    a plain scan over pp-sharded params would all-gather the full layer
+    stack per device — the exact failure pp exists to avoid)."""
+    import numpy as np
+
+    ref_vec, _ = make_engine().embed_one("embedding text")
+    pp_vec, n_toks = make_engine(pp=2).embed_one("embedding text")
+    assert n_toks > 0
+    np.testing.assert_allclose(pp_vec, ref_vec, rtol=1e-5, atol=1e-5)

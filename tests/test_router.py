@@ -284,7 +284,7 @@ class TestRouterE2E:
             })
             assert r.status == 200
             text = await r.text()
-            assert text.count("data:") == 5  # 4 tokens + [DONE]
+            assert text.count("data:") == 6  # 4 tokens + finish + [DONE]
             assert "[DONE]" in text
             await _stop_stack(client, engines)
         asyncio.run(run())
