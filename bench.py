@@ -42,8 +42,12 @@ ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
 # cross-sequence prefill packing group cap (1 = round-2 behavior)
 PREFILL_SEQS = int(os.environ.get("PST_BENCH_PREFILL_SEQS", "8"))
-# double-buffered decode dispatch (0 = synchronous fetch per round)
-ASYNC_DECODE = os.environ.get("PST_BENCH_ASYNC", "1") == "1"
+# double-buffered decode dispatch (0 = synchronous fetch per round).
+# Default OFF: the round-5 hardware sweep measured sync-packed at 141.8
+# tok/s/chip vs async-packed 117.6 — chained decode keeps the device
+# busy and delays prefill admission (p50 TTFT 0.78s -> 2.94s), costing
+# more than the fetch overlap buys at K=8
+ASYNC_DECODE = os.environ.get("PST_BENCH_ASYNC", "0") == "1"
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -101,6 +105,12 @@ def _init_backend_or_die(timeout_s: float = 60.0, retries: int = 1):
 
 
 def main() -> None:
+    if os.environ.get("PST_BENCH_SWEEP", "0") == "1":
+        # the sweep parent never dials the chip: each config runs in its
+        # own subprocess (below), so it must not hold the chip lock
+        _run_sweep()
+        return
+
     # chip-session hygiene: one TPU process at a time, SIGTERM-only stop
     from production_stack_tpu.utils import chip_guard
     from production_stack_tpu.utils.chip_guard import ChipBusyError
@@ -123,19 +133,23 @@ def main() -> None:
     print(f"# backend: {devices[0].platform} x{len(devices)}",
           file=sys.stderr)
 
-    if os.environ.get("PST_BENCH_SWEEP", "0") == "1":
-        _run_sweep()
-    else:
-        print(json.dumps(run_config(
-            SCHED_STEPS, PREFILL_SEQS, ASYNC_DECODE, "default"
-        )))
+    print(json.dumps(run_config(
+        SCHED_STEPS, PREFILL_SEQS, ASYNC_DECODE,
+        os.environ.get("PST_BENCH_LABEL", "default"),
+    )))
 
 
 def _run_sweep() -> None:
-    """One chip session, the full measurement matrix: K=1 control, K=8,
-    packing on/off, async on/off. Results stream into BENCH_SWEEP.json
-    after EVERY config so a mid-sweep wedge still leaves evidence; the
-    best row is the driver-contract stdout line."""
+    """The full measurement matrix: K=1 control, K=8, packing on/off,
+    async on/off — ONE SUBPROCESS PER CONFIG. Process exit is the only
+    HBM-release primitive that works reliably through the tunnel: the
+    round-5 sweep showed an in-process engine.shutdown() leaves the old
+    engine's params+KV live long enough that the next config's
+    allocations RESOURCE_EXHAUST the chip. Results stream into
+    BENCH_SWEEP.json after EVERY config so a mid-sweep wedge still
+    leaves evidence; the best row is the driver-contract stdout line."""
+    import subprocess
+
     configs = [
         ("k1-sync-nopack", 1, 1, False),
         (f"k{SCHED_STEPS}-sync-nopack", SCHED_STEPS, 1, False),
@@ -143,21 +157,91 @@ def _run_sweep() -> None:
         (f"k{SCHED_STEPS}-async-packed", SCHED_STEPS, PREFILL_SEQS, True),
     ]
     out_path = os.environ.get("PST_BENCH_SWEEP_OUT", "BENCH_SWEEP.json")
+    per_config_timeout = float(
+        os.environ.get("PST_BENCH_CONFIG_TIMEOUT", "1500")
+    )
     results: list[dict] = []
     for label, k, ps, ad in configs:
+        env = dict(os.environ)
+        env.pop("PST_BENCH_SWEEP", None)
+        env.update({
+            "PST_BENCH_SCHED_STEPS": str(k),
+            "PST_BENCH_PREFILL_SEQS": str(ps),
+            "PST_BENCH_ASYNC": "1" if ad else "0",
+            "PST_BENCH_LABEL": label,
+        })
+        r = None
+        wedged = False
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
         try:
-            r = run_config(k, ps, ad, label)
-        except Exception as e:  # noqa: BLE001 — record, keep sweeping
-            r = {"metric": f"sweep-config-failed: {label}", "value": 0.0,
+            stdout, _ = proc.communicate(timeout=per_config_timeout)
+        except subprocess.TimeoutExpired:
+            # SIGTERM, never SIGKILL: the child owns the chip session and
+            # must release it via its handler (see utils/chip_guard.py)
+            proc.terminate()
+            try:
+                stdout, _ = proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                # the child ignored SIGTERM: it still holds the chip
+                # flock, so any further config would fail instantly with
+                # ChipBusyError — abort the sweep instead of recording
+                # lock errors as measurements (and leaving a zombie)
+                stdout = ""
+                wedged = True
+            r = {"metric": f"sweep-config-timeout: {label}", "value": 0.0,
                  "unit": "gen_tokens/s/chip", "vs_baseline": 0.0,
-                 "error": f"{type(e).__name__}: {e}"[:300]}
+                 "error": f"no result after {per_config_timeout:.0f}s"
+                          + ("; child unresponsive to SIGTERM, sweep "
+                             "aborted" if wedged else "")}
+        if r is None:
+            last = [ln for ln in (stdout or "").splitlines()
+                    if ln.startswith("{")]
+            try:
+                r = json.loads(last[-1])
+            except (IndexError, ValueError):
+                r = {"metric": f"sweep-config-failed: {label}",
+                     "value": 0.0, "unit": "gen_tokens/s/chip",
+                     "vs_baseline": 0.0,
+                     "error": f"exit={proc.returncode}, no JSON line"}
         print(f"# sweep {label}: {json.dumps(r)}", file=sys.stderr)
         results.append(r)
         with open(out_path, "w") as f:
             json.dump({"ts": time.strftime("%FT%TZ", time.gmtime()),
                        "model": MODEL, "results": results}, f, indent=1)
+        if wedged:
+            break
     best = max(results, key=lambda r: r.get("value", 0.0))
     print(json.dumps(best))
+
+
+def _arm_watchdog(seconds: float, label: str):
+    """Abort (with the driver-contract JSON line) if the run wedges.
+
+    `_init_backend_or_die` bounds backend INIT, but a chip that dies
+    MID-run leaves the main thread blocked inside a C call the
+    SIGTERM->SystemExit handler cannot interrupt (observed round 5: KV
+    alloc sleep-polling a dropped tunnel for 10+ min). A daemon timer
+    prints the abort row and hard-exits; os._exit is acceptable here
+    because the tunnel session is already dead."""
+    import threading
+
+    def fire() -> None:
+        print(json.dumps({
+            "metric": f"bench-aborted: watchdog ({label})",
+            "value": 0.0,
+            "unit": "gen_tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{label} exceeded {seconds:.0f}s — chip wedged?",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
@@ -165,6 +249,11 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     import gc
 
     import jax  # noqa: F401 — backend already initialized
+
+    watchdog = _arm_watchdog(
+        float(os.environ.get("PST_BENCH_RUN_DEADLINE", "1200")),
+        f"run_config[{label}]",
+    )
 
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.llm_engine import LLMEngine
@@ -216,48 +305,41 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     )
     print(f"# warmup/compile {time.time() - t0:.1f}s", file=sys.stderr)
 
-    if PRECOMPILE and prefill_seqs > 1:
-        # sweep the packed-prefill (group, ctx) buckets the QPS-paced run
-        # can form (chunks are all max_prefill_chunk long; group sizes
-        # bucket to powers of two). Synthetic chunks write into
-        # unallocated high blocks: nothing reads them, and real prefills
-        # own their blocks exclusively.
+    if PRECOMPILE:
+        # compile every prefill program the QPS-paced run can reach so no
+        # XLA compile lands inside a TTFT/ITL measurement: lone arrivals
+        # take the SINGLE-sequence path (warmup packs its two prompts, so
+        # singles would otherwise first compile mid-run), bursts take the
+        # packed path at pow2 group sizes, and a fully prefix-cached
+        # prompt resumes with a 1-token tail chunk (see
+        # ModelRunner.precompile_prefill)
         t0 = time.time()
-        chunk_len = 512
-        nb = engine.runner.num_blocks
+        rnr = engine.runner
+        chunk = config.max_prefill_chunk
+        plen = SYSTEM_PROMPT_TOK + HISTORY_TOK
+        totals = sorted({
+            rnr._ctx_bucket(min(plen, p + chunk))
+            for p in range(0, plen, chunk)
+        })
+        tail_ctx = rnr._ctx_bucket(plen)
+        # a fully prefix-cached prompt resumes past the last whole-block
+        # boundary, so its tail chunk is plen - floor((plen-1)/bs)*bs
+        # tokens (in [1, block_size]) — use the exact length so the tail
+        # lands in the same t_pad bucket the timed run will reach
         bs = config.block_size
-        blocks_per = 2048 // bs
-        max_sweep = min(prefill_seqs, NUM_USERS)
-        # the sweep claims the TOP max_sweep*blocks_per block ids; the
-        # allocator hands out low ids first, so require the pool to be at
-        # least twice the swept range (plus warmup's prefix blocks) or
-        # skip — overwriting live cached K/V would corrupt the timed run
-        if nb < 2 * max_sweep * blocks_per + 64:
-            print(
-                f"# packed-prefill precompile skipped: pool {nb} blocks "
-                f"too small for a {max_sweep}x{blocks_per}-block sweep",
-                file=sys.stderr,
-            )
-            max_sweep = 0
+        tail_len = plen - ((plen - 1) // bs) * bs
+        singles = [(chunk, t) for t in totals] + [(tail_len, tail_ctx)]
+        groups = []
         s = 2
-        while s <= max_sweep:
-            for total in (512, 1024, 2048):
-                start = total - chunk_len
-                tabs = []
-                for i in range(s):
-                    first = nb - (i + 1) * blocks_per
-                    tabs.append(
-                        list(range(first, first + (total + bs - 1) // bs))
-                    )
-                engine.runner.prefill_batch(
-                    [[1] * chunk_len] * s,
-                    start_positions=[start] * s,
-                    block_tables=tabs,
-                    total_lens=[total] * s,
-                )
+        while s <= min(prefill_seqs, NUM_USERS):
+            groups += [(s, chunk, t) for t in totals]
             s *= 2
+        if prefill_seqs > 1:
+            groups.append((2, tail_len, tail_ctx))
+        ndisp = rnr.precompile_prefill(singles, groups)
         print(
-            f"# packed-prefill precompile {time.time() - t0:.1f}s",
+            f"# prefill precompile: {ndisp} dispatches in "
+            f"{time.time() - t0:.1f}s",
             file=sys.stderr,
         )
 
@@ -358,11 +440,28 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             **itl_p,
         },
     }
+    # the measurement is complete: disarm the abort watchdog BEFORE
+    # teardown, which can itself block on a dead tunnel — a hung
+    # shutdown must not overwrite a successful result with an abort
+    # row. Arm a teardown guard instead that EMITS the result and exits
+    # cleanly, so the measurement survives a wedged shutdown.
+    import threading
+
+    watchdog.cancel()
+
+    def emit_and_exit() -> None:
+        print(json.dumps(result), flush=True)
+        os._exit(0)
+
+    teardown_guard = threading.Timer(120.0, emit_and_exit)
+    teardown_guard.daemon = True
+    teardown_guard.start()
     # free the engine (params + KV cache) before the next sweep config
     # allocates its own — two live engines would OOM the chip's HBM
     engine.shutdown()
     del engine
     gc.collect()
+    teardown_guard.cancel()
     return result
 
 

@@ -22,6 +22,7 @@ import asyncio
 import json
 import random
 import string
+import sys
 import time
 from dataclasses import dataclass, field
 
@@ -144,14 +145,12 @@ class Benchmark:
         self.args = args
         self.records: list[RequestRecord] = []
         self.errors = 0
-        self.sessions = [
-            UserSession(i, args) for i in range(args.num_users)
-        ]
         self._convs = None
         if getattr(args, "sharegpt_path", None):
             self._convs = load_sharegpt(args.sharegpt_path)
-            for s in self.sessions:
-                s.sharegpt_conv = self._convs[s.user_id % len(self._convs)]
+        self.sessions = [
+            self._new_session(i) for i in range(args.num_users)
+        ]
         self._next_user_id = args.num_users
         self.sessions_completed = 0
         # sessions enter the free queue in run(): all at t=0, or
@@ -159,6 +158,14 @@ class Benchmark:
         # multi-round-qa.py:386 — a thundering herd at t=0 measures the
         # cold-start queue, not steady-state serving)
         self.free_sessions = asyncio.Queue()
+
+    def _new_session(self, user_id: int) -> UserSession:
+        """One construction path for initial AND recycled users, so the
+        two populations can't silently diverge."""
+        s = UserSession(user_id, self.args)
+        if self._convs is not None:
+            s.sharegpt_conv = self._convs[user_id % len(self._convs)]
+        return s
 
     async def run_request(self, session: UserSession,
                           http: aiohttp.ClientSession) -> None:
@@ -247,19 +254,29 @@ class Benchmark:
                     # session recycling (reference multi-round-qa.py:407):
                     # replace the finished user with a FRESH one so
                     # concurrency holds constant for the whole run
-                    fresh = UserSession(self._next_user_id, self.args)
+                    fresh = self._new_session(self._next_user_id)
                     self._next_user_id += 1
-                    if self._convs is not None:
-                        fresh.sharegpt_conv = self._convs[
-                            fresh.user_id % len(self._convs)
-                        ]
-                    self.sessions.append(fresh)
+                    # NOT appended to self.sessions: with recycling on,
+                    # nothing reads that list after admission, and keeping
+                    # every finished session's full chat history alive
+                    # grows memory for the whole run
                     self.free_sessions.put_nowait(fresh)
 
     async def _admit_sessions(self, t_start: float) -> None:
         """Feed users into the free queue: all at once, or staggered
         over --ramp-up-time."""
         ramp = self.args.ramp_up_time
+        if ramp >= self.args.duration:
+            # users admitted after the deadline would never run: the
+            # sweep point would silently measure lower concurrency than
+            # configured
+            print(
+                f"WARNING: --ramp-up-time {ramp}s >= --duration "
+                f"{self.args.duration}s; clamping ramp to "
+                f"{self.args.duration / 2:.1f}s so every user runs",
+                file=sys.stderr,
+            )
+            ramp = self.args.duration / 2
         if ramp <= 0:
             for s in self.sessions:
                 self.free_sessions.put_nowait(s)

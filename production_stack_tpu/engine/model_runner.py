@@ -1083,6 +1083,69 @@ class ModelRunner:
         )
         return sampled, logits
 
+    def precompile_prefill(
+        self,
+        singles: list[tuple[int, int]] = (),
+        groups: list[tuple[int, int, int]] = (),
+    ) -> int:
+        """Compile prefill programs ahead of serving by executing trash
+        chunks whose block tables point at the TOP of the block pool.
+
+        `singles`: (chunk_len, total_len) pairs for the single-sequence
+        path; `groups`: (group_size, chunk_len, total_len) for the packed
+        path. Returns the number of dispatches executed. A compile that
+        lands inside a live request costs seconds (tens of seconds
+        through a remote/tunneled chip) and lands straight in that
+        request's TTFT/ITL, so servers and benches call this at startup
+        for every bucket the configured workload shape can reach —
+        including the resume-tail chunk (a fully prefix-cached prompt
+        re-prefills only its final token, chunk_len=1).
+
+        The allocator hands out low block ids first; this sweep claims
+        the top ids and requires, per entry, the pool to be at least
+        twice the claimed range plus slack — entries too big for the pool
+        are skipped individually (with a warning) rather than risk
+        overwriting live cached K/V.
+        """
+        bs = self.block_size
+        nb = self.num_blocks
+        n = 0
+        for chunk_len, total in singles:
+            bp = (total + bs - 1) // bs
+            if nb < 2 * bp + 64:
+                logger.warning(
+                    "prefill precompile: skipping single (%d, %d) — pool "
+                    "of %d blocks too small", chunk_len, total, nb,
+                )
+                continue
+            self.prefill(
+                [1] * chunk_len,
+                total - chunk_len,
+                list(range(nb - bp, nb)),
+                total,
+            )
+            n += 1
+        for s, chunk_len, total in groups:
+            bp = (total + bs - 1) // bs
+            if nb < 2 * s * bp + 64:
+                logger.warning(
+                    "prefill precompile: skipping group (%d, %d, %d) — "
+                    "pool of %d blocks too small", s, chunk_len, total, nb,
+                )
+                continue
+            tabs = [
+                list(range(nb - (i + 1) * bp, nb - i * bp))
+                for i in range(s)
+            ]
+            self.prefill_batch(
+                [[1] * chunk_len] * s,
+                start_positions=[total - chunk_len] * s,
+                block_tables=tabs,
+                total_lens=[total] * s,
+            )
+            n += 1
+        return n
+
     def decode(
         self,
         token_ids: list[int],

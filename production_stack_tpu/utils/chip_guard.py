@@ -106,6 +106,25 @@ def engage(lock_path: str | None = None) -> ChipLock | None:
     CPU-only runs (JAX_PLATFORMS=cpu) take no lock and keep their
     default SIGTERM semantics (e.g. aiohttp's graceful shutdown)."""
     if not chip_guard_needed():
+        # the axon TPU plugin ignores the JAX_PLATFORMS env var and
+        # registers the tunneled chip anyway — enforce via jax.config so
+        # the no-lock decision made from the env var is actually safe
+        # (tests/conftest.py applies the same override for pytest runs)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except (ImportError, RuntimeError) as e:
+            # if the override fails (e.g. the backend is already
+            # initialized), this process may dial the chip LOCK-FREE —
+            # the exact second-dial wedge this module exists to prevent
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "chip_guard: could not force jax_platforms=cpu (%s); "
+                "this process may reach the real chip without the lock",
+                e,
+            )
         return None
     install_sigterm_handler()
     return acquire_chip_lock(lock_path)

@@ -188,7 +188,10 @@ def test_recycle_holds_concurrency(tmp_path):
 
     asyncio.new_event_loop().run_until_complete(scenario())
     assert b.sessions_completed == 1
-    fresh = b.sessions[-1]
+    assert b.free_sessions.qsize() == 1  # concurrency held
+    fresh = b.free_sessions.get_nowait()
     assert fresh.user_id == 2  # new identity, fresh history
     assert fresh.history == [] and fresh.rounds_done == 0
-    assert b.free_sessions.qsize() == 1  # concurrency held
+    # finished sessions are NOT retained: their chat history would
+    # otherwise accumulate for the whole run
+    assert len(b.sessions) == 2

@@ -281,3 +281,53 @@ def test_packing_respects_decode_interleave_bound():
         ))
     out = sched.schedule()
     assert len(out.prefills) == 6
+
+
+def test_precompile_prefill_covers_serving_buckets():
+    """precompile_prefill compiles the single/packed/tail programs a
+    QPS-paced workload reaches, so no XLA compile lands inside a live
+    request's TTFT (the round-5 bench found 6-15 s tunnel compiles
+    inside the timed run for exactly these keys)."""
+    eng = LLMEngine(tiny_cfg(max_prefill_seqs=8))
+    r = eng.runner
+    n = r.precompile_prefill(
+        singles=[(16, 16), (16, 32), (4, 32)],
+        groups=[(2, 16, 32), (4, 16, 32)],
+    )
+    assert n == 5
+    for chunk, total in [(16, 16), (16, 32), (4, 32)]:
+        assert (r._prefill_bucket(chunk), total) in r._prefill_fns
+    assert (2, 16, 32) in r._prefill_batch_fns
+    assert (4, 16, 32) in r._prefill_batch_fns
+
+    # generating through the engine afterwards must not add prefill keys
+    # for a workload whose buckets were precompiled
+    before = set(r._prefill_fns)
+    eng.generate([list(range(1, 17))], greedy(2))
+    assert set(r._prefill_fns) == before
+
+
+def test_precompile_prefill_pool_guard_skips_oversized():
+    """Entries whose trash-block claim could alias live cache blocks are
+    skipped individually; small entries still compile."""
+    eng = LLMEngine(tiny_cfg(num_kv_blocks=40, max_prefill_seqs=8))
+    r = eng.runner
+    # single at 32 tokens = 8 blocks: 2*8+64 > 40 -> skipped
+    # packed 2x16 tokens = 2*4 blocks: 2*8+64 > 40 -> skipped
+    n = r.precompile_prefill(singles=[(16, 32)], groups=[(2, 16, 16)])
+    assert n == 0
+    assert (16, 32) not in r._prefill_fns
+    assert (2, 16, 16) not in r._prefill_batch_fns
+
+
+def test_precompile_prefill_leaves_cache_semantics_intact():
+    """A precompile sweep must not corrupt subsequent generation: outputs
+    with and without a preceding sweep are identical."""
+    plain = LLMEngine(tiny_cfg(max_prefill_seqs=8))
+    swept = LLMEngine(tiny_cfg(max_prefill_seqs=8))
+    swept.runner.precompile_prefill(
+        singles=[(16, 32)], groups=[(2, 16, 32)]
+    )
+    out_a = [o.token_ids for o in plain.generate(_prompts(), greedy(6))]
+    out_b = [o.token_ids for o in swept.generate(_prompts(), greedy(6))]
+    assert out_a == out_b
