@@ -191,11 +191,20 @@ def _run_sweep() -> None:
                 # lock errors as measurements (and leaving a zombie)
                 stdout = ""
                 wedged = True
-            r = {"metric": f"sweep-config-timeout: {label}", "value": 0.0,
-                 "unit": "gen_tokens/s/chip", "vs_baseline": 0.0,
-                 "error": f"no result after {per_config_timeout:.0f}s"
-                          + ("; child unresponsive to SIGTERM, sweep "
-                             "aborted" if wedged else "")}
+            # a graceful SIGTERM shutdown (or the child's own teardown
+            # guard) may still have emitted a COMPLETED measurement —
+            # prefer it over a synthetic timeout row
+            last = [ln for ln in (stdout or "").splitlines()
+                    if ln.startswith("{")]
+            try:
+                r = json.loads(last[-1])
+            except (IndexError, ValueError):
+                r = {"metric": f"sweep-config-timeout: {label}",
+                     "value": 0.0, "unit": "gen_tokens/s/chip",
+                     "vs_baseline": 0.0,
+                     "error": f"no result after {per_config_timeout:.0f}s"
+                              + ("; child unresponsive to SIGTERM, sweep "
+                                 "aborted" if wedged else "")}
         if r is None:
             last = [ln for ln in (stdout or "").splitlines()
                     if ln.startswith("{")]
@@ -315,8 +324,11 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         # ModelRunner.precompile_prefill)
         t0 = time.time()
         rnr = engine.runner
-        chunk = config.max_prefill_chunk
         plen = SYSTEM_PROMPT_TOK + HISTORY_TOK
+        # a prompt shorter than one chunk prefills in a single sub-chunk
+        # dispatch — precompiling the full-chunk bucket would miss the
+        # bucket the run actually hits (and imply a negative start)
+        chunk = min(config.max_prefill_chunk, plen)
         totals = sorted({
             rnr._ctx_bucket(min(plen, p + chunk))
             for p in range(0, plen, chunk)

@@ -9,6 +9,7 @@ configs translate mechanically.
 from __future__ import annotations
 
 import argparse
+import os
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.server import EngineServer
@@ -77,8 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tool-call-parser", default="hermes",
                    choices=["hermes"],
                    help="tool-call output format to parse")
-    p.add_argument("--api-key", default=None,
-                   help="require `Authorization: Bearer <key>` on /v1/*")
+    p.add_argument("--api-key", default=os.environ.get("PST_API_KEY"),
+                   help="require `Authorization: Bearer <key>` on /v1/* "
+                        "(default: $PST_API_KEY, so k8s can mount the key "
+                        "as a Secret env instead of exposing it on argv)")
+    p.add_argument("--chat-template", default=None,
+                   help="Jinja chat-template override: a template string "
+                        "or a path to a template file")
     p.add_argument("--attention-impl", default="auto",
                    choices=["auto", "xla", "pallas"])
     # disaggregated prefill / KV transfer
@@ -117,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
     return EngineConfig(
         model=args.model,
         tokenizer=args.tokenizer,
+        chat_template=args.chat_template,
         dtype=args.dtype,
         cache_dtype=args.kv_cache_dtype,
         seed=args.seed,

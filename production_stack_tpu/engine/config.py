@@ -11,6 +11,9 @@ from production_stack_tpu.models.config import ModelConfig, get_model_config
 class EngineConfig:
     model: str = "pst-tiny-debug"
     tokenizer: str | None = None  # defaults to model path; "byte" for tests
+    # optional Jinja chat-template override (string or file path) applied
+    # over whatever the tokenizer ships (reference: helm chatTemplate)
+    chat_template: str | None = None
     dtype: str = "bfloat16"
     cache_dtype: str = "bfloat16"
     seed: int = 0

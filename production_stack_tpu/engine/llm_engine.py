@@ -46,7 +46,10 @@ class LLMEngine:
             )
 
             validate_multihost_config(config)
-        self.tokenizer = get_tokenizer(config.tokenizer, config.model)
+        self.tokenizer = get_tokenizer(
+            config.tokenizer, config.model,
+            chat_template=config.chat_template,
+        )
         self.runner = ModelRunner(config, params=params)
         if config.multihost:
             from production_stack_tpu.engine.multihost_engine import (

@@ -54,6 +54,9 @@ class ByteTokenizer:
         ]
 
     def apply_chat_template(self, messages: list[dict]) -> str:
+        override = getattr(self, "chat_template_override", None)
+        if override is not None:
+            return _render_template(override, messages)
         parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
         parts.append("<|assistant|>\n")
         return "".join(parts)
@@ -79,18 +82,27 @@ class HFTokenizer:
         return self._tok.decode(token_ids, skip_special_tokens=True)
 
     def apply_chat_template(self, messages: list[dict]) -> str:
+        override = getattr(self, "chat_template_override", None)
+        if override is not None:
+            return _render_template(override, messages)
         if self._tok.chat_template is not None:
             return self._tok.apply_chat_template(
                 messages, tokenize=False, add_generation_prompt=True
             )
-        import jinja2
-
-        return jinja2.Template(_DEFAULT_CHAT_TEMPLATE).render(
-            messages=messages, add_generation_prompt=True
-        )
+        return _render_template(_DEFAULT_CHAT_TEMPLATE, messages)
 
 
-def get_tokenizer(spec: str | None, model: str) -> Tokenizer:
+def _render_template(template: str, messages: list[dict]) -> str:
+    import jinja2
+
+    return jinja2.Template(template).render(
+        messages=messages, add_generation_prompt=True
+    )
+
+
+def get_tokenizer(
+    spec: str | None, model: str, chat_template: str | None = None
+) -> Tokenizer:
     """Resolve the tokenizer.
 
     - explicit "byte" -> hermetic ByteTokenizer
@@ -98,6 +110,11 @@ def get_tokenizer(spec: str | None, model: str) -> Tokenizer:
       would serve garbage tokens against real weights)
     - no spec: the model dir if it is one, else (weight-free preset) the
       ByteTokenizer with a log line.
+
+    ``chat_template``: optional Jinja override (a template string, or a
+    path to a file containing one) applied over whatever the tokenizer
+    ships — the ``--chat-template`` serving knob (reference capability:
+    helm/values.yaml ``chatTemplate`` per modelSpec).
     """
     from production_stack_tpu.utils import init_logger
 
@@ -105,16 +122,32 @@ def get_tokenizer(spec: str | None, model: str) -> Tokenizer:
     explicit = spec is not None
     spec = spec or model
     if spec == "byte":
-        return ByteTokenizer()
-    if os.path.isdir(spec):
-        return HFTokenizer(spec)  # raises on a broken checkpoint dir
-    if explicit:
+        tok: Tokenizer = ByteTokenizer()
+    elif os.path.isdir(spec):
+        tok = HFTokenizer(spec)  # raises on a broken checkpoint dir
+    elif explicit:
         raise ValueError(
             f"tokenizer path {spec!r} does not exist; pass 'byte' for the "
             "hermetic byte tokenizer"
         )
-    logger.info(
-        "model %r is a weight-free preset; using the hermetic byte "
-        "tokenizer", model,
-    )
-    return ByteTokenizer()
+    else:
+        logger.info(
+            "model %r is a weight-free preset; using the hermetic byte "
+            "tokenizer", model,
+        )
+        tok = ByteTokenizer()
+    if chat_template:
+        if os.path.isfile(chat_template):
+            with open(chat_template) as f:
+                chat_template = f.read()
+        elif "{" not in chat_template:
+            # path-looking string (no Jinja syntax) whose file is
+            # missing: rendering it verbatim would silently corrupt
+            # every chat prompt — fail at startup instead
+            raise ValueError(
+                f"chat template file {chat_template!r} does not exist "
+                "(an inline template must contain Jinja '{{ ... }}' "
+                "syntax)"
+            )
+        tok.chat_template_override = chat_template  # type: ignore[union-attr]
+    return tok
