@@ -170,7 +170,7 @@ def _run_sweep() -> None:
             "PST_BENCH_ASYNC": "1" if ad else "0",
             "PST_BENCH_LABEL": label,
         })
-        r = None
+        timed_out = False
         wedged = False
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
@@ -179,6 +179,7 @@ def _run_sweep() -> None:
         try:
             stdout, _ = proc.communicate(timeout=per_config_timeout)
         except subprocess.TimeoutExpired:
+            timed_out = True
             # SIGTERM, never SIGKILL: the child owns the chip session and
             # must release it via its handler (see utils/chip_guard.py)
             proc.terminate()
@@ -191,30 +192,22 @@ def _run_sweep() -> None:
                 # lock errors as measurements (and leaving a zombie)
                 stdout = ""
                 wedged = True
-            # a graceful SIGTERM shutdown (or the child's own teardown
-            # guard) may still have emitted a COMPLETED measurement —
-            # prefer it over a synthetic timeout row
-            last = [ln for ln in (stdout or "").splitlines()
-                    if ln.startswith("{")]
-            try:
-                r = json.loads(last[-1])
-            except (IndexError, ValueError):
-                r = {"metric": f"sweep-config-timeout: {label}",
-                     "value": 0.0, "unit": "gen_tokens/s/chip",
-                     "vs_baseline": 0.0,
-                     "error": f"no result after {per_config_timeout:.0f}s"
-                              + ("; child unresponsive to SIGTERM, sweep "
-                                 "aborted" if wedged else "")}
-        if r is None:
-            last = [ln for ln in (stdout or "").splitlines()
-                    if ln.startswith("{")]
-            try:
-                r = json.loads(last[-1])
-            except (IndexError, ValueError):
-                r = {"metric": f"sweep-config-failed: {label}",
-                     "value": 0.0, "unit": "gen_tokens/s/chip",
-                     "vs_baseline": 0.0,
-                     "error": f"exit={proc.returncode}, no JSON line"}
+        # even on timeout, a graceful SIGTERM shutdown (or the child's
+        # teardown guard) may have emitted a COMPLETED measurement —
+        # prefer it over a synthetic failure row
+        r = _last_json(stdout)
+        if r is None and timed_out:
+            r = {"metric": f"sweep-config-timeout: {label}",
+                 "value": 0.0, "unit": "gen_tokens/s/chip",
+                 "vs_baseline": 0.0,
+                 "error": f"no result after {per_config_timeout:.0f}s"
+                          + ("; child unresponsive to SIGTERM, sweep "
+                             "aborted" if wedged else "")}
+        elif r is None:
+            r = {"metric": f"sweep-config-failed: {label}",
+                 "value": 0.0, "unit": "gen_tokens/s/chip",
+                 "vs_baseline": 0.0,
+                 "error": f"exit={proc.returncode}, no JSON line"}
         print(f"# sweep {label}: {json.dumps(r)}", file=sys.stderr)
         results.append(r)
         with open(out_path, "w") as f:
@@ -224,6 +217,16 @@ def _run_sweep() -> None:
             break
     best = max(results, key=lambda r: r.get("value", 0.0))
     print(json.dumps(best))
+
+
+def _last_json(stdout: str | None) -> dict | None:
+    """Parse the last driver-contract JSON line from a child's stdout."""
+    lines = [ln for ln in (stdout or "").splitlines()
+             if ln.startswith("{")]
+    try:
+        return json.loads(lines[-1])
+    except (IndexError, ValueError):
+        return None
 
 
 def _arm_watchdog(seconds: float, label: str):
@@ -325,12 +328,13 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         t0 = time.time()
         rnr = engine.runner
         plen = SYSTEM_PROMPT_TOK + HISTORY_TOK
-        # a prompt shorter than one chunk prefills in a single sub-chunk
-        # dispatch — precompiling the full-chunk bucket would miss the
-        # bucket the run actually hits (and imply a negative start)
-        chunk = min(config.max_prefill_chunk, plen)
-        totals = sorted({
-            rnr._ctx_bucket(min(plen, p + chunk))
+        chunk = config.max_prefill_chunk
+        # walk the actual chunking: each sub-chunk is min(chunk, plen-p)
+        # tokens at total p+len — a short FINAL sub-chunk (plen % chunk)
+        # lands in its own smaller t_pad bucket and must be precompiled
+        # too, or its compile lands inside a live TTFT measurement
+        pieces = sorted({
+            (min(chunk, plen - p), rnr._ctx_bucket(p + min(chunk, plen - p)))
             for p in range(0, plen, chunk)
         })
         tail_ctx = rnr._ctx_bucket(plen)
@@ -340,11 +344,11 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         # lands in the same t_pad bucket the timed run will reach
         bs = config.block_size
         tail_len = plen - ((plen - 1) // bs) * bs
-        singles = [(chunk, t) for t in totals] + [(tail_len, tail_ctx)]
+        singles = pieces + [(tail_len, tail_ctx)]
         groups = []
         s = 2
         while s <= min(prefill_seqs, NUM_USERS):
-            groups += [(s, chunk, t) for t in totals]
+            groups += [(s, cl, t) for cl, t in pieces]
             s *= 2
         if prefill_seqs > 1:
             groups.append((2, tail_len, tail_ctx))

@@ -798,9 +798,43 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
+    def _decode_pack_layout(self, b: int, c_pad: int, chained: bool):
+        """Static layout of the ONE int32 host->device buffer a
+        multi-step decode dispatch ships.
+
+        Through a remote/tunneled chip every separate buffer creation
+        pays link latency; packing the ~8 small per-dispatch arrays
+        (tokens, positions, context lens, sampling params, page tables)
+        into one transfer makes the h2d cost one RPC instead of eight.
+        f32/u32 fields travel bitcast as i32 and are bitcast back on
+        device. Returns ({name: (offset, shape)}, total_len)."""
+        n_pages = c_pad // self.block_size
+        fields: list[tuple[str, tuple[int, ...]]] = []
+        if not chained:
+            fields.append(("tokens", (b,)))
+        fields += [
+            ("positions", (b,)),
+            ("ctx", (b,)),
+            ("temps", (b,)),
+            ("top_ps", (b,)),
+            ("top_ks", (b,)),
+            ("keys", (b, 2)),
+            ("page_tables", (b, n_pages)),
+        ]
+        if self.attention_impl != "pallas":
+            fields.append(("gather_tables", (b, c_pad)))
+        layout: dict[str, tuple[int, tuple[int, ...]]] = {}
+        off = 0
+        for name, shape in fields:
+            n = int(np.prod(shape))
+            layout[name] = (off, shape)
+            off += n
+        return layout, off
+
     def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
                             use_penalties: bool = False,
-                            want_logprobs: bool = False):
+                            want_logprobs: bool = False,
+                            chained: bool = False):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -812,6 +846,10 @@ class ModelRunner:
         loop is the same idea). The per-iteration sampling keys are
         (seed, generated_len + i) — bit-identical to K single steps, so
         multi-step changes throughput, never outputs.
+
+        Host-side inputs arrive as ONE packed i32 buffer
+        (`_decode_pack_layout`); `chained=True` builds the variant whose
+        tokens come from the previous round's on-device output instead.
         """
         mc = self.model_config
         scale = self._scale
@@ -852,13 +890,37 @@ class ModelRunner:
                 )
 
         use_pages = self.attention_impl == "pallas"
+        layout, _total = self._decode_pack_layout(b, c_pad, chained)
 
-        def step(params, kc, vc, tokens, positions, page_tables,
-                 gather_tables, context_lens, temps, top_ps, top_ks,
-                 base_keys, gen_ids=None, presence=None, frequency=None,
+        def _seg(packed, name):
+            off, shape = layout[name]
+            n = int(np.prod(shape))
+            return packed[off:off + n].reshape(shape)  # static slice
+
+        def step(params, kc, vc, packed, chained_tokens=None,
+                 gen_ids=None, presence=None, frequency=None,
                  repetition=None, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             lane = jnp.arange(b)
+            tokens = (
+                chained_tokens if chained else _seg(packed, "tokens")
+            )
+            positions = _seg(packed, "positions")
+            context_lens = _seg(packed, "ctx")
+            temps = jax.lax.bitcast_convert_type(
+                _seg(packed, "temps"), jnp.float32
+            )
+            top_ps = jax.lax.bitcast_convert_type(
+                _seg(packed, "top_ps"), jnp.float32
+            )
+            top_ks = _seg(packed, "top_ks")
+            base_keys = jax.lax.bitcast_convert_type(
+                _seg(packed, "keys"), jnp.uint32
+            )
+            page_tables = _seg(packed, "page_tables")
+            gather_tables = (
+                _seg(packed, "gather_tables") if not use_pages else None
+            )
 
             if use_penalties:
                 # per-lane generated-token counts, maintained ON DEVICE
@@ -1261,16 +1323,27 @@ class ModelRunner:
         b_actual = len(positions) if chained else len(token_ids)
         c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
 
-        if chained:
-            tokens_arg = token_ids  # already (b,) on device
-        else:
+        # ONE packed i32 host->device buffer per dispatch (layout shared
+        # with the jitted unpack, _decode_pack_layout): through the
+        # tunneled chip each separate buffer creation pays link latency
+        layout, total = self._decode_pack_layout(b, c_pad, chained)
+        packed = np.zeros((total,), np.int32)
+
+        def put(name, arr):
+            off, shape = layout[name]
+            n = int(np.prod(shape))
+            packed[off:off + n] = arr.reshape(-1).view(np.int32)
+
+        if not chained:
             tokens = np.zeros((b,), dtype=np.int32)
             tokens[:b_actual] = token_ids
-            tokens_arg = jnp.asarray(tokens)
+            put("tokens", tokens)
         pos = np.zeros((b,), dtype=np.int32)
         pos[:b_actual] = positions
+        put("positions", pos)
         ctx = np.ones((b,), dtype=np.int32)
         ctx[:b_actual] = context_lens
+        put("ctx", ctx)
 
         n_pages = c_pad // self.block_size
         page_tables = np.stack(
@@ -1281,23 +1354,27 @@ class ModelRunner:
                 for i in range(b)
             ]
         )
-        if self.attention_impl == "pallas":
-            gather_tables = np.zeros((1, 1), dtype=np.int32)  # unused
-        else:
+        put("page_tables", page_tables)
+        if self.attention_impl != "pallas":
             gather_tables = np.zeros((b, c_pad), dtype=np.int32)
             for i in range(b_actual):
                 gather_tables[i] = self._gather_slots_for_table(
                     block_tables[i], c_pad
                 )
+            put("gather_tables", gather_tables)
 
         t_full = np.zeros((b,), np.float32)
         t_full[:b_actual] = temps
+        put("temps", t_full)
         p_full = np.ones((b,), np.float32)
         p_full[:b_actual] = top_ps
+        put("top_ps", p_full)
         k_full = np.full((b,), -1, np.int32)
         k_full[:b_actual] = top_ks
+        put("top_ks", k_full)
         key_full = np.zeros((b, 2), np.uint32)
         key_full[:b_actual] = keys
+        put("keys", key_full)
 
         pen_kw = {}
         if penalties is not None:
@@ -1323,16 +1400,17 @@ class ModelRunner:
             }
 
         cache_key = (b, c_pad, steps, penalties is not None,
-                     want_logprobs)
+                     want_logprobs, chained)
         if cache_key not in self._decode_multi_fns:
             logger.info(
                 "compiling multi-step decode b=%d ctx=%d k=%d pen=%s "
-                "lp=%s",
+                "lp=%s chained=%s",
                 b, c_pad, steps, penalties is not None, want_logprobs,
+                chained,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
-                want_logprobs=want_logprobs,
+                want_logprobs=want_logprobs, chained=chained,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}
@@ -1344,19 +1422,13 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": jnp.asarray(slots),
             }
+        chained_kw = {"chained_tokens": token_ids} if chained else {}
         ys, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
-            tokens_arg,
-            jnp.asarray(pos),
-            jnp.asarray(page_tables),
-            jnp.asarray(gather_tables),
-            jnp.asarray(ctx),
-            jnp.asarray(t_full),
-            jnp.asarray(p_full),
-            jnp.asarray(k_full),
-            jnp.asarray(key_full),
+            jnp.asarray(packed),
+            **chained_kw,
             **pen_kw,
             **lora_kw,
         )
