@@ -347,6 +347,10 @@ class LLMEngine:
         k = pend["k"]
         if any(s.finished for s in seqs):  # stopped/aborted mid-flight
             return False
+        if any(self._is_guided(s) for s in seqs):
+            # the chained dispatch carries no DFA tables; guided lanes
+            # resolve each round so their device states re-initialize
+            return False
         if set(id(s) for s in self.scheduler.running) != set(
             id(s) for s in seqs
         ):
@@ -597,12 +601,17 @@ class LLMEngine:
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
             k_steps = self.config.num_scheduler_steps
-            # guided lanes need a host-side logit mask every token, so
-            # they ride the single-step path regardless of K (the
-            # documented guided-vs-multistep cliff; masks are cached per
-            # machine state so steady-state cost is one dict lookup)
+            # guided lanes ride the fused multi-step scan via on-device
+            # TokenDFA tables (structured.TokenDFA — outlines-style
+            # FSM-index compilation); only constraints too large to
+            # compile under budget fall back to the host-masked
+            # single-step path below
+            guided_tables = None
             needs_guided = any(self._is_guided(s) for s in seqs)
-            if k_steps > 1 and not needs_guided:
+            if needs_guided and k_steps > 1:
+                guided_tables = self._device_guided_tables(seqs)
+            if k_steps > 1 and (not needs_guided
+                                or guided_tables is not None):
                 temps, top_ps, top_ks, keys, needs_pen = (
                     self._sampling_arrays(seqs)
                 )
@@ -633,11 +642,13 @@ class LLMEngine:
                     lora_slots=[self._lora_slot(s) for s in seqs],
                     penalties=penalties,
                     want_logprobs=want_lp,
+                    guided=guided_tables,
                 )  # (k, b) on device [+ logprob arrays]
                 toks_dev, lps_dev = (
                     (ys[0], ys[1:]) if want_lp else (ys, None)
                 )
-                if self._async_decode and penalties is None:
+                if (self._async_decode and penalties is None
+                        and guided_tables is None):
                     # start the double-buffered pipeline: leave the
                     # tokens on device; the NEXT step dispatches the
                     # following round before fetching this one
@@ -903,6 +914,108 @@ class LLMEngine:
             # choice unreachable
             allowed.add(int(seq.eos_token_id))
         return allowed
+
+    def _device_guided_tables(self, seqs: list[Sequence]):
+        """Assemble TokenDFA tables for a batch with guided lanes so the
+        fused multi-step scan can evaluate the constraints ON DEVICE
+        (fixes the guided-vs-multistep cliff: guided lanes previously
+        forced the whole batch onto the single-step host-mask path).
+
+        Returns the `guided` tuple ModelRunner.decode_multi takes, or
+        None when any guided lane's constraint is too large to compile
+        under budget (the caller keeps the host path). Unguided lanes
+        ride a shared trivial allow-everything machine."""
+        from production_stack_tpu.engine.structured import get_token_dfa
+
+        vocab = self.runner.model_config.vocab_size
+        mask_cache = self._mask_cache()
+        lane_dfas: list = []
+        for s in seqs:
+            machine = getattr(s, "_guided_machine", None)
+            choices = getattr(s, "_guided_choices", None)
+            if machine is None and choices is None:
+                lane_dfas.append(None)
+                continue
+            # a missing EOS id is legal for guided_choice (the machine
+            # kinds reject it at request admission); -1 simply never
+            # lands in the vocab-range EOS column
+            eos = (int(s.eos_token_id)
+                   if s.eos_token_id is not None else -1)
+            dfa = get_token_dfa(
+                machine if machine is not None else choices,
+                mask_cache, vocab, eos,
+            )
+            if dfa is None:
+                return None  # over budget: host path
+            lane_dfas.append(dfa)
+
+        distinct: list = []
+        for d in lane_dfas:
+            if d is not None and all(d is not x for x in distinct):
+                distinct.append(d)
+        # machine row M-1 (after padding: the last REAL row) is the
+        # trivial allow-all machine for unguided lanes
+        n_real = len(distinct) + 1
+        offsets: dict[int, int] = {}
+        off = 0
+        for d in distinct:
+            offsets[id(d)] = off
+            off += d.num_states
+        free_state = off
+        s_total = off + 1
+        c_max = max([d.num_classes for d in distinct] + [1])
+        s_pad = 1 << (s_total - 1).bit_length()
+        c_pad = 1 << (c_max - 1).bit_length()
+        m_pad = 1 << (n_real - 1).bit_length()
+        # identity via TokenDFA.serial, NOT id(): ids recycle once the
+        # structured-module LRU evicts a DFA, which would silently serve
+        # a stale constraint's device tables
+        cache_token = (
+            tuple(d.serial for d in distinct), s_pad, c_pad, m_pad,
+        )
+
+        cached = getattr(self, "_guided_host_tables", None)
+        if cached is not None and cached[0] == cache_token:
+            _, token_class, class_mask, class_trans = cached
+        else:
+            token_class = np.zeros((m_pad, vocab), np.int32)
+            class_mask = np.zeros((s_pad, c_pad), bool)
+            class_trans = np.tile(
+                np.arange(s_pad, dtype=np.int32)[:, None], (1, c_pad)
+            )
+            for mi, d in enumerate(distinct):
+                token_class[mi] = d.token_class
+                o = offsets[id(d)]
+                S, C = d.class_mask.shape
+                class_mask[o:o + S, :C] = d.class_mask
+                class_trans[o:o + S, :C] = d.class_trans + o
+            # allow-all for unguided lanes
+            class_mask[free_state, :] = True
+            self._guided_host_tables = (
+                cache_token, token_class, class_mask, class_trans,
+            )
+
+        init_states = np.zeros((len(seqs),), np.int32)
+        lane_map = np.zeros((len(seqs),), np.int32)
+        for i, (s, d) in enumerate(zip(seqs, lane_dfas)):
+            if d is None:
+                init_states[i] = free_state
+                lane_map[i] = n_real - 1
+                continue
+            machine = getattr(s, "_guided_machine", None)
+            host_state = (
+                s._guided_state if machine is not None
+                else tuple(s.generated_token_ids)
+            )
+            idx = d.state_index.get(host_state)
+            if idx is None:
+                # a frozen/strayed state the DFA never enumerated: keep
+                # the host path for this batch
+                return None
+            init_states[i] = offsets[id(d)] + idx
+            lane_map[i] = distinct.index(d)
+        return (cache_token, init_states, lane_map, token_class,
+                class_mask, class_trans)
 
     def _apply_guided_mask(self, seqs: list[Sequence], logits):
         """-inf everything outside each lane's allowed-token set."""

@@ -798,7 +798,8 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
-    def _decode_pack_layout(self, b: int, c_pad: int, chained: bool):
+    def _decode_pack_layout(self, b: int, c_pad: int, chained: bool,
+                            guided: bool = False):
         """Static layout of the ONE int32 host->device buffer a
         multi-step decode dispatch ships.
 
@@ -821,6 +822,10 @@ class ModelRunner:
             ("keys", (b, 2)),
             ("page_tables", (b, n_pages)),
         ]
+        if guided:
+            # per-lane DFA state + machine row (the big tables travel
+            # separately, device-cached across dispatches)
+            fields += [("g_state", (b,)), ("g_lane", (b,))]
         if self.attention_impl != "pallas":
             fields.append(("gather_tables", (b, c_pad)))
         layout: dict[str, tuple[int, tuple[int, ...]]] = {}
@@ -834,7 +839,8 @@ class ModelRunner:
     def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
                             use_penalties: bool = False,
                             want_logprobs: bool = False,
-                            chained: bool = False):
+                            chained: bool = False,
+                            guided_shapes: tuple | None = None):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -890,7 +896,9 @@ class ModelRunner:
                 )
 
         use_pages = self.attention_impl == "pallas"
-        layout, _total = self._decode_pack_layout(b, c_pad, chained)
+        layout, _total = self._decode_pack_layout(
+            b, c_pad, chained, guided=guided_shapes is not None
+        )
 
         def _seg(packed, name):
             off, shape = layout[name]
@@ -898,6 +906,7 @@ class ModelRunner:
             return packed[off:off + n].reshape(shape)  # static slice
 
         def step(params, kc, vc, packed, chained_tokens=None,
+                 g_token_class=None, g_class_mask=None, g_class_trans=None,
                  gen_ids=None, presence=None, frequency=None,
                  repetition=None, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
@@ -933,8 +942,17 @@ class ModelRunner:
             else:
                 counts0 = jnp.zeros((b, 1), jnp.float32)  # unused carry
 
+            if guided_shapes is not None:
+                # (b, V) class of every token for each lane's machine,
+                # gathered once per dispatch outside the scan
+                lane_tc = g_token_class[_seg(packed, "g_lane")]
+                g_state0 = _seg(packed, "g_state")
+            else:
+                lane_tc = None
+                g_state0 = jnp.zeros((b,), jnp.int32)  # unused carry
+
             def one(carry, i):
-                kc, vc, tokens, positions, ctx, counts = carry
+                kc, vc, tokens, positions, ctx, counts, g_state = carry
                 # slot for each lane's current position from its block
                 # table (idle lanes carry the zero table -> trash block 0;
                 # K <= block_size keeps them inside it)
@@ -959,8 +977,21 @@ class ModelRunner:
                         logits, counts > 0, counts, presence, frequency,
                         repetition,
                     )
+                if guided_shapes is not None:
+                    # constraint mask from the lane's DFA state (same
+                    # penalties->mask->sample order as the host path)
+                    mask_c = g_class_mask[g_state]        # (b, C)
+                    allowed = jnp.take_along_axis(
+                        mask_c, lane_tc, axis=1
+                    )                                     # (b, V)
+                    logits = jnp.where(allowed, logits, -jnp.inf)
                 keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
                 nxt = sample_tokens(logits, temps, top_ps, top_ks, keys)
+                if guided_shapes is not None:
+                    cls = jnp.take_along_axis(
+                        lane_tc, nxt[:, None], axis=1
+                    )[:, 0]
+                    g_state = g_class_trans[g_state, cls]
                 if use_penalties:
                     counts = counts.at[lane, nxt].add(1.0)
                 if want_logprobs:
@@ -969,11 +1000,16 @@ class ModelRunner:
                     ys = (nxt, *token_logprobs(logits, nxt))
                 else:
                     ys = nxt
-                return (kc, vc, nxt, positions + 1, ctx + 1, counts), ys
+                return (
+                    (kc, vc, nxt, positions + 1, ctx + 1, counts,
+                     g_state),
+                    ys,
+                )
 
             (kc, vc, *_), ys = jax.lax.scan(
                 one,
-                (kc, vc, tokens, positions, context_lens, counts0),
+                (kc, vc, tokens, positions, context_lens, counts0,
+                 g_state0),
                 jnp.arange(k_steps),
             )
             return ys, kc, vc  # ys: (k, b) toks [+ logprob arrays]
@@ -1294,6 +1330,7 @@ class ModelRunner:
         lora_slots: list[int] | None = None,
         penalties: tuple | None = None,
         want_logprobs: bool = False,
+        guided: tuple | None = None,
     ):
         """`steps` fused decode+sample iterations (one dispatch, one
         fetch); returns (steps, b) int32 sampled tokens on device — or,
@@ -1311,7 +1348,14 @@ class ModelRunner:
         `token_ids` may be a full-lane (b,) DEVICE array instead of a
         host list: the async-decode pipeline chains round N+1 directly on
         round N's on-device sampled tokens, so no host fetch sits between
-        dispatches."""
+        dispatches.
+
+        `guided`: optional (cache_token, init_states (b,), lane_map (b,),
+        token_class (M, V), class_mask (S, C), class_trans (S, C)) —
+        TokenDFA tables (engine/structured.py) evaluated INSIDE the
+        fused scan so constrained lanes keep the K-step fetch
+        amortization. The three big tables are uploaded once per
+        `cache_token` and reused across dispatches."""
         if steps > self.block_size:
             raise ValueError(
                 f"num_scheduler_steps={steps} > block_size="
@@ -1326,7 +1370,9 @@ class ModelRunner:
         # ONE packed i32 host->device buffer per dispatch (layout shared
         # with the jitted unpack, _decode_pack_layout): through the
         # tunneled chip each separate buffer creation pays link latency
-        layout, total = self._decode_pack_layout(b, c_pad, chained)
+        layout, total = self._decode_pack_layout(
+            b, c_pad, chained, guided=guided is not None
+        )
         packed = np.zeros((total,), np.int32)
 
         def put(name, arr):
@@ -1399,18 +1445,51 @@ class ModelRunner:
                 "repetition": jnp.asarray(rep_full),
             }
 
+        guided_kw = {}
+        guided_shapes = None
+        if guided is not None:
+            (g_token, init_states, lane_map, token_class, class_mask,
+             class_trans) = guided
+            g_state = np.zeros((b,), np.int32)
+            g_state[:b_actual] = init_states[:b_actual]
+            put("g_state", g_state)
+            g_lane = np.zeros((b,), np.int32)
+            g_lane[:b_actual] = lane_map[:b_actual]
+            put("g_lane", g_lane)
+            # device-cache the big tables across dispatches: they change
+            # only when the set of live constraints changes
+            cached = getattr(self, "_guided_dev", None)
+            if cached is None or cached[0] != g_token:
+                self._guided_dev = (
+                    g_token,
+                    jnp.asarray(token_class),
+                    jnp.asarray(class_mask),
+                    jnp.asarray(class_trans),
+                )
+            _, tc_dev, mask_dev, trans_dev = self._guided_dev
+            guided_kw = {
+                "g_token_class": tc_dev,
+                "g_class_mask": mask_dev,
+                "g_class_trans": trans_dev,
+            }
+            guided_shapes = (
+                token_class.shape[0], class_mask.shape[0],
+                class_mask.shape[1],
+            )
+
         cache_key = (b, c_pad, steps, penalties is not None,
-                     want_logprobs, chained)
+                     want_logprobs, chained, guided_shapes)
         if cache_key not in self._decode_multi_fns:
             logger.info(
                 "compiling multi-step decode b=%d ctx=%d k=%d pen=%s "
-                "lp=%s chained=%s",
+                "lp=%s chained=%s guided=%s",
                 b, c_pad, steps, penalties is not None, want_logprobs,
-                chained,
+                chained, guided_shapes,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
                 want_logprobs=want_logprobs, chained=chained,
+                guided_shapes=guided_shapes,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}
@@ -1429,6 +1508,7 @@ class ModelRunner:
             self.v_cache,
             jnp.asarray(packed),
             **chained_kw,
+            **guided_kw,
             **pen_kw,
             **lora_kw,
         )

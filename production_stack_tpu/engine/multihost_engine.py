@@ -133,7 +133,7 @@ class BroadcastingRunner:
     def decode_multi(self, token_ids, positions, block_tables,
                      context_lens, steps, temps, top_ps, top_ks, keys,
                      lora_slots=None, penalties=None,
-                     want_logprobs=False):
+                     want_logprobs=False, guided=None):
         msg = {
             "kind": "decode_multi",
             "token_ids": [int(t) for t in token_ids],
@@ -159,11 +159,35 @@ class BroadcastingRunner:
                 "freq": np.asarray(freq).tolist(),
                 "rep": np.asarray(rep).tolist(),
             }
+        if guided is not None:
+            tok, init_states, lane_map, tc, cm, ct = guided
+            # cache_token serials are process-local; serialize as a
+            # list so every follower re-keys its device cache
+            # consistently. The BIG tables ride the broadcast only when
+            # the constraint set CHANGES — per-dispatch they are
+            # device-cached on every host, so steady-state guided
+            # decode adds just the (b,) init/lane vectors to the wire.
+            wire_tok = list(map(int, tok[0])) + list(tok[1:])
+            msg["guided"] = {
+                "token": wire_tok,
+                "init": np.asarray(init_states).tolist(),
+                "lane": np.asarray(lane_map).tolist(),
+            }
+            if getattr(self, "_guided_sent_token", None) != tuple(
+                wire_tok
+            ):
+                msg["guided"]["tc"] = np.asarray(tc).tolist()
+                msg["guided"]["cm"] = (
+                    np.asarray(cm).astype(np.int8).tolist()
+                )
+                msg["guided"]["ct"] = np.asarray(ct).tolist()
+                self._guided_sent_token = tuple(wire_tok)
         self._bc.publish(msg)
         return self._runner.decode_multi(
             token_ids, positions, block_tables, context_lens, steps,
             temps, top_ps, top_ks, keys, lora_slots=lora_slots,
             penalties=penalties, want_logprobs=want_logprobs,
+            guided=guided,
         )
 
     def verify_batch(self, chunks, start_positions, block_tables,
@@ -245,6 +269,35 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
                     np.asarray(pen["pres"], np.float32),
                     np.asarray(pen["freq"], np.float32),
                     np.asarray(pen["rep"], np.float32),
+                )
+            gd = msg.pop("guided", None)
+            if gd is not None:
+                tok = tuple(gd["token"])
+                if "tc" in gd:
+                    tables = (
+                        np.asarray(gd["tc"], np.int32),
+                        np.asarray(gd["cm"], np.int8).astype(bool),
+                        np.asarray(gd["ct"], np.int32),
+                    )
+                    runner._guided_follower_tables = (tok, tables)
+                else:
+                    # host 0 sends the big tables only when the
+                    # constraint set changes; in-order broadcast means
+                    # they were seen before
+                    cached = getattr(
+                        runner, "_guided_follower_tables", None
+                    )
+                    if cached is None or cached[0] != tok:
+                        raise RuntimeError(
+                            "guided decode broadcast referenced tables "
+                            "this follower never received"
+                        )
+                    tables = cached[1]
+                msg["guided"] = (
+                    tok,
+                    np.asarray(gd["init"], np.int32),
+                    np.asarray(gd["lane"], np.int32),
+                    *tables,
                 )
             runner.decode_multi(**msg)
         elif kind == "verify_batch":

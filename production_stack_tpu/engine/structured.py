@@ -921,3 +921,236 @@ def get_machine(kind: str, spec) -> JsonSchemaMachine | RegexMachine:
         )
         _MACHINE_CACHE[key] = m
     return m
+
+
+# ---------------------------------------------------------------------------
+# token-level DFA with compressed alphabet: guided decoding ON DEVICE
+# (vLLM-capability equivalent of outlines' FSM-index compilation; lets
+# guided lanes ride the fused multi-step decode scan instead of forcing
+# the whole batch onto the single-step host-mask path)
+
+
+class TokenDFA:
+    """Deterministic token-transition tables for one constraint.
+
+    Built by BFS over the machine's reachable NFA-state frozensets,
+    taking TOKENS (not chars) as the alphabet, then compressing tokens
+    into equivalence classes (identical allowed/next-state behaviour in
+    every enumerated state). The resulting arrays are small enough to
+    live on the accelerator:
+
+      token_class: (V,) int32   class id of each token
+      class_mask:  (S, C) bool  class allowed from state s
+      class_trans: (S, C) int32 next state (self-loop when disallowed)
+
+    EOS is always its own class; it is allowed exactly when the state
+    accepts (or is a dead end — mirroring LLMEngine._guided_allowed's
+    only-legal-move-is-stop rule) and self-loops.
+
+    Host code keeps tracking NFA frozensets (`state_index` maps them to
+    DFA ids at dispatch time); construction FAILS (returns None from
+    `build`) when the state or work budget is exceeded, in which case
+    callers keep the host-side single-step mask path.
+    """
+
+    _serial_counter = 0
+
+    def __init__(self, token_class, class_mask, class_trans, state_index,
+                 eos_token_id):
+        self.token_class = token_class
+        self.class_mask = class_mask
+        self.class_trans = class_trans
+        self.state_index = state_index
+        self.eos_token_id = eos_token_id
+        # process-unique identity for downstream caches: id() would be
+        # reused by CPython after an eviction frees the object, silently
+        # serving a stale constraint's device tables
+        TokenDFA._serial_counter += 1
+        self.serial = TokenDFA._serial_counter
+
+    @property
+    def num_states(self) -> int:
+        return self.class_mask.shape[0]
+
+    @property
+    def num_classes(self) -> int:
+        return self.class_mask.shape[1]
+
+    @staticmethod
+    def build(machine, mask_cache, vocab: int, eos_token_id: int,
+              max_states: int = 128, max_work: int = 2_000_000):
+        """Compile `machine` against `mask_cache`'s vocab trie, or None
+        when budgets blow (huge schemas keep the host path)."""
+        import numpy as np
+
+        trie = mask_cache._root
+        init = machine.initial()
+        states: dict[frozenset, int] = {init: 0}
+        order: list[frozenset] = [init]
+        # per-state: {token_id: next_state_frozenset}
+        trans_maps: list[dict[int, frozenset]] = []
+        work = 0
+        qi = 0
+        while qi < len(order):
+            D = order[qi]
+            qi += 1
+            tmap: dict[int, frozenset] = {}
+            stack = [(trie, D)]
+            while stack:
+                node, sts = stack.pop()
+                for ch, child in node.items():
+                    if ch == 0:
+                        for tid in child:
+                            tmap[tid] = sts
+                        continue
+                    ns = machine.step(sts, ch)
+                    work += 1
+                    if work > max_work:
+                        return None
+                    if ns:
+                        stack.append((child, ns))
+            trans_maps.append(tmap)
+            for ns in set(tmap.values()):
+                if ns not in states:
+                    if len(states) >= max_states:
+                        return None
+                    states[ns] = len(order)
+                    order.append(ns)
+        S = len(order)
+        # token equivalence classes: signature = ((state, next_state)...)
+        # over states where the token is allowed. Tokens allowed nowhere
+        # share class 0; EOS gets a reserved class.
+        sigs: dict[int, list] = {}
+        for s_idx, tmap in enumerate(trans_maps):
+            for tid, ns in tmap.items():
+                sigs.setdefault(tid, []).append((s_idx, states[ns]))
+        sig_to_class: dict[tuple, int] = {(): 0}
+        token_class = np.zeros((vocab,), np.int32)
+        for tid, sig in sigs.items():
+            key = tuple(sig)
+            c = sig_to_class.get(key)
+            if c is None:
+                c = len(sig_to_class)
+                sig_to_class[key] = c
+            token_class[tid] = c
+        eos_class = len(sig_to_class)
+        if 0 <= eos_token_id < vocab:
+            token_class[eos_token_id] = eos_class
+        C = eos_class + 1
+        class_mask = np.zeros((S, C), bool)
+        class_trans = np.tile(
+            np.arange(S, dtype=np.int32)[:, None], (1, C)
+        )  # disallowed classes self-loop
+        for tid, sig in sigs.items():
+            c = token_class[tid]
+            for s_idx, ns_idx in sig:
+                class_mask[s_idx, c] = True
+                class_trans[s_idx, c] = ns_idx
+        for s_idx, D in enumerate(order):
+            if machine.accepting(D) or not trans_maps[s_idx]:
+                class_mask[s_idx, eos_class] = True  # stop is legal
+        return TokenDFA(token_class, class_mask, class_trans,
+                        dict(states), eos_token_id)
+
+    @staticmethod
+    def from_choices(choice_ids, vocab: int, eos_token_id: int):
+        """DFA over a guided_choice token-id trie. States are trie
+        nodes keyed by the generated prefix; `state_index` maps
+        tuple(prefix) -> state id. Mirrors LLMEngine._guided_allowed's
+        choice semantics, including offering EOS when one choice is
+        complete but a longer one still extends it."""
+        import numpy as np
+
+        prefixes: dict[tuple, int] = {(): 0}
+        order: list[tuple] = [()]
+        qi = 0
+        trans_maps: list[dict[int, tuple]] = []
+        accept: list[bool] = []
+        while qi < len(order):
+            g = order[qi]
+            qi += 1
+            tmap: dict[int, tuple] = {}
+            complete = False
+            for ids in choice_ids:
+                t = tuple(ids)
+                if len(t) > len(g) and t[: len(g)] == g:
+                    nxt = g + (t[len(g)],)
+                    tmap[t[len(g)]] = nxt
+                elif t == g:
+                    complete = True
+            trans_maps.append(tmap)
+            accept.append(complete)
+            for ns in tmap.values():
+                if ns not in prefixes:
+                    prefixes[ns] = len(order)
+                    order.append(ns)
+        S = len(order)
+        sigs: dict[int, list] = {}
+        for s_idx, tmap in enumerate(trans_maps):
+            for tid, ns in tmap.items():
+                sigs.setdefault(tid, []).append((s_idx, prefixes[ns]))
+        sig_to_class: dict[tuple, int] = {(): 0}
+        token_class = np.zeros((vocab,), np.int32)
+        for tid, sig in sigs.items():
+            key = tuple(sig)
+            c = sig_to_class.get(key)
+            if c is None:
+                c = len(sig_to_class)
+                sig_to_class[key] = c
+            token_class[tid] = c
+        eos_class = len(sig_to_class)
+        if 0 <= eos_token_id < vocab:
+            token_class[eos_token_id] = eos_class
+        C = eos_class + 1
+        class_mask = np.zeros((S, C), bool)
+        class_trans = np.tile(
+            np.arange(S, dtype=np.int32)[:, None], (1, C)
+        )
+        for tid, sig in sigs.items():
+            c = token_class[tid]
+            for s_idx, ns_idx in sig:
+                class_mask[s_idx, c] = True
+                class_trans[s_idx, c] = ns_idx
+        for s_idx in range(S):
+            # EOS is legal when the prefix IS a complete choice — if no
+            # longer choice extends it the sequence has already finished
+            # via the completion stop, so only the extendable-complete
+            # case is ever dispatched
+            if accept[s_idx]:
+                class_mask[s_idx, eos_class] = True
+        return TokenDFA(token_class, class_mask, class_trans,
+                        dict(prefixes), eos_token_id)
+
+
+_TOKEN_DFA_CACHE: dict = {}
+_TOKEN_DFA_CACHE_CAP = 32
+
+
+def get_token_dfa(machine_or_choices, mask_cache, vocab: int,
+                  eos_token_id: int):
+    """Compile (or fetch) the TokenDFA for a machine or a guided_choice
+    id list. Returns None when the constraint is too large to compile
+    under budget (callers keep the host mask path). Failures are cached
+    too, so a huge schema is not re-attempted every step."""
+    if isinstance(machine_or_choices, (list, tuple)):
+        key = ("choices", tuple(tuple(c) for c in machine_or_choices),
+               vocab, eos_token_id)
+    else:
+        key = ("machine", id(machine_or_choices), vocab, eos_token_id)
+    if key in _TOKEN_DFA_CACHE:
+        dfa, ref = _TOKEN_DFA_CACHE[key]
+        return dfa
+    if isinstance(machine_or_choices, (list, tuple)):
+        dfa = TokenDFA.from_choices(
+            machine_or_choices, vocab, eos_token_id
+        )
+        ref = None
+    else:
+        dfa = TokenDFA.build(
+            machine_or_choices, mask_cache, vocab, eos_token_id
+        )
+        ref = machine_or_choices  # pin: id()-keyed entries must not dangle
+    if len(_TOKEN_DFA_CACHE) >= _TOKEN_DFA_CACHE_CAP:
+        _TOKEN_DFA_CACHE.pop(next(iter(_TOKEN_DFA_CACHE)))
+    _TOKEN_DFA_CACHE[key] = (dfa, ref)
+    return dfa

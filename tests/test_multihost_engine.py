@@ -292,3 +292,38 @@ def test_two_process_engine_matches_single_process():
     finally:
         mcfg._PRESETS.pop(cfg.name, None)
     assert tokens == [o.token_ids for o in ref]
+
+
+def test_broadcast_guided_tables_sent_once():
+    """The big DFA tables ride the broadcast only when the constraint
+    set changes; steady-state guided dispatches carry just the per-lane
+    init/lane vectors, and a follower replays cached tables."""
+    import numpy as np
+
+    from production_stack_tpu.engine import multihost_engine as mhe
+
+    inner = _RecordingRunner()
+    bc = _FakeBroadcaster()
+    br = mhe.BroadcastingRunner(inner, bc)
+    tok = ((7,), 4, 2, 2)
+    tc = np.zeros((2, 16), np.int32)
+    cm = np.ones((4, 2), bool)
+    ct = np.zeros((4, 2), np.int32)
+    guided = (tok, np.zeros((1,), np.int32), np.zeros((1,), np.int32),
+              tc, cm, ct)
+    common = dict(positions=[0], block_tables=[[0]], context_lens=[1],
+                  steps=2, temps=[0.0], top_ps=[1.0], top_ks=[-1],
+                  keys=np.zeros((1, 2), np.uint32))
+    br.decode_multi([1], guided=guided, **common)
+    br.decode_multi([1], guided=guided, **common)
+    g1, g2 = bc.published[0]["guided"], bc.published[1]["guided"]
+    assert "tc" in g1 and "cm" in g1 and "ct" in g1
+    assert "tc" not in g2 and "cm" not in g2  # tables sent once
+
+    follower = _RecordingRunner()
+    _drain_follower(bc, follower)
+    assert len(follower.calls) == 2
+    for _, kw in follower.calls:
+        t, init, lane, ftc, fcm, fct = kw["guided"]
+        assert t == (7, 4, 2, 2)
+        assert ftc.shape == tc.shape and fcm.shape == cm.shape
