@@ -24,6 +24,19 @@ import time
 
 os.environ.setdefault("PST_LOG_LEVEL", "WARNING")  # keep stdout JSON-only
 
+# Persistent XLA compilation cache: chip windows through the tunnel can be
+# as short as ~20 min (TPU_ATTEMPTS.log 2026-07-31: up 01:01, dead before
+# the ~13 min of per-config compiles finished), so a retried session must
+# not re-pay them. With the cache, warmup/precompile of an already-seen
+# config is a disk read instead of a tunnel compile. Harmless if the PJRT
+# plugin can't serialize executables — jax logs a warning and recompiles.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 import numpy as np  # noqa: E402
 
 MODEL = os.environ.get("PST_BENCH_MODEL", "llama-3.2-3b")
@@ -150,12 +163,31 @@ def _run_sweep() -> None:
     leaves evidence; the best row is the driver-contract stdout line."""
     import subprocess
 
-    configs = [
-        ("k1-sync-nopack", 1, 1, False),
-        (f"k{SCHED_STEPS}-sync-nopack", SCHED_STEPS, 1, False),
-        (f"k{SCHED_STEPS}-sync-packed", SCHED_STEPS, PREFILL_SEQS, False),
-        (f"k{SCHED_STEPS}-async-packed", SCHED_STEPS, PREFILL_SEQS, True),
-    ]
+    # config labels are self-describing ("k{K}-{sync|async}-{packed|nopack}")
+    # and the list is env-overridable so a short chip window can run the
+    # highest-value measurements first:
+    #   PST_BENCH_SWEEP_CONFIGS=k8-sync-packed,k16-sync-packed,... bench.py
+    spec = os.environ.get(
+        "PST_BENCH_SWEEP_CONFIGS",
+        "k1-sync-nopack,k{K}-sync-nopack,k{K}-sync-packed,k{K}-async-packed"
+    ).replace("{K}", str(SCHED_STEPS))
+    configs = []
+    for label in [s.strip() for s in spec.split(",") if s.strip()]:
+        kpart, mode, pack = label.split("-")
+        # fail fast on typos: a scarce chip window must not silently run
+        # the sync path under an "asynch" label
+        if (not kpart.startswith("k") or mode not in ("sync", "async")
+                or pack not in ("packed", "nopack")):
+            raise ValueError(
+                f"bad sweep config label {label!r}: want "
+                "k<N>-{sync|async}-{packed|nopack}"
+            )
+        configs.append((
+            label,
+            int(kpart[1:]),
+            PREFILL_SEQS if pack == "packed" else 1,
+            mode == "async",
+        ))
     out_path = os.environ.get("PST_BENCH_SWEEP_OUT", "BENCH_SWEEP.json")
     per_config_timeout = float(
         os.environ.get("PST_BENCH_CONFIG_TIMEOUT", "1500")

@@ -14,5 +14,7 @@ while true; do
     echo "$(date -u +%FT%TZ) TPU UP" >> TPU_ATTEMPTS.log
     exit 0
   fi
-  sleep 810
+  # short interval: chip windows as short as ~20 min have been observed
+  # (TPU_ATTEMPTS.log 2026-07-31), so detection delay must stay small
+  sleep "${TPU_PROBE_INTERVAL:-240}"
 done
