@@ -953,6 +953,10 @@ class LLMEngine:
         for d in lane_dfas:
             if d is not None and all(d is not x for x in distinct):
                 distinct.append(d)
+        # order-invariant identity: a mere reordering of running lanes
+        # (preemption/requeue) must not invalidate the host tables, the
+        # device upload, or (multihost) trigger a table rebroadcast
+        distinct.sort(key=lambda d: d.serial)
         # machine row M-1 (after padding: the last REAL row) is the
         # trivial allow-all machine for unguided lanes
         n_real = len(distinct) + 1

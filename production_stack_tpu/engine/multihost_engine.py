@@ -32,6 +32,26 @@ from production_stack_tpu.utils import init_logger
 logger = init_logger(__name__)
 
 
+def _b64(a) -> dict:
+    """ndarray -> JSON-safe {b64, shape, dt}: the step broadcast rides
+    the jax.distributed coordinator KV store as JSON, and raw-bytes
+    base64 beats a Python-int list by ~10x in size and parse cost for
+    the big guided tables."""
+    import base64
+
+    a = np.ascontiguousarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "shape": list(a.shape), "dt": str(a.dtype)}
+
+
+def _unb64(d: dict):
+    import base64
+
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dt"])
+    ).reshape(d["shape"])
+
+
 def validate_multihost_config(config) -> None:
     """Reject single-host-only features early with a clear message."""
     problems = []
@@ -176,11 +196,17 @@ class BroadcastingRunner:
             if getattr(self, "_guided_sent_token", None) != tuple(
                 wire_tok
             ):
-                msg["guided"]["tc"] = np.asarray(tc).tolist()
-                msg["guided"]["cm"] = (
-                    np.asarray(cm).astype(np.int8).tolist()
+                # raw int32/int8 bytes via base64, NOT a JSON int list:
+                # tc is (m_pad, vocab) — with a 128k vocab a tolist()
+                # payload is several MB of Python ints to serialize and
+                # for every follower to parse. Pad rows (all-zero, above
+                # n_real) are rebuilt follower-side, not shipped.
+                n_real = len(tok[0]) + 1
+                msg["guided"]["tc"] = _b64(np.asarray(tc)[:n_real])
+                msg["guided"]["cm"] = _b64(
+                    np.asarray(cm).astype(np.int8)
                 )
-                msg["guided"]["ct"] = np.asarray(ct).tolist()
+                msg["guided"]["ct"] = _b64(np.asarray(ct))
                 self._guided_sent_token = tuple(wire_tok)
         self._bc.publish(msg)
         return self._runner.decode_multi(
@@ -274,10 +300,16 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
             if gd is not None:
                 tok = tuple(gd["token"])
                 if "tc" in gd:
+                    tc = _unb64(gd["tc"])
+                    m_pad = tok[-1]  # cache_token layout: (..., m_pad)
+                    if tc.shape[0] < m_pad:  # re-grow the all-zero pad
+                        tc = np.concatenate([tc, np.zeros(
+                            (m_pad - tc.shape[0], tc.shape[1]), np.int32
+                        )])
                     tables = (
-                        np.asarray(gd["tc"], np.int32),
-                        np.asarray(gd["cm"], np.int8).astype(bool),
-                        np.asarray(gd["ct"], np.int32),
+                        tc,
+                        _unb64(gd["cm"]).astype(bool),
+                        _unb64(gd["ct"]),
                     )
                     runner._guided_follower_tables = (tok, tables)
                 else:

@@ -37,6 +37,7 @@ engine adds it to the mask so generation can only stop on valid output.
 
 from __future__ import annotations
 
+import collections
 import json
 
 # ---------------------------------------------------------------------------
@@ -1016,41 +1017,15 @@ class TokenDFA:
                         return None
                     states[ns] = len(order)
                     order.append(ns)
-        S = len(order)
-        # token equivalence classes: signature = ((state, next_state)...)
-        # over states where the token is allowed. Tokens allowed nowhere
-        # share class 0; EOS gets a reserved class.
-        sigs: dict[int, list] = {}
-        for s_idx, tmap in enumerate(trans_maps):
-            for tid, ns in tmap.items():
-                sigs.setdefault(tid, []).append((s_idx, states[ns]))
-        sig_to_class: dict[tuple, int] = {(): 0}
-        token_class = np.zeros((vocab,), np.int32)
-        for tid, sig in sigs.items():
-            key = tuple(sig)
-            c = sig_to_class.get(key)
-            if c is None:
-                c = len(sig_to_class)
-                sig_to_class[key] = c
-            token_class[tid] = c
-        eos_class = len(sig_to_class)
-        if 0 <= eos_token_id < vocab:
-            token_class[eos_token_id] = eos_class
-        C = eos_class + 1
-        class_mask = np.zeros((S, C), bool)
-        class_trans = np.tile(
-            np.arange(S, dtype=np.int32)[:, None], (1, C)
-        )  # disallowed classes self-loop
-        for tid, sig in sigs.items():
-            c = token_class[tid]
-            for s_idx, ns_idx in sig:
-                class_mask[s_idx, c] = True
-                class_trans[s_idx, c] = ns_idx
-        for s_idx, D in enumerate(order):
-            if machine.accepting(D) or not trans_maps[s_idx]:
-                class_mask[s_idx, eos_class] = True  # stop is legal
-        return TokenDFA(token_class, class_mask, class_trans,
-                        dict(states), eos_token_id)
+        # stop is legal at accepting states and dead ends
+        eos_allowed = [
+            machine.accepting(D) or not trans_maps[i]
+            for i, D in enumerate(order)
+        ]
+        tables = _compress_tables(
+            trans_maps, states, vocab, eos_token_id, eos_allowed
+        )
+        return TokenDFA(*tables, dict(states), eos_token_id)
 
     @staticmethod
     def from_choices(choice_ids, vocab: int, eos_token_id: int):
@@ -1084,45 +1059,63 @@ class TokenDFA:
                 if ns not in prefixes:
                     prefixes[ns] = len(order)
                     order.append(ns)
-        S = len(order)
-        sigs: dict[int, list] = {}
-        for s_idx, tmap in enumerate(trans_maps):
-            for tid, ns in tmap.items():
-                sigs.setdefault(tid, []).append((s_idx, prefixes[ns]))
-        sig_to_class: dict[tuple, int] = {(): 0}
-        token_class = np.zeros((vocab,), np.int32)
-        for tid, sig in sigs.items():
-            key = tuple(sig)
-            c = sig_to_class.get(key)
-            if c is None:
-                c = len(sig_to_class)
-                sig_to_class[key] = c
-            token_class[tid] = c
-        eos_class = len(sig_to_class)
-        if 0 <= eos_token_id < vocab:
-            token_class[eos_token_id] = eos_class
-        C = eos_class + 1
-        class_mask = np.zeros((S, C), bool)
-        class_trans = np.tile(
-            np.arange(S, dtype=np.int32)[:, None], (1, C)
+        # EOS is legal when the prefix IS a complete choice — if no
+        # longer choice extends it the sequence has already finished
+        # via the completion stop, so only the extendable-complete
+        # case is ever dispatched
+        tables = _compress_tables(
+            trans_maps, prefixes, vocab, eos_token_id, accept
         )
-        for tid, sig in sigs.items():
-            c = token_class[tid]
-            for s_idx, ns_idx in sig:
-                class_mask[s_idx, c] = True
-                class_trans[s_idx, c] = ns_idx
-        for s_idx in range(S):
-            # EOS is legal when the prefix IS a complete choice — if no
-            # longer choice extends it the sequence has already finished
-            # via the completion stop, so only the extendable-complete
-            # case is ever dispatched
-            if accept[s_idx]:
-                class_mask[s_idx, eos_class] = True
-        return TokenDFA(token_class, class_mask, class_trans,
-                        dict(prefixes), eos_token_id)
+        return TokenDFA(*tables, dict(prefixes), eos_token_id)
 
 
-_TOKEN_DFA_CACHE: dict = {}
+def _compress_tables(trans_maps, idx_of, vocab: int, eos_token_id: int,
+                     eos_allowed):
+    """Shared tail of TokenDFA construction: token equivalence classes
+    (signature = ((state, next_state)...) over states where the token is
+    allowed; tokens allowed nowhere share class 0; EOS gets a reserved
+    class) and the (S, C) mask/transition tables. `idx_of` maps the
+    next-state objects stored in `trans_maps` to dense state ids;
+    `eos_allowed[s]` says whether stopping is legal in state s."""
+    import numpy as np
+
+    S = len(trans_maps)
+    sigs: dict[int, list] = {}
+    for s_idx, tmap in enumerate(trans_maps):
+        for tid, ns in tmap.items():
+            sigs.setdefault(tid, []).append((s_idx, idx_of[ns]))
+    sig_to_class: dict[tuple, int] = {(): 0}
+    token_class = np.zeros((vocab,), np.int32)
+    for tid, sig in sigs.items():
+        key = tuple(sig)
+        c = sig_to_class.get(key)
+        if c is None:
+            c = len(sig_to_class)
+            sig_to_class[key] = c
+        token_class[tid] = c
+    eos_class = len(sig_to_class)
+    if 0 <= eos_token_id < vocab:
+        token_class[eos_token_id] = eos_class
+    C = eos_class + 1
+    class_mask = np.zeros((S, C), bool)
+    class_trans = np.tile(
+        np.arange(S, dtype=np.int32)[:, None], (1, C)
+    )  # disallowed classes self-loop
+    for tid, sig in sigs.items():
+        c = token_class[tid]
+        for s_idx, ns_idx in sig:
+            class_mask[s_idx, c] = True
+            class_trans[s_idx, c] = ns_idx
+    for s_idx in range(S):
+        if eos_allowed[s_idx]:
+            class_mask[s_idx, eos_class] = True
+    return token_class, class_mask, class_trans
+
+
+# LRU (not FIFO): a long-lived guided request's hot DFA must survive 32
+# newer one-shot constraints, or its (up to max_work-step) rebuild lands
+# on the scheduling hot path every dispatch
+_TOKEN_DFA_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _TOKEN_DFA_CACHE_CAP = 32
 
 
@@ -1138,6 +1131,7 @@ def get_token_dfa(machine_or_choices, mask_cache, vocab: int,
     else:
         key = ("machine", id(machine_or_choices), vocab, eos_token_id)
     if key in _TOKEN_DFA_CACHE:
+        _TOKEN_DFA_CACHE.move_to_end(key)
         dfa, ref = _TOKEN_DFA_CACHE[key]
         return dfa
     if isinstance(machine_or_choices, (list, tuple)):
@@ -1151,6 +1145,6 @@ def get_token_dfa(machine_or_choices, mask_cache, vocab: int,
         )
         ref = machine_or_choices  # pin: id()-keyed entries must not dangle
     if len(_TOKEN_DFA_CACHE) >= _TOKEN_DFA_CACHE_CAP:
-        _TOKEN_DFA_CACHE.pop(next(iter(_TOKEN_DFA_CACHE)))
+        _TOKEN_DFA_CACHE.popitem(last=False)  # least-recently-used
     _TOKEN_DFA_CACHE[key] = (dfa, ref)
     return dfa

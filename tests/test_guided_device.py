@@ -171,3 +171,49 @@ def test_choice_dfa_eos_on_extendable_complete():
     assert dfa.class_mask[idx, eos_cls]
     nxt = choice_ids[1][len(choice_ids[0])]
     assert dfa.class_mask[idx, dfa.token_class[nxt]]
+
+
+def test_token_dfa_cache_is_lru():
+    """A hot (recently-used) DFA must survive CACHE_CAP newer one-shot
+    constraints — FIFO eviction would rebuild it every dispatch."""
+    import production_stack_tpu.engine.structured as structured
+
+    tok = ByteTokenizer()
+    structured._TOKEN_DFA_CACHE.clear()
+    hot = structured.get_token_dfa(
+        [tuple(tok.encode("hot", add_bos=False))], None,
+        tok.vocab_size, tok.eos_token_id,
+    )
+    for i in range(structured._TOKEN_DFA_CACHE_CAP - 1):
+        structured.get_token_dfa(
+            [tuple(tok.encode(f"w{i}", add_bos=False))], None,
+            tok.vocab_size, tok.eos_token_id,
+        )
+        # the long-lived request touches its DFA between arrivals
+        again = structured.get_token_dfa(
+            [tuple(tok.encode("hot", add_bos=False))], None,
+            tok.vocab_size, tok.eos_token_id,
+        )
+        assert again is hot, "hot DFA evicted despite recent use"
+    structured._TOKEN_DFA_CACHE.clear()
+
+
+def test_guided_tables_invariant_under_lane_order():
+    """Reordering running lanes (preemption/requeue) must not change
+    cache_token — a changed token would rebuild host tables, re-upload
+    to device, and (multihost) rebroadcast multi-MB tables."""
+    eng = make_engine(num_scheduler_steps=4, max_num_seqs=2)
+    sp_a = SamplingParams(max_tokens=8, temperature=0.0,
+                          guided_regex=r"(on|off)")
+    sp_b = SamplingParams(max_tokens=8, temperature=0.0,
+                          guided_regex=r"(cat|dog)")
+    eng.add_request("a", prompt_token_ids=[1, 2, 3], sampling_params=sp_a)
+    eng.add_request("b", prompt_token_ids=[4, 5, 6], sampling_params=sp_b)
+    while not all(s.num_computed_tokens >= s.num_prompt_tokens
+                  for s in eng._seqs.values()):
+        eng.step()
+    seqs = [eng._seqs["a"], eng._seqs["b"]]
+    t1 = eng._device_guided_tables(seqs)
+    t2 = eng._device_guided_tables(list(reversed(seqs)))
+    assert t1 is not None and t2 is not None
+    assert t1[0] == t2[0], "cache_token depends on lane order"
