@@ -247,6 +247,30 @@ def _run_sweep() -> None:
                        "model": MODEL, "results": results}, f, indent=1)
         if wedged:
             break
+        if r.get("value", 0.0) == 0.0:
+            # config produced no measurement — if the chip itself has
+            # stopped answering (tunnel drop mid-window, the 01:01 UTC
+            # failure mode), every remaining config would burn its full
+            # timeout the same way; probe once and stop the sweep so the
+            # probe loop can start hunting for the next window
+            probe = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "scripts", "tpu_probe.py")
+            try:
+                rc = subprocess.run(
+                    [sys.executable, probe], timeout=120,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                ).returncode
+                # rc 2 = chip lock held by another process (e.g. the
+                # probe loop's own cycle): the chip is owned, not dead —
+                # a config-specific failure must not abandon a live
+                # window just because the flock collided
+                alive = rc in (0, 2)
+            except subprocess.TimeoutExpired:
+                alive = False
+            if not alive:
+                print("# sweep: chip no longer answers — stopping",
+                      file=sys.stderr)
+                break
     best = max(results, key=lambda r: r.get("value", 0.0))
     print(json.dumps(best))
 

@@ -184,7 +184,9 @@ def test_token_dfa_cache_is_lru():
         [tuple(tok.encode("hot", add_bos=False))], None,
         tok.vocab_size, tok.eos_token_id,
     )
-    for i in range(structured._TOKEN_DFA_CACHE_CAP - 1):
+    # strictly more inserts than CAP so eviction actually fires (cache
+    # holds 'hot' + CAP one-shots = CAP+1 inserts -> 2 evictions)
+    for i in range(structured._TOKEN_DFA_CACHE_CAP + 1):
         structured.get_token_dfa(
             [tuple(tok.encode(f"w{i}", add_bos=False))], None,
             tok.vocab_size, tok.eos_token_id,
