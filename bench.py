@@ -255,17 +255,25 @@ def _run_sweep() -> None:
             # probe loop can start hunting for the next window
             probe = os.path.join(os.path.dirname(os.path.abspath(
                 __file__)), "scripts", "tpu_probe.py")
+            pp = subprocess.Popen(
+                [sys.executable, probe],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
             try:
-                rc = subprocess.run(
-                    [sys.executable, probe], timeout=120,
-                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                ).returncode
+                rc = pp.wait(timeout=120)
                 # rc 2 = chip lock held by another process (e.g. the
                 # probe loop's own cycle): the chip is owned, not dead —
                 # a config-specific failure must not abandon a live
                 # window just because the flock collided
                 alive = rc in (0, 2)
             except subprocess.TimeoutExpired:
+                # SIGTERM, never SIGKILL — a killed client wedges the
+                # chip tunnel (same invariant as the sweep child above)
+                pp.terminate()
+                try:
+                    pp.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
                 alive = False
             if not alive:
                 print("# sweep: chip no longer answers — stopping",

@@ -283,7 +283,8 @@ class LLMEngine:
                 raise ValueError("guided_choice entries must tokenize "
                                  "to at least one token")
             seq._guided_choices = choice_ids  # type: ignore[attr-defined]
-        if sp.guided_json is not None or sp.guided_regex is not None:
+        if (sp.guided_json is not None or sp.guided_regex is not None
+                or sp.guided_grammar is not None):
             from production_stack_tpu.engine import structured
 
             if self.tokenizer.eos_token_id is None:
@@ -295,12 +296,15 @@ class LLMEngine:
                     "token"
                 )
             # compile (or fetch cached) the constraint machine; schema/
-            # pattern errors surface here as ValueError -> HTTP 400
-            machine = structured.get_machine(
-                "json" if sp.guided_json is not None else "regex",
-                sp.guided_json if sp.guided_json is not None
-                else sp.guided_regex,
+            # pattern/grammar errors surface here as ValueError -> 400
+            kind, spec = (
+                ("json", sp.guided_json)
+                if sp.guided_json is not None
+                else ("regex", sp.guided_regex)
+                if sp.guided_regex is not None
+                else ("grammar", sp.guided_grammar)
             )
+            machine = structured.get_machine(kind, spec)
             seq._guided_machine = machine  # type: ignore[attr-defined]
             seq._guided_state = machine.initial()  # type: ignore[attr-defined]
         self._seqs[request_id] = seq

@@ -60,15 +60,19 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
         guided_regex = body.get("guided_regex")
         if guided_regex is not None and not isinstance(guided_regex, str):
             raise ProtocolError("guided_regex must be a string")
+        guided_grammar = body.get("guided_grammar")
+        if guided_grammar is not None and not isinstance(
+            guided_grammar, str
+        ):
+            raise ProtocolError("guided_grammar must be a string")
         # OpenAI response_format: json_object / json_schema map onto the
         # same constraint machinery (vLLM accepts both spellings)
         rf = body.get("response_format")
         if isinstance(rf, dict) and rf.get("type") in (
             "json_object", "json_schema"
         ):
-            if guided_json is None and guided_choice is None and (
-                guided_regex is None
-            ):
+            if (guided_json is None and guided_choice is None
+                    and guided_regex is None and guided_grammar is None):
                 if rf["type"] == "json_object":
                     guided_json = {"type": "object"}
                 else:
@@ -88,6 +92,7 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
             guided_choice=guided_choice,
             guided_json=guided_json,
             guided_regex=guided_regex,
+            guided_grammar=guided_grammar,
             max_tokens=int(max_tokens),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),

@@ -32,17 +32,21 @@ class SamplingParams:
     # constrain sampling (engine/structured.py).
     guided_json: dict | str | None = None
     guided_regex: str | None = None
+    # structured output (vLLM guided_grammar role): the generation must
+    # derive from the `root` rule of this EBNF grammar (GBNF-style
+    # syntax; engine/structured.GrammarMachine)
+    guided_grammar: str | None = None
 
     def __post_init__(self) -> None:
         n_guided = sum(
             x is not None
             for x in (self.guided_choice, self.guided_json,
-                      self.guided_regex)
+                      self.guided_regex, self.guided_grammar)
         )
         if n_guided > 1:
             raise ValueError(
                 "at most one of guided_choice / guided_json / "
-                "guided_regex may be set"
+                "guided_regex / guided_grammar may be set"
             )
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")

@@ -1,8 +1,8 @@
-"""Structured output: JSON-schema and regex constrained decoding.
+"""Structured output: JSON-schema, regex, and EBNF-grammar decoding.
 
 The role of vLLM's guided decoding backends (outlines/xgrammar wired
-through `guided_json` / `guided_regex` request fields; the reference
-stack forwards these to its engines — reference:
+through `guided_json` / `guided_regex` / `guided_grammar` request
+fields; the reference stack forwards these to its engines — reference:
 src/vllm_router/services/request_service/request.py routes request
 bodies verbatim, tutorials use guided choice/JSON against them). Those
 backends are CUDA-era CPU libraries; this is a self-contained TPU-stack
@@ -657,37 +657,8 @@ class RegexMachine:
         return s, e
 
     def _parse_class(self) -> tuple:
-        negate = False
-        if self._peek() == "^":
-            self._take()
-            negate = True
-        items: list[tuple] = []
-        first = True
-        while True:
-            ch = self._peek()
-            if ch is None:
-                raise ValueError("unclosed character class")
-            if ch == "]" and not first:
-                self._take()
-                break
-            first = False
-            ch = self._take()
-            if ch == "\\":
-                items.append(_escape_matcher(self._take()))
-                continue
-            if (
-                self._peek() == "-"
-                and self._pos + 1 < len(self._pat)
-                and self._pat[self._pos + 1] != "]"
-            ):
-                self._take()
-                hi = self._take()
-                if hi == "\\":
-                    hi = self._take()
-                items.append(("range", ch, hi))
-            else:
-                items.append(("ch", ch))
-        return ("class", negate, tuple(items))
+        matcher, self._pos = _lex_char_class(self._pat, self._pos)
+        return matcher
 
     # -- machine interface --------------------------------------------------
     def _eps_closure(self, states: frozenset) -> frozenset:
@@ -783,6 +754,469 @@ def _matches(matcher: tuple, ch: str) -> bool:
     _, negate, items = matcher
     hit = any(_matches(item, ch) for item in items)
     return hit != negate
+
+
+def _class_atom(text: str, i: int) -> tuple[tuple, int]:
+    """One character-class atom at text[i] -> (matcher, next_i).
+    Escapes: \\xHH -> concrete char; otherwise _escape_matcher."""
+    ch = text[i]
+    if ch != "\\":
+        return ("ch", ch), i + 1
+    if i + 1 >= len(text):
+        raise ValueError("dangling escape in character class")
+    e = text[i + 1]
+    if e == "x":
+        try:
+            return ("ch", chr(int(text[i + 2:i + 4], 16))), i + 4
+        except (ValueError, IndexError):
+            raise ValueError(
+                "bad \\xHH escape in character class"
+            ) from None
+    return _escape_matcher(e), i + 2
+
+
+def _lex_char_class(text: str, i: int) -> tuple[tuple, int]:
+    """Shared char-class lexer (regex and grammar dialects use the same
+    matcher representation): `i` points just past '['; returns
+    (("class", negate, items), next_i past ']'). Ranges accept escaped
+    concrete bounds ([\\x41-\\x5A], [\\t-~]); class escapes (\\d \\w
+    ...) cannot bound a range."""
+    n = len(text)
+    negate = False
+    if i < n and text[i] == "^":
+        negate = True
+        i += 1
+    items: list[tuple] = []
+    first = True
+    while True:
+        if i >= n:
+            raise ValueError("unclosed character class")
+        if text[i] == "]" and not first:
+            return ("class", negate, tuple(items)), i + 1
+        first = False
+        m, i = _class_atom(text, i)
+        if (m[0] == "ch" and i < n and text[i] == "-"
+                and i + 1 < n and text[i + 1] != "]"):
+            hi_m, i = _class_atom(text, i + 1)
+            if hi_m[0] != "ch":
+                raise ValueError(
+                    "character-class range bound must be a concrete "
+                    "character"
+                )
+            items.append(("range", m[1], hi_m[1]))
+            continue
+        items.append(m)
+
+
+# ---------------------------------------------------------------------------
+# EBNF grammar machine (vLLM guided_grammar role)
+
+# closure rewrites before a grammar is declared divergent. Left-recursive
+# rules (expr ::= expr "+" term) grow their stacks on every rewrite and
+# can never reach a consuming head, so they hit this cap at compile time
+_GRAMMAR_CLOSURE_CAP = 50_000
+
+
+class GrammarMachine:
+    """Character-level machine for an EBNF grammar in the GBNF-style
+    dialect vLLM's guided_grammar accepts (llama.cpp grammar syntax):
+
+        root ::= ws expr ws          # `root` is the start symbol
+        expr ::= term (("+" | "-") term)*
+        term ::= [0-9]+ | "(" expr ")"
+        ws   ::= [ \\t]*
+
+    Rules `name ::= body`; alternation `|`; concatenation by
+    juxtaposition; elements: "literal" (escapes \\n \\t \\r \\" \\\\ \\xHH),
+    [char-class] (ranges, ^ negation, escapes), (group), rule
+    references, postfix * + ? {m} {m,} {m,n}; # comments.
+
+    Same interface as JsonSchemaMachine / RegexMachine so every guided
+    path (host mask walk, TokenDFA device compilation) works unchanged:
+    states are frozensets of frame stacks; `_closure` rewrites
+    nonterminal heads until every stack starts with a consuming frame;
+    the empty stack accepts. Recursive (non-left) rules are supported —
+    nesting pushes frames, so state counts are unbounded and deep
+    grammars simply stay on the host mask path when TokenDFA.build's
+    budget refuses them. Left recursion cannot make progress and is
+    rejected at compile time via the closure work cap.
+
+    Reference capability: vLLM guided_grammar (outlines/xgrammar CFG
+    backends on GPU serving engines)."""
+
+    def __init__(self, grammar: str):
+        if not isinstance(grammar, str) or not grammar.strip():
+            raise ValueError("guided_grammar must be a non-empty string")
+        self._rules = _parse_grammar(grammar)
+        if "root" not in self._rules:
+            raise ValueError('grammar must define a "root" rule')
+        missing = {
+            r
+            for body in self._rules.values()
+            for r in _ast_refs(body)
+            if r not in self._rules
+        }
+        if missing:
+            raise ValueError(
+                f"grammar references undefined rule(s): "
+                f"{', '.join(sorted(missing))}"
+            )
+        # structural left-recursion check: a rule that can reach itself
+        # through a nullable prefix can never make character progress,
+        # so its closure would grow stacks until the work cap. Detect it
+        # on the rule graph in O(rules x ast) instead of burning ~50k
+        # tuple rewrites of synchronous admission-path CPU per attempt
+        # (request-path DoS otherwise — review r5).
+        cycle = _left_recursion_cycle(self._rules)
+        if cycle is not None:
+            raise ValueError(
+                "left-recursive grammar (cannot make progress): "
+                + " -> ".join(cycle)
+            )
+        self._init = self._closure((("ast", ("ref", "root")),))
+
+    def _closure(self, *stacks: tuple) -> frozenset:
+        """Rewrite `("ast", node)` heads until every member stack starts
+        with a consuming frame (("lit", s, i) / ("cls", matcher)) or is
+        the empty = accepting stack."""
+        out: set[tuple] = set()
+        work = list(stacks)
+        seen: set[tuple] = set()
+        budget = _GRAMMAR_CLOSURE_CAP
+        while work:
+            budget -= 1
+            if budget < 0:
+                raise ValueError(
+                    "grammar closure diverged (left-recursive rule?)"
+                )
+            st = work.pop()
+            if st in seen:
+                continue
+            seen.add(st)
+            if not st:
+                out.add(st)
+                continue
+            head = st[0]
+            if head[0] != "ast":
+                out.add(st)  # consuming frame
+                continue
+            node, rest = head[1], st[1:]
+            kind = node[0]
+            if kind == "lit":
+                s = node[1]
+                work.append(((("lit", s, 0),) + rest) if s else rest)
+            elif kind == "cls":
+                work.append((("cls", node[1]),) + rest)
+            elif kind == "ref":
+                work.append((("ast", self._rules[node[1]]),) + rest)
+            elif kind == "seq":
+                work.append(
+                    tuple(("ast", e) for e in node[1]) + rest
+                )
+            elif kind == "alt":
+                for a in node[1]:
+                    work.append((("ast", a),) + rest)
+            elif kind == "rep":
+                _, e, lo, hi = node
+                if lo == 0:
+                    work.append(rest)  # done repeating
+                if hi is None:
+                    nxt = ("rep", e, max(lo - 1, 0), None)
+                    work.append((("ast", e), ("ast", nxt)) + rest)
+                elif hi > 0:
+                    nxt = ("rep", e, max(lo - 1, 0), hi - 1)
+                    work.append((("ast", e), ("ast", nxt)) + rest)
+            else:  # pragma: no cover — AST kinds are closed above
+                raise AssertionError(f"unknown grammar node {node!r}")
+        return frozenset(out)
+
+    # -- machine interface ------------------------------------------------
+    def initial(self) -> frozenset:
+        return self._init
+
+    def step(self, states: frozenset, ch: str) -> frozenset:
+        nxt: list[tuple] = []
+        for st in states:
+            if not st:
+                continue
+            head, rest = st[0], st[1:]
+            if head[0] == "lit":
+                _, s, i = head
+                if ch == s[i]:
+                    nxt.append(
+                        rest if i + 1 == len(s)
+                        else (("lit", s, i + 1),) + rest
+                    )
+            else:  # ("cls", matcher)
+                if _matches(head[1], ch):
+                    nxt.append(rest)
+        if not nxt:
+            return frozenset()
+        return self._closure(*nxt)
+
+    def accepting(self, states: frozenset) -> bool:
+        return () in states
+
+    def step_str(self, states: frozenset, s: str) -> frozenset:
+        for ch in s:
+            if not states:
+                return states
+            states = self.step(states, ch)
+        return states
+
+
+def _ast_refs(node: tuple):
+    kind = node[0]
+    if kind == "ref":
+        yield node[1]
+    elif kind == "seq" or kind == "alt":
+        for e in node[1]:
+            yield from _ast_refs(e)
+    elif kind == "rep":
+        yield from _ast_refs(node[1])
+
+
+def _left_recursion_cycle(rules: dict[str, tuple]) -> list[str] | None:
+    """Find a cycle in the leftmost-reference graph, where rule A has an
+    edge to rule B iff B can appear at A's start with only nullable
+    (epsilon-matchable) elements before it. Such a cycle means closure
+    can rewrite forever without consuming a character."""
+    # nullable fixpoint over rule refs (standard CFG nullability)
+    nullable: dict[str, bool] = {r: False for r in rules}
+
+    def node_nullable(node: tuple) -> bool:
+        kind = node[0]
+        if kind == "lit":
+            return node[1] == ""
+        if kind == "cls":
+            return False
+        if kind == "ref":
+            return nullable[node[1]]
+        if kind == "seq":
+            return all(node_nullable(e) for e in node[1])
+        if kind == "alt":
+            return any(node_nullable(e) for e in node[1])
+        # rep
+        return node[2] == 0 or node_nullable(node[1])
+
+    changed = True
+    while changed:
+        changed = False
+        for r, body in rules.items():
+            if not nullable[r] and node_nullable(body):
+                nullable[r] = True
+                changed = True
+
+    def left_refs(node: tuple):
+        kind = node[0]
+        if kind == "ref":
+            yield node[1]
+        elif kind == "alt":
+            for e in node[1]:
+                yield from left_refs(e)
+        elif kind == "seq":
+            for e in node[1]:
+                yield from left_refs(e)
+                if not node_nullable(e):
+                    break
+        elif kind == "rep":
+            if node[3] != 0:
+                yield from left_refs(node[1])
+
+    edges = {r: sorted(set(left_refs(b))) for r, b in rules.items()}
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {r: WHITE for r in rules}
+    path: list[str] = []
+
+    def dfs(r: str) -> list[str] | None:
+        color[r] = GRAY
+        path.append(r)
+        for t in edges[r]:
+            if color[t] == GRAY:
+                return path[path.index(t):] + [t]
+            if color[t] == WHITE:
+                c = dfs(t)
+                if c is not None:
+                    return c
+        path.pop()
+        color[r] = BLACK
+        return None
+
+    for r in rules:
+        if color[r] == WHITE:
+            c = dfs(r)
+            if c is not None:
+                return c
+    return None
+
+
+class _GrammarParser:
+    """Recursive-descent parser for the grammar text -> rule ASTs.
+
+    AST nodes (hashable nested tuples, the frames GrammarMachine
+    rewrites): ("lit", s), ("cls", matcher), ("ref", name),
+    ("seq", (e...)), ("alt", (a...)), ("rep", e, lo, hi|None)."""
+
+    def __init__(self, text: str):
+        self._toks = self._lex(text)
+        self._pos = 0
+
+    # -- lexer ------------------------------------------------------------
+    @staticmethod
+    def _lex(text: str) -> list[tuple]:
+        toks: list[tuple] = []
+        i, n = 0, len(text)
+        while i < n:
+            ch = text[i]
+            if ch in " \t\r\n":
+                i += 1
+                continue
+            if ch == "#":  # comment to end of line
+                while i < n and text[i] != "\n":
+                    i += 1
+                continue
+            if ch == ":" and text[i:i + 3] == "::=":
+                toks.append(("::=",))
+                i += 3
+                continue
+            if ch in "|()*+?":
+                toks.append((ch,))
+                i += 1
+                continue
+            if ch == "{":
+                j = text.find("}", i)
+                if j < 0:
+                    raise ValueError("unclosed {m,n} repeat")
+                spec = text[i + 1:j]
+                if not _valid_repeat(spec):
+                    raise ValueError(f"bad repeat {{{spec}}}")
+                toks.append(("{}",) + _parse_repeat_spec(spec, 1 << 16))
+                i = j + 1
+                continue
+            if ch == '"':
+                s, i = _GrammarParser._lex_string(text, i + 1)
+                toks.append(("str", s))
+                continue
+            if ch == "[":
+                m, i = _lex_char_class(text, i + 1)
+                toks.append(("cls", m))
+                continue
+            if ch.isalpha() or ch == "_":
+                j = i
+                while j < n and (text[j].isalnum() or text[j] in "_-"):
+                    j += 1
+                toks.append(("name", text[i:j]))
+                i = j
+                continue
+            raise ValueError(f"unexpected character {ch!r} in grammar")
+        return toks
+
+    @staticmethod
+    def _lex_string(text: str, i: int) -> tuple[str, int]:
+        out: list[str] = []
+        n = len(text)
+        while i < n and text[i] != '"':
+            ch = text[i]
+            if ch == "\\":
+                if i + 1 >= n:
+                    raise ValueError("dangling escape in grammar string")
+                e = text[i + 1]
+                simple = {"n": "\n", "t": "\t", "r": "\r", '"': '"',
+                          "\\": "\\"}
+                if e in simple:
+                    out.append(simple[e])
+                    i += 2
+                    continue
+                if e == "x" and i + 3 < n:
+                    out.append(chr(int(text[i + 2:i + 4], 16)))
+                    i += 4
+                    continue
+                raise ValueError(f"unsupported escape \\{e} in string")
+            out.append(ch)
+            i += 1
+        if i >= n:
+            raise ValueError("unclosed grammar string literal")
+        return "".join(out), i + 1
+
+
+    # -- parser -----------------------------------------------------------
+    def _peek(self, k: int = 0):
+        p = self._pos + k
+        return self._toks[p] if p < len(self._toks) else None
+
+    def _at_rule_start(self) -> bool:
+        t0, t1 = self._peek(), self._peek(1)
+        return (t0 is not None and t0[0] == "name"
+                and t1 is not None and t1[0] == "::=")
+
+    def parse(self) -> dict[str, tuple]:
+        rules: dict[str, tuple] = {}
+        while self._peek() is not None:
+            if not self._at_rule_start():
+                raise ValueError(
+                    f"expected `name ::=` at token {self._peek()!r}"
+                )
+            name = self._peek()[1]
+            self._pos += 2
+            if name in rules:
+                raise ValueError(f"duplicate rule {name!r}")
+            rules[name] = self._parse_alt()
+        return rules
+
+    def _parse_alt(self) -> tuple:
+        alts = [self._parse_seq()]
+        while self._peek() is not None and self._peek()[0] == "|":
+            self._pos += 1
+            alts.append(self._parse_seq())
+        return alts[0] if len(alts) == 1 else ("alt", tuple(alts))
+
+    def _parse_seq(self) -> tuple:
+        elems: list[tuple] = []
+        while True:
+            t = self._peek()
+            if (t is None or t[0] in ("|", ")")
+                    or self._at_rule_start()):
+                break
+            elems.append(self._parse_element())
+        if len(elems) == 1:
+            return elems[0]
+        return ("seq", tuple(elems))  # () = epsilon
+
+    def _parse_element(self) -> tuple:
+        t = self._peek()
+        if t[0] == "str":
+            node = ("lit", t[1])
+            self._pos += 1
+        elif t[0] == "cls":
+            node = ("cls", t[1])
+            self._pos += 1
+        elif t[0] == "name":
+            node = ("ref", t[1])
+            self._pos += 1
+        elif t[0] == "(":
+            self._pos += 1
+            node = self._parse_alt()
+            if self._peek() is None or self._peek()[0] != ")":
+                raise ValueError("unclosed group in grammar")
+            self._pos += 1
+        else:
+            raise ValueError(f"unexpected token {t!r} in grammar")
+        t = self._peek()
+        if t is not None and t[0] in ("*", "+", "?", "{}"):
+            self._pos += 1
+            if t[0] == "*":
+                node = ("rep", node, 0, None)
+            elif t[0] == "+":
+                node = ("rep", node, 1, None)
+            elif t[0] == "?":
+                node = ("rep", node, 0, 1)
+            else:
+                node = ("rep", node, t[1], t[2])
+        return node
+
+
+def _parse_grammar(text: str) -> dict[str, tuple]:
+    return _GrammarParser(text).parse()
 
 
 # ---------------------------------------------------------------------------
@@ -902,24 +1336,31 @@ _MACHINE_CACHE: dict = {}
 _MACHINE_CACHE_CAP = 64
 
 
-def get_machine(kind: str, spec) -> JsonSchemaMachine | RegexMachine:
-    """Compile (or fetch) the machine for a guided_json / guided_regex
-    constraint. `spec` is a schema dict/str for json, a pattern for
-    regex."""
+def get_machine(
+    kind: str, spec
+) -> "JsonSchemaMachine | RegexMachine | GrammarMachine":
+    """Compile (or fetch) the machine for a guided_json / guided_regex /
+    guided_grammar constraint. `spec` is a schema dict/str for json, a
+    pattern for regex, an EBNF grammar text for grammar."""
     if kind == "json":
         if isinstance(spec, str):
             spec = json.loads(spec)
         key = ("json", json.dumps(spec, sort_keys=True))
     else:
-        key = ("regex", spec)
+        key = (kind, spec)
     m = _MACHINE_CACHE.get(key)
+    if isinstance(m, ValueError):
+        raise m  # negative-cached: don't re-pay a failing compile
     if m is None:
         if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
             _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
-        m = (
-            JsonSchemaMachine(spec) if kind == "json"
-            else RegexMachine(spec)
-        )
+        cls = {"json": JsonSchemaMachine, "regex": RegexMachine,
+               "grammar": GrammarMachine}[kind]
+        try:
+            m = cls(spec)
+        except ValueError as e:
+            _MACHINE_CACHE[key] = e
+            raise
         _MACHINE_CACHE[key] = m
     return m
 

@@ -1,0 +1,190 @@
+"""guided_grammar: EBNF-constrained generation (vLLM guided_grammar
+role; reference capability: SURVEY §2.7 vLLM-equivalent engine —
+structured-output backends). GBNF-style syntax, GrammarMachine in
+engine/structured.py.
+
+Tiers mirror the other guided kinds: machine-level walks, DFA
+compilation parity, engine e2e (host path and fused K-step device
+path), and protocol validation."""
+
+from __future__ import annotations
+
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.engine.structured import (
+    GrammarMachine,
+    TokenDFA,
+    TokenMaskCache,
+    get_machine,
+)
+from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+
+def make_engine(**overrides) -> LLMEngine:
+    kw = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=64,
+        max_num_seqs=2, max_prefill_chunk=32, seed=0,
+    )
+    kw.update(overrides)
+    return LLMEngine(EngineConfig(**kw))
+
+
+EXPR_GRAMMAR = r"""
+# arithmetic over single digits, right-recursive
+root ::= expr
+expr ::= term (("+" | "-") expr)?
+term ::= [0-9]+ | "(" expr ")"
+"""
+
+
+def accepts(m: GrammarMachine, s: str) -> bool:
+    st = m.step_str(m.initial(), s)
+    return bool(st) and m.accepting(st)
+
+
+def test_machine_walks():
+    m = GrammarMachine(EXPR_GRAMMAR)
+    for good in ["1", "12", "1+2", "1+(2-3)", "((7))", "1+2-3+44"]:
+        assert accepts(m, good), good
+    for bad in ["", "+", "1+", "(1", "1)", "a", "1**2"]:
+        assert not accepts(m, bad), bad
+
+
+def test_machine_literals_classes_repeats():
+    m = GrammarMachine(
+        'root ::= "ab" [x-z]{2,3} ("!" | "?")* [^0-9]'
+    )
+    for good in ["abxy!", "abxyz??!c", "abzz _"[:5]]:
+        assert accepts(m, good), good
+    for bad in ["abx!", "abxyzz!", "abxy5", "abxy"]:
+        assert not accepts(m, bad), bad
+
+
+def test_string_escapes_and_comments():
+    m = GrammarMachine(
+        'root ::= "a\\n" "\\x41" # trailing comment\n'
+    )
+    assert accepts(m, "a\nA")
+    assert not accepts(m, "a\nB")
+
+
+def test_left_recursion_rejected_at_compile():
+    import time
+
+    for g in [
+        'root ::= root "a" | "a"',
+        # indirect: root -> b -> root through a nullable prefix
+        'root ::= b "x"\nb ::= "q"? root | "y"',
+        # nullable-prefix self recursion
+        'root ::= "a"* root "b" | "c"',
+    ]:
+        t0 = time.time()
+        with pytest.raises(ValueError, match="left-recursive"):
+            GrammarMachine(g)
+        # structural detection, not closure-budget exhaustion: the
+        # admission path must reject in milliseconds (review r5: the
+        # budget burn was a ~13s request-path DoS)
+        assert time.time() - t0 < 1.0
+
+
+def test_nullable_star_terminates():
+    # star of a nullable element must close (seen-set, not divergence)
+    m = GrammarMachine('root ::= ("a"?)* "b"')
+    assert accepts(m, "aab")
+    assert accepts(m, "b")
+
+
+def test_class_hex_escapes_and_escaped_range_bounds():
+    m = GrammarMachine(r'root ::= [\x41-\x5A]+')  # A-Z
+    assert accepts(m, "AZQ")
+    assert not accepts(m, "a")
+    assert not accepts(m, "x")  # \x41 must not lex as literal 'x','4'...
+    m2 = GrammarMachine(r'root ::= [\t-~]')
+    assert accepts(m2, "~") and accepts(m2, "\t") and accepts(m2, "A")
+    assert not accepts(m2, "\x00")
+    with pytest.raises(ValueError, match="range bound"):
+        GrammarMachine(r'root ::= [a-\d]')
+
+
+def test_regex_class_hex_escapes():
+    from production_stack_tpu.engine.structured import RegexMachine
+
+    m = RegexMachine(r"[\x30-\x39]+")  # 0-9
+    st = m.step_str(m.initial(), "042")
+    assert st and m.accepting(st)
+    assert not m.step_str(m.initial(), "a")
+
+
+def test_undefined_and_missing_root_rejected():
+    with pytest.raises(ValueError, match="undefined"):
+        GrammarMachine('root ::= nosuchrule')
+    with pytest.raises(ValueError, match="root"):
+        GrammarMachine('start ::= "a"')
+    with pytest.raises(ValueError, match="duplicate"):
+        GrammarMachine('root ::= "a"\nroot ::= "b"')
+
+
+def test_token_dfa_matches_host_mask_walk():
+    """Finite grammars must compile to the device DFA, with per-state
+    allowed sets equal to the host trie-product walk."""
+    tok = ByteTokenizer()
+    mc = TokenMaskCache(tok)
+    machine = get_machine(
+        "grammar", 'root ::= ("cat" | "car" | "dog") "s"?'
+    )
+    dfa = TokenDFA.build(machine, mc, tok.vocab_size, tok.eos_token_id)
+    assert dfa is not None
+    for states, idx in dfa.state_index.items():
+        expect = set(mc.allowed(machine, states))
+        if machine.accepting(states) or not expect:
+            expect.add(tok.eos_token_id)
+        got = {
+            t for t in range(tok.vocab_size)
+            if dfa.class_mask[idx, dfa.token_class[t]]
+        }
+        assert got == expect, f"state {idx}"
+
+
+def test_engine_e2e_greedy():
+    eng = make_engine()
+    sp = SamplingParams(
+        max_tokens=16, temperature=0.0,
+        guided_grammar='root ::= "yes" | "no"',
+    )
+    out = eng.generate(["answer:"], sp)[0]
+    assert out.text in ("yes", "no")
+
+
+def test_engine_e2e_multistep_parity():
+    """K=4 fused device path must produce exactly the K=1 host-masked
+    output for a recursive grammar."""
+    g = 'root ::= "[" [0-9] ("," [0-9])* "]"'
+    outs = []
+    for k in (1, 4):
+        eng = make_engine(num_scheduler_steps=k)
+        sp = SamplingParams(max_tokens=24, temperature=0.0,
+                            guided_grammar=g)
+        outs.append(eng.generate(["list:"], sp)[0].text)
+    assert outs[0] == outs[1]
+    assert outs[0].startswith("[") and outs[0].endswith("]")
+
+
+def test_protocol_parses_guided_grammar():
+    from production_stack_tpu.engine.protocol import (
+        ProtocolError,
+        sampling_params_from_request,
+    )
+
+    sp = sampling_params_from_request(
+        {"max_tokens": 8, "guided_grammar": 'root ::= "x"'}
+    )
+    assert sp.guided_grammar == 'root ::= "x"'
+    with pytest.raises(ProtocolError):
+        sampling_params_from_request({"guided_grammar": 7})
+    with pytest.raises(ValueError):
+        SamplingParams(guided_grammar='root ::= "x"',
+                       guided_regex="x")
