@@ -891,8 +891,30 @@ class LLMEngine:
         is unconstrained."""
         machine = getattr(seq, "_guided_machine", None)
         if machine is not None:
+            if getattr(seq, "_guided_dead", False):
+                # constraint evaluation blew up earlier for THIS request
+                # (e.g. an ambiguous grammar whose closure diverges only
+                # mid-generation): the only legal move is to stop
+                return (
+                    {int(seq.eos_token_id)}
+                    if seq.eos_token_id is not None else set()
+                )
             states = seq._guided_state
-            allowed = set(self._mask_cache().allowed(machine, states))
+            try:
+                allowed = set(self._mask_cache().allowed(machine, states))
+            except ValueError as e:
+                # fail ONLY this request: a per-lane constraint blow-up
+                # must never abort the whole engine step (and with it
+                # every other in-flight stream)
+                logger.warning(
+                    "guided constraint diverged for %s mid-generation "
+                    "(%s); ending the stream", seq.request_id, e,
+                )
+                seq._guided_dead = True  # type: ignore[attr-defined]
+                return (
+                    {int(seq.eos_token_id)}
+                    if seq.eos_token_id is not None else set()
+                )
             if machine.accepting(states) and seq.eos_token_id is not None:
                 allowed.add(int(seq.eos_token_id))
             if not allowed and seq.eos_token_id is not None:
@@ -945,6 +967,10 @@ class LLMEngine:
             # lands in the vocab-range EOS column
             eos = (int(s.eos_token_id)
                    if s.eos_token_id is not None else -1)
+            # a diverging machine returns None here (the failure is
+            # negative-cached inside get_token_dfa, same as over-budget
+            # constraints); the host path's per-lane containment
+            # (_guided_allowed) then winds the request down
             dfa = get_token_dfa(
                 machine if machine is not None else choices,
                 mask_cache, vocab, eos,
@@ -1116,7 +1142,12 @@ class LLMEngine:
         ):
             ts = self._mask_cache().token_str(int(token))
             if ts:
-                ns = machine.step_str(seq._guided_state, ts)
+                try:
+                    ns = machine.step_str(seq._guided_state, ts)
+                except ValueError:
+                    # per-lane containment: see _guided_allowed
+                    ns = frozenset()
+                    seq._guided_dead = True  # type: ignore[attr-defined]
                 if ns:
                     seq._guided_state = ns  # type: ignore[attr-defined]
                 # empty set = the token strayed off-machine (only

@@ -500,7 +500,15 @@ class RegexMachine:
     negation, groups `(...)` (non-capturing semantics), alternation
     `|`, quantifiers `* + ? {m} {m,} {m,n}`. Anchors are implicit: the
     pattern must match the ENTIRE generation (vLLM guided_regex
-    semantics)."""
+    semantics).
+
+    Character classes follow Python `re` semantics (shared lexer with
+    the grammar dialect): `\\xHH` is a hex char escape, a single-char
+    escape may anchor a range (`[\\t-~]` is the tab..tilde RANGE), and
+    a multi-char class escape as a range bound (`[a-\\d]`) is rejected
+    at admission. Earlier releases lexed these literally; patterns
+    relying on that nonstandard reading now get the standard meaning
+    (or a 400 for `[a-\\d]`)."""
 
     _MAX_REPEAT = 256
 
@@ -1334,6 +1342,10 @@ def _gpt2_byte_decoder() -> dict[str, int]:
 
 _MACHINE_CACHE: dict = {}
 _MACHINE_CACHE_CAP = 64
+# sentinel tagging negative-cache entries (failed compiles) — see
+# get_machine: the cached value is (_INVALID, message), never the
+# exception instance itself
+_INVALID = object()
 
 
 def get_machine(
@@ -1343,14 +1355,26 @@ def get_machine(
     guided_grammar constraint. `spec` is a schema dict/str for json, a
     pattern for regex, an EBNF grammar text for grammar."""
     if kind == "json":
-        if isinstance(spec, str):
-            spec = json.loads(spec)
-        key = ("json", json.dumps(spec, sort_keys=True))
+        try:
+            if isinstance(spec, str):
+                spec = json.loads(spec)
+            key = ("json", json.dumps(spec, sort_keys=True))
+        except RecursionError:
+            # key construction recurses over the spec BEFORE the guarded
+            # compile below — a deeply nested json spec must hit the same
+            # admission ValueError -> 400 contract as grammar/regex
+            raise ValueError(
+                "guided_json spec too deeply nested"
+            ) from None
     else:
         key = (kind, spec)
     m = _MACHINE_CACHE.get(key)
-    if isinstance(m, ValueError):
-        raise m  # negative-cached: don't re-pay a failing compile
+    if isinstance(m, tuple) and m[0] is _INVALID:
+        # negative-cached: don't re-pay a failing compile. Raise a FRESH
+        # exception — re-raising a stored instance appends frames to its
+        # __traceback__ on every hit, pinning frames/locals for the life
+        # of the cache entry (unbounded memory on client retries).
+        raise ValueError(m[1])
     if m is None:
         if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
             _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
@@ -1359,8 +1383,16 @@ def get_machine(
         try:
             m = cls(spec)
         except ValueError as e:
-            _MACHINE_CACHE[key] = e
+            _MACHINE_CACHE[key] = (_INVALID, str(e))
             raise
+        except RecursionError:
+            # the recursive-descent parsers (grammar/regex/schema) have
+            # no explicit depth bound; a pathologically nested spec must
+            # surface as the documented admission-time ValueError -> 400,
+            # not an unhandled 500
+            msg = f"guided_{kind} spec too deeply nested"
+            _MACHINE_CACHE[key] = (_INVALID, msg)
+            raise ValueError(msg) from None
         _MACHINE_CACHE[key] = m
     return m
 
@@ -1581,9 +1613,17 @@ def get_token_dfa(machine_or_choices, mask_cache, vocab: int,
         )
         ref = None
     else:
-        dfa = TokenDFA.build(
-            machine_or_choices, mask_cache, vocab, eos_token_id
-        )
+        try:
+            dfa = TokenDFA.build(
+                machine_or_choices, mask_cache, vocab, eos_token_id
+            )
+        except ValueError:
+            # a DIVERGING machine (closure cap mid-build) is as
+            # permanent a failure as an over-budget one: negative-cache
+            # it (the documented contract), or the failing tens-of-ms
+            # build re-runs on the scheduling hot path every decode
+            # round for the life of the request
+            dfa = None
         ref = machine_or_choices  # pin: id()-keyed entries must not dangle
     if len(_TOKEN_DFA_CACHE) >= _TOKEN_DFA_CACHE_CAP:
         _TOKEN_DFA_CACHE.popitem(last=False)  # least-recently-used

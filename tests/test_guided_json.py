@@ -333,3 +333,16 @@ def test_api_surface_guided_json():
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_deeply_nested_json_spec_is_admission_valueerror():
+    """RecursionError from cache-key construction (json.loads/dumps
+    recurse over the spec BEFORE compile) must surface as the documented
+    admission ValueError -> 400, like grammar/regex (code-review r5)."""
+    import pytest
+
+    from production_stack_tpu.engine.structured import get_machine
+
+    deep = "[" * 30000 + "1" + "]" * 30000
+    with pytest.raises(ValueError, match="nested"):
+        get_machine("json", deep)
