@@ -50,6 +50,15 @@ NUM_USERS = int(os.environ.get("PST_BENCH_USERS", "16"))
 SYSTEM_PROMPT_TOK = int(os.environ.get("PST_BENCH_SYS_TOK", "512"))
 HISTORY_TOK = int(os.environ.get("PST_BENCH_HISTORY_TOK", "1024"))
 ANSWER_TOK = int(os.environ.get("PST_BENCH_ANSWER_TOK", "100"))
+# chat rounds per user (reference: multi-round-qa/run.sh drives 10 rounds
+# per session). Rounds 2+ resume from the prefix cache — only the tail
+# past the last cached whole block re-prefills — so multi-round is both
+# the faithful workload shape AND the one the paged prefix cache exists
+# for. All lengths are deterministic (greedy + ignore_eos), so every
+# resume-tail bucket is precompiled analytically below.
+ROUNDS = int(os.environ.get("PST_BENCH_ROUNDS", "10"))
+# tokens appended as the user's next question between rounds
+QUESTION_TOK = int(os.environ.get("PST_BENCH_QUESTION_TOK", "64"))
 # fused decode iterations per dispatch (amortises the host<->device RTT,
 # which dominates through the tunneled chip; see engine/model_runner.py)
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
@@ -336,6 +345,12 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     from production_stack_tpu.engine.sampling_params import SamplingParams
 
     t_setup = time.time()
+    # final-round sequence length: round-1 prompt plus per-round growth
+    # (answer fed back into the session + the next question)
+    final_len = (
+        SYSTEM_PROMPT_TOK + HISTORY_TOK
+        + (ROUNDS - 1) * (ANSWER_TOK + QUESTION_TOK) + ANSWER_TOK
+    )
     config = EngineConfig(
         model=MODEL,
         tokenizer="byte",
@@ -343,7 +358,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         cache_dtype="bfloat16",
         block_size=32,
         hbm_utilization=0.85,
-        max_model_len=4096,
+        max_model_len=max(4096, 32 * (-(-(final_len + 64) // 32))),
         max_num_seqs=NUM_USERS,
         max_prefill_chunk=512,
         max_prefill_seqs=prefill_seqs,
@@ -365,6 +380,13 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     shared_prefix = rng.randint(0, vocab, SYSTEM_PROMPT_TOK).tolist()
     prompts = [
         shared_prefix + rng.randint(0, vocab, HISTORY_TOK).tolist()
+        for _ in range(NUM_USERS)
+    ]
+    # the user's next message for each later round, fixed up front so the
+    # workload is deterministic across configs
+    questions = [
+        [rng.randint(0, vocab, QUESTION_TOK).tolist()
+         for _ in range(ROUNDS - 1)]
         for _ in range(NUM_USERS)
     ]
     sp = SamplingParams(
@@ -416,7 +438,49 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             s *= 2
         if prefill_seqs > 1:
             groups.append((2, tail_len, tail_ctx))
+        if ROUNDS > 1:
+            # rounds 2+ resume from the prefix cache at the last cached
+            # whole-block boundary of the previous round's sequence; with
+            # greedy + ignore_eos every length is deterministic, so each
+            # round's resume tail compiles ahead of the timed run. Fused
+            # K-step rounds finish whole lane groups together, so
+            # resubmissions arrive in BURSTS — the packed variants of
+            # each tail are reachable too. Dedup by bucket so shared
+            # (t_pad, c_pad) programs cost one trash dispatch, not one
+            # per round.
+            seen = {
+                (rnr._prefill_bucket(cl), t) for cl, t in singles
+            }
+            seen_g = {
+                (gs, rnr._prefill_bucket(cl), t) for gs, cl, t in groups
+            }
+            L = plen
+            for r in range(ROUNDS - 1):
+                prev_total = L + ANSWER_TOK
+                L = prev_total + QUESTION_TOK
+                cached = (prev_total // bs) * bs
+                rtail = L - cached
+                cb = rnr._ctx_bucket(L)
+                if (rnr._prefill_bucket(rtail), cb) not in seen:
+                    seen.add((rnr._prefill_bucket(rtail), cb))
+                    singles.append((rtail, cb))
+                gs = 2
+                while gs <= min(prefill_seqs, NUM_USERS):
+                    key = (gs, rnr._prefill_bucket(rtail), cb)
+                    if key not in seen_g:
+                        seen_g.add(key)
+                        groups.append((gs, rtail, cb))
+                    gs *= 2
         ndisp = rnr.precompile_prefill(singles, groups)
+        if ROUNDS > 1:
+            # later rounds also cross decode ctx buckets (pow2 block
+            # counts) the warmup never reached
+            grow = ANSWER_TOK + QUESTION_TOK
+            ndisp += rnr.precompile_decode(
+                [plen + r * grow + ANSWER_TOK for r in range(ROUNDS)],
+                sched_steps,
+                chained=async_decode,
+            )
         print(
             f"# prefill precompile: {ndisp} dispatches in "
             f"{time.time() - t0:.1f}s",
@@ -429,10 +493,15 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     # arrival, not from the start of a burst
     ttfts: dict[str, float] = {}
     t_start = time.time()
-    arrivals = [(f"u{i}", t_start + i / QPS, p)
+    # request ids are "u<i>:r<round>"; round-1 arrivals are QPS-paced,
+    # rounds 2+ resubmit the grown session the moment the previous
+    # answer lands (reference sessions chat continuously)
+    arrivals = [(f"u{i}:r1", t_start + i / QPS, p)
                 for i, p in enumerate(prompts)]
     submit_t: dict[str, float] = {}
     pending = list(arrivals)
+    session_prompt = list(prompts)  # per-user, grows each round
+    session_round = [1] * NUM_USERS
 
     gen_tokens = 0
     decode_time = 0.0
@@ -463,12 +532,28 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                 if prev is not None:
                     itls.append(now - prev)
                 last_token_t[out.request_id] = now
+            if out.finished:
+                uid = int(out.request_id.split(":")[0][1:])
+                r = session_round[uid]
+                if r < ROUNDS:
+                    session_prompt[uid] = (
+                        session_prompt[uid] + list(out.token_ids)
+                        + questions[uid][r - 1]
+                    )
+                    session_round[uid] = r + 1
+                    nrid = f"u{uid}:r{r + 1}"
+                    engine.add_request(
+                        nrid,
+                        prompt_token_ids=session_prompt[uid],
+                        sampling_params=sp,
+                    )
+                    submit_t[nrid] = now
         if engine.last_step_kind == "decode":
             gen_tokens += sum(len(o.new_token_ids) for o in outs)
             decode_time += dt
     total_time = time.time() - t_start
 
-    all_gen = NUM_USERS * ANSWER_TOK
+    all_gen = NUM_USERS * ANSWER_TOK * ROUNDS
     decode_tps = gen_tokens / decode_time if decode_time > 0 else 0.0
     overall_tps = all_gen / total_time
     ttft_arr = np.asarray(sorted(ttfts.values()))
@@ -490,10 +575,16 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     # value and vs_baseline are both per-chip so TP runs stay comparable
     roofline_tps = NUM_USERS * TP * HBM_BW_GBPS * 1e9 / model_bytes
 
+    r1 = np.asarray(
+        [v for k, v in ttfts.items() if k.endswith(":r1")]
+    )
+    resume = np.asarray(
+        [v for k, v in ttfts.items() if not k.endswith(":r1")]
+    )
     result = {
         "metric": (
             f"multi-round-qa-style serving throughput "
-            f"({mc.name}, {NUM_USERS} users, "
+            f"({mc.name}, {NUM_USERS} users x {ROUNDS} rounds, "
             f"{SYSTEM_PROMPT_TOK}+{HISTORY_TOK} tok prompts, "
             f"{ANSWER_TOK} tok answers, {TP} chip(s))"
         ),
@@ -507,8 +598,18 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "prefill_seqs": prefill_seqs,
             "async_decode": async_decode,
             "config_label": label,
+            "rounds": ROUNDS,
             "decode_tokens_per_s_aggregate": round(decode_tps, 1),
             "p50_ttft_s": round(p50_ttft, 3),
+            # round-1 TTFT pays the full prefill; rounds 2+ resume from
+            # the prefix cache and re-prefill only the session tail
+            "p50_ttft_round1_s": round(
+                float(np.percentile(r1, 50)), 3
+            ) if len(r1) else -1,
+            "p50_ttft_resume_s": round(
+                float(np.percentile(resume, 50)), 3
+            ) if len(resume) else -1,
+            "preemptions": engine.stats().num_preemptions_total,
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)
             else -1,

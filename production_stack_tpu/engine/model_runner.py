@@ -1244,6 +1244,75 @@ class ModelRunner:
             n += 1
         return n
 
+    def precompile_decode(
+        self, context_lens: list[int], steps: int,
+        chained: bool = False,
+    ) -> int:
+        """Compile the fused-K decode program for every ctx bucket the
+        given context lengths reach, against trash blocks at the top of
+        the pool (same safety contract as precompile_prefill). Decode
+        lanes are statically padded to max_num_seqs, so the ctx bucket is
+        the only shape dimension a serving run crosses mid-stream —
+        e.g. multi-round chat sessions grow past a pow2 block-count
+        boundary and would otherwise pay an XLA compile inside a live
+        ITL measurement. Greedy sampling arrays select the same program
+        as any temperature (sampling params are runtime operands).
+
+        `chained=True` additionally compiles the async-pipeline variant
+        (device-array token input — a DISTINCT program cache key): the
+        chained dispatch crosses the same ctx buckets mid-pipeline, so
+        async serving needs both programs warm."""
+        b = self.config.max_num_seqs
+        bs = self.block_size
+        nb = self.num_blocks
+        temps = np.zeros((b,), np.float32)
+        top_ps = np.ones((b,), np.float32)
+        top_ks = np.full((b,), -1, np.int32)
+        keys = np.zeros((b, 2), np.uint32)
+        seen: set[int] = set()
+        n = 0
+        for cl in context_lens:
+            c_pad = self._ctx_bucket(cl + max(0, steps - 1))
+            if c_pad in seen:
+                continue
+            seen.add(c_pad)
+            npages = c_pad // bs
+            # same 2x-plus-slack rule as precompile_prefill: the low
+            # half of the pool may already hold live/cached K/V (warmup
+            # runs before precompile in bench/server startup), and the
+            # trash table must never reach down into it
+            if nb < 2 * npages + 64:
+                logger.warning(
+                    "decode precompile: skipping ctx %d — pool of %d "
+                    "blocks too small", cl, nb,
+                )
+                continue
+            # every lane shares one trash table: decode writes land in
+            # the same top-of-pool slots, never on live cached K/V
+            table = list(range(nb - npages, nb))
+            ctx = c_pad - max(0, steps - 1)
+            if steps > 1:
+                out = self.decode_multi(
+                    [1] * b, [ctx - 1] * b, [table] * b, [ctx] * b,
+                    steps, temps, top_ps, top_ks, keys,
+                )
+                jax.block_until_ready(out)
+                n += 1
+                if chained:
+                    out = self.decode_multi(
+                        out[-1], [ctx - 1] * b, [table] * b, [ctx] * b,
+                        steps, temps, top_ps, top_ks, keys,
+                    )
+                    jax.block_until_ready(out)
+                    n += 1
+            else:
+                out = self.decode(
+                    [1] * b, [ctx - 1] * b, [table] * b, [ctx] * b
+                )
+                jax.block_until_ready(out)
+                n += 1
+        return n
+
     def decode(
         self,
         token_ids: list[int],
