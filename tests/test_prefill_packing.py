@@ -206,10 +206,14 @@ def test_scheduler_no_packing_without_chunking():
 
 
 def test_packing_respects_decode_interleave_bound():
-    """When decode-ready sequences are waiting, a packed prefill group
-    must not exceed the remaining decode_interleave budget — otherwise
-    the documented ITL bound ("at most K prefill chunks between decode
-    steps") silently becomes K-1+max_prefill_seqs (advisor r3)."""
+    """decode_interleave counts prefill DISPATCHES: a packed group of N
+    chunks is one device dispatch whose wall cost is RTT-dominated, so
+    under decode load the scheduler still packs a FULL group per
+    interleave slot (the earlier chunk-counting reading throttled
+    admission to one unpacked chunk per decode round — measured on
+    hardware as round-1 p50 TTFT 15.6s vs low seconds in the 10-round
+    workload), and a decode round must follow after at most
+    `decode_interleave` dispatches."""
     from production_stack_tpu.engine.block_manager import BlockManager
     from production_stack_tpu.engine.scheduler import (
         Scheduler,
@@ -248,22 +252,35 @@ def test_packing_respects_decode_interleave_bound():
             ))
         return sched
 
-    # K=1: exactly one prefill chunk, then a decode, never a full group
+    # K=1: one FULL packed dispatch (all 6 waiting chunks), then a
+    # decode round must follow before any further prefill dispatch
     sched = build(decode_interleave=1)
     out = sched.schedule()
-    assert len(out.prefills) == 1  # capped by the ITL budget, not 6
-    out.prefills[0].seq.num_computed_tokens += out.prefills[0].chunk_len
-    out = sched.schedule()
-    assert out.decode is not None  # the bound held
-
-    # K=4: the group may take the whole remaining budget at once
-    sched = build(decode_interleave=4)
-    out = sched.schedule()
-    assert len(out.prefills) == 4
+    assert len(out.prefills) == 6  # one dispatch packs the whole group
     for w in out.prefills:
         w.seq.num_computed_tokens += w.chunk_len
     out = sched.schedule()
-    assert out.decode is not None
+    assert out.decode is not None  # the dispatch bound held
+
+    # K=2: two consecutive packed dispatches are allowed, then decode.
+    # 10 fresh prompts with max_prefill_seqs=8 need two dispatches
+    sched = build(decode_interleave=2)
+    for i in range(6, 10):
+        sched.add_seq(Sequence(
+            request_id=f"p{i}", prompt_token_ids=list(range(1, 9)),
+            sampling_params=SamplingParams(max_tokens=2),
+            eos_token_id=None,
+        ))
+    out = sched.schedule()
+    assert len(out.prefills) == 8  # full group, dispatch 1
+    for w in out.prefills:
+        w.seq.num_computed_tokens += w.chunk_len
+    out = sched.schedule()
+    assert len(out.prefills) == 2  # remaining chunks, dispatch 2
+    for w in out.prefills:
+        w.seq.num_computed_tokens += w.chunk_len
+    out = sched.schedule()
+    assert out.decode is not None  # streak exhausted -> decode
 
     # no decode-ready sequences: packing is unconstrained
     bm = BlockManager(num_blocks=256, block_size=4,
