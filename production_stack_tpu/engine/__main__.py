@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "round N's on-device tokens before fetching it")
     p.add_argument("--no-async-decode", dest="async_decode",
                    action="store_false")
+    p.add_argument("--precompile-serving", action="store_true",
+                   default=False,
+                   help="compile every steady-state prefill/decode "
+                        "program shape at startup so no XLA compile "
+                        "lands inside a live request (minutes of "
+                        "startup the first time; cheap on restart with "
+                        "JAX_COMPILATION_CACHE_DIR)")
     p.add_argument("--enable-prefix-caching", action="store_true",
                    default=True)
     p.add_argument("--no-enable-prefix-caching",
@@ -138,6 +145,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         decode_interleave=args.decode_interleave,
         num_scheduler_steps=args.num_scheduler_steps,
         async_decode=args.async_decode,
+        precompile_serving=args.precompile_serving,
         num_speculative_tokens=args.num_speculative_tokens,
         ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,

@@ -53,6 +53,14 @@ class EngineConfig:
     # bit-identical). Ignored under multihost (followers replay host
     # token lists).
     async_decode: bool = True
+    # compile every steady-state serving program shape at startup
+    # (full-chunk + resume-tail prefill, packed groups, fused-K decode,
+    # per ctx bucket) so no XLA compile lands inside a live request's
+    # TTFT/ITL — through a remote/tunneled chip one compile is tens of
+    # seconds. Costs minutes of startup the FIRST time; the persistent
+    # compile cache (JAX_COMPILATION_CACHE_DIR) makes later restarts
+    # cheap. Multihost: broadcast so follower hosts compile ahead too.
+    precompile_serving: bool = False
     # speculative decoding (vLLM --speculative-config ngram role):
     # propose up to this many draft tokens by prompt-lookup (the last
     # n-gram's previous continuation in the context) and verify them in

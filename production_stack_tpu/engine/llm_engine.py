@@ -1388,3 +1388,75 @@ class LLMEngine:
                 if out.finished:
                     finals[out.request_id] = out
         return [finals[f"gen-{i}"] for i in range(len(prompts))]
+
+    def precompile_serving(self) -> int:
+        """Compile every config-derivable serving program shape: the
+        FULL grid of prefill programs (every pow2 chunk bucket — final
+        tail chunks land anywhere below max_prefill_chunk — x every
+        reachable ctx bucket x every pow2 packed-group size), the
+        fused-K decode program per ctx bucket (+ the chained async
+        variant), and, with spec decode on, the packed verify programs.
+        Servers call this at startup (--precompile-serving) so no XLA
+        compile lands inside a live request's TTFT/ITL — the round-5
+        hardware sweeps measured 6-40s tunnel compiles landing
+        mid-measurement for exactly these shapes. Returns the number of
+        trash dispatches executed.
+
+        Out of scope (request-dependent, not config-derivable): the
+        penalties / logprobs / guided-table variants of the decode
+        program — requests using those sampling features may pay one
+        compile per variant. First-boot cost is minutes (the grid is
+        O(log^2) programs); with JAX_COMPILATION_CACHE_DIR restarts
+        reuse every program."""
+        rnr = self.runner
+        cfg = self.config
+        bs = self.block_manager.block_size
+        # reachable ctx buckets: pow2 block counts from one block up to
+        # the smaller of max_model_len and what the pool can hold
+        cap = min(cfg.max_model_len, rnr.num_blocks * bs)
+        ctxs: list[int] = []
+        c = rnr._ctx_bucket(1)
+        while True:
+            ctxs.append(c)
+            if c >= cap:
+                break
+            c = rnr._ctx_bucket(c + 1)
+        # chunk-length buckets: every pow2 t_pad bucket up to the full
+        # chunk (a prompt of any length puts its final tail chunk in
+        # any of them)
+        tbs: list[int] = []
+        t = rnr._prefill_bucket(1)
+        while True:
+            tbs.append(t)
+            if t >= rnr._prefill_bucket(cfg.max_prefill_chunk):
+                break
+            t = rnr._prefill_bucket(t + 1)
+        singles: list[tuple[int, int]] = []
+        groups: list[tuple[int, int, int]] = []
+        for c in ctxs:
+            for t in tbs:
+                if t > c:
+                    continue
+                singles.append((t, c))
+                # every pow2 group size: the packed program key is
+                # s_pad = next_pow2(n_actual), so a 2-seq burst is a
+                # different program than the max group
+                s = 2
+                while s <= cfg.max_prefill_seqs:
+                    groups.append((s, t, c))
+                    s *= 2
+        n = rnr.precompile_prefill(singles, groups)
+        # decode: pick context lens that land IN each bucket after the
+        # +K-1 lookahead shift (passing the bucket boundary itself would
+        # shift every program one bucket up and leave the smallest
+        # bucket cold)
+        k = cfg.num_scheduler_steps
+        n += rnr.precompile_decode(
+            [max(1, c - k + 1) for c in ctxs], k,
+            chained=self._async_decode,
+        )
+        if cfg.num_speculative_tokens > 0:
+            n += rnr.precompile_verify(
+                ctxs, cfg.num_speculative_tokens + 1, cfg.max_num_seqs
+            )
+        return n

@@ -1313,6 +1313,59 @@ class ModelRunner:
                 n += 1
         return n
 
+    def precompile_verify(
+        self, context_lens: list[int], draft_len: int, max_lanes: int
+    ) -> int:
+        """Compile the packed spec-decode verify programs (program key
+        (s_pad, t_pad, c_pad), see verify_batch): every pow2 lane count
+        up to max_lanes x the draft-chunk bucket x each ctx bucket,
+        against trash blocks at the top of the pool (same safety rule
+        as the other precompiles)."""
+        bs = self.block_size
+        nb = self.num_blocks
+        lanes: list[int] = []
+        s = 1
+        while s <= max_lanes:
+            lanes.append(s)
+            s *= 2
+        seen: set[tuple] = set()
+        n = 0
+        for cl in context_lens:
+            c_pad = self._ctx_bucket(cl)
+            npages = c_pad // bs
+            for s in lanes:
+                key = (s, self._prefill_bucket(draft_len), c_pad)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if nb < 2 * s * npages + 64:
+                    logger.warning(
+                        "verify precompile: skipping s=%d ctx=%d — pool "
+                        "of %d blocks too small", s, c_pad, nb,
+                    )
+                    continue
+                tabs = [
+                    list(range(nb - (i + 1) * npages, nb - i * npages))
+                    for i in range(s)
+                ]
+                row_sampling = (
+                    np.zeros((s,), np.float32),
+                    np.ones((s,), np.float32),
+                    np.full((s,), -1, np.int32),
+                    np.zeros((s,), np.uint32),
+                    np.zeros((s,), np.int64),
+                )
+                out = self.verify_batch(
+                    [[1] * draft_len] * s,
+                    [c_pad - draft_len] * s,
+                    tabs,
+                    [c_pad] * s,
+                    row_sampling,
+                )
+                jax.block_until_ready(out)
+                n += 1
+        return n
+
     def decode(
         self,
         token_ids: list[int],

@@ -249,6 +249,28 @@ class BroadcastingRunner:
         })
         return self._runner.embed(token_ids, lora_slot=lora_slot)
 
+    def precompile_prefill(self, singles=(), groups=()):
+        # broadcast so FOLLOWERS compile ahead too — a follower that
+        # first meets a program shape inside a live replayed step stalls
+        # the whole collective for the compile
+        self._bc.publish({
+            "kind": "precompile_prefill",
+            "singles": [[int(a), int(b)] for a, b in singles],
+            "groups": [[int(s), int(a), int(b)] for s, a, b in groups],
+        })
+        return self._runner.precompile_prefill(singles, groups)
+
+    def precompile_decode(self, context_lens, steps, chained=False):
+        self._bc.publish({
+            "kind": "precompile_decode",
+            "context_lens": [int(c) for c in context_lens],
+            "steps": int(steps),
+            "chained": bool(chained),
+        })
+        return self._runner.precompile_decode(
+            context_lens, steps, chained=chained
+        )
+
     def shutdown_followers(self) -> None:
         self._bc.publish({"kind": "shutdown"})
 
@@ -344,5 +366,9 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
             runner.verify_batch(**msg)
         elif kind == "embed":
             runner.embed(**msg)
+        elif kind == "precompile_prefill":
+            runner.precompile_prefill(**msg)
+        elif kind == "precompile_decode":
+            runner.precompile_decode(**msg)
         else:  # future step kinds must fail loudly, not silently desync
             raise RuntimeError(f"unknown multihost step kind {kind!r}")

@@ -48,6 +48,15 @@ class EngineServer:
         self.config = config
         self.model_name = config.served_model_name or config.model
         self.engine = AsyncLLMEngine(config, params=params)
+        if config.precompile_serving:
+            t0 = time.time()
+            n = self.engine.engine.precompile_serving()
+            logger.info(
+                "serving precompile: %d dispatches in %.1fs (every "
+                "config-derivable program shape warm; only "
+                "request-dependent sampling variants can still compile "
+                "lazily)", n, time.time() - t0,
+            )
         self.registry = CollectorRegistry()
         self.metrics = EngineMetrics(self.model_name, registry=self.registry)
         self.lora_adapters: dict[str, str] = {}  # name -> path

@@ -327,3 +327,38 @@ def test_broadcast_guided_tables_sent_once():
         t, init, lane, ftc, fcm, fct = kw["guided"]
         assert t == (7, 4, 2, 2)
         assert ftc.shape == tc.shape and fcm.shape == cm.shape
+
+
+def test_broadcast_carries_precompile():
+    """--precompile-serving under multihost: precompile dispatches
+    broadcast so FOLLOWER hosts compile ahead too — a follower that
+    first meets a program shape inside a live replayed step stalls the
+    whole collective for the compile."""
+    from production_stack_tpu.engine import multihost_engine as mhe
+
+    class _PrecompileRunner(_RecordingRunner):
+        def precompile_prefill(self, *a, **kw):
+            self.calls.append(("precompile_prefill", a, kw))
+            return 3
+
+        def precompile_decode(self, *a, **kw):
+            self.calls.append(("precompile_decode", a, kw))
+            return 2
+
+    runner = _PrecompileRunner()
+    bc = _FakeBroadcaster()
+    proxy = mhe.BroadcastingRunner(runner, bc)
+    assert proxy.precompile_prefill([(16, 32)], [(2, 16, 32)]) == 3
+    assert proxy.precompile_decode([64, 128], 4, chained=True) == 2
+    assert bc.published[0] == {
+        "kind": "precompile_prefill",
+        "singles": [[16, 32]], "groups": [[2, 16, 32]],
+    }
+    assert bc.published[1] == {
+        "kind": "precompile_decode",
+        "context_lens": [64, 128], "steps": 4, "chained": True,
+    }
+    follower = _PrecompileRunner()
+    _drain_follower(bc, follower)
+    kinds = [c[0] for c in follower.calls]
+    assert kinds == ["precompile_prefill", "precompile_decode"]

@@ -348,3 +348,51 @@ def test_precompile_prefill_leaves_cache_semantics_intact():
     out_a = [o.token_ids for o in plain.generate(_prompts(), greedy(6))]
     out_b = [o.token_ids for o in swept.generate(_prompts(), greedy(6))]
     assert out_a == out_b
+
+
+def test_precompile_serving_covers_all_buckets():
+    """--precompile-serving (engine/server startup): the FULL
+    config-derivable grid — every pow2 chunk bucket x ctx bucket for
+    singles, every pow2 group size for packed groups, the fused-K
+    decode program per ctx bucket INCLUDING the smallest (the +K-1
+    lookahead shift must not leave it cold), and with spec decode on,
+    the packed verify programs for every pow2 lane count."""
+    eng = LLMEngine(tiny_cfg(
+        max_prefill_seqs=4, num_kv_blocks=256, max_model_len=64,
+        num_scheduler_steps=2, async_decode=False,
+        num_speculative_tokens=2,
+    ))
+    r = eng.runner
+    n = eng.precompile_serving()
+    assert n > 0
+    cap = 64
+    ctxs = []
+    c = r._ctx_bucket(1)
+    while True:
+        ctxs.append(c)
+        if c >= cap:
+            break
+        c = r._ctx_bucket(c + 1)
+    tbs = []
+    t = r._prefill_bucket(1)
+    while True:
+        tbs.append(t)
+        if t >= r._prefill_bucket(eng.config.max_prefill_chunk):
+            break
+        t = r._prefill_bucket(t + 1)
+    for c in ctxs:
+        for t in tbs:
+            if t > c:
+                continue
+            # single-sequence program for every reachable tail bucket
+            assert (t, c) in r._prefill_fns, (t, c)
+            # every pow2 group size is its own packed program
+            for s in (2, 4):
+                assert (s, t, c) in r._prefill_batch_fns, (s, t, c)
+    # fused-K decode compiled for EVERY bucket, including the smallest
+    for c in ctxs:
+        assert any(k[1] == c for k in r._decode_multi_fns), c
+    # spec verify programs per pow2 lane count at the largest ctx bucket
+    tb = r._prefill_bucket(3)  # draft_len = num_speculative_tokens + 1
+    for s in (1, 2, 4):
+        assert (s, tb, ctxs[-1]) in r._verify_batch_fns, s
