@@ -64,6 +64,10 @@ QUESTION_TOK = int(os.environ.get("PST_BENCH_QUESTION_TOK", "64"))
 SCHED_STEPS = int(os.environ.get("PST_BENCH_SCHED_STEPS", "8"))
 # cross-sequence prefill packing group cap (1 = round-2 behavior)
 PREFILL_SEQS = int(os.environ.get("PST_BENCH_PREFILL_SEQS", "8"))
+# prefill chunk size: bigger chunks = fewer RTT-dominated dispatches per
+# cold prompt (the 48-user window-2 run was prefill-bound), at the cost
+# of larger programs and coarser decode interleaving
+PREFILL_CHUNK = int(os.environ.get("PST_BENCH_PREFILL_CHUNK", "512"))
 # double-buffered decode dispatch (0 = synchronous fetch per round).
 # Default OFF: the round-5 hardware sweep measured sync-packed at 141.8
 # tok/s/chip vs async-packed 117.6 — chained decode keeps the device
@@ -360,7 +364,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         hbm_utilization=0.85,
         max_model_len=max(4096, 32 * (-(-(final_len + 64) // 32))),
         max_num_seqs=NUM_USERS,
-        max_prefill_chunk=512,
+        max_prefill_chunk=PREFILL_CHUNK,
         max_prefill_seqs=prefill_seqs,
         tensor_parallel_size=TP,
         num_scheduler_steps=sched_steps,
