@@ -234,6 +234,14 @@ class LLMEngine:
             # kill the whole engine (one malformed request = DoS)
             raise ValueError("prompt_token_ids must be integers")
         sp0 = sampling_params or SamplingParams()
+        if sp0.logit_bias:
+            vocab = self.runner.model_config.vocab_size
+            bad = [t for t in sp0.logit_bias if t >= vocab]
+            if bad:
+                raise ValueError(
+                    f"logit_bias token ids {bad[:5]} out of range for "
+                    f"vocab size {vocab}"
+                )
         if sp0.logprobs is not None:
             from production_stack_tpu.engine.sampler import LOGPROB_CAP
 
@@ -355,6 +363,8 @@ class LLMEngine:
             # the chained dispatch carries no DFA tables; guided lanes
             # resolve each round so their device states re-initialize
             return False
+        if any(s.sampling_params.logit_bias for s in seqs):
+            return False  # chained dispatch carries no bias arrays
         if set(id(s) for s in self.scheduler.running) != set(
             id(s) for s in seqs
         ):
@@ -443,8 +453,8 @@ class LLMEngine:
                 seqs: list[Sequence] = pend["seqs"]
                 k = pend["k"]
                 want_lp = pend.get("lps") is not None
-                temps, top_ps, top_ks, keys, _ = self._sampling_arrays(
-                    seqs
+                temps, top_ps, top_ks, min_ps, keys, _ = (
+                    self._sampling_arrays(seqs)
                 )
                 keys[:, 1] += k  # k sampled-but-unapplied tokens per lane
                 positions = [s.num_tokens - 1 + k for s in seqs]
@@ -452,7 +462,7 @@ class LLMEngine:
                 ys = self.runner.decode_multi(
                     pend["toks"][-1], positions,
                     [s.block_table for s in seqs], ctx_lens, k,
-                    temps, top_ps, top_ks, keys,
+                    temps, top_ps, top_ks, keys, min_ps=min_ps,
                     lora_slots=[self._lora_slot(s) for s in seqs],
                     want_logprobs=want_lp,
                 )
@@ -498,8 +508,10 @@ class LLMEngine:
                 if w.seq.metrics.first_scheduled_time is None:
                     w.seq.metrics.first_scheduled_time = now
             seqs_w = [w.seq for w in works]
-            temps, top_ps, top_ks, keys, _ = self._sampling_arrays(seqs_w)
-            sampling = (temps, top_ps, top_ks, keys)
+            temps, top_ps, top_ks, min_ps, keys, _ = (
+                self._sampling_arrays(seqs_w)
+            )
+            sampling = (temps, top_ps, top_ks, min_ps, keys)
             if len(works) == 1:
                 # single-sequence path keeps the round-2 compile buckets
                 w = works[0]
@@ -553,6 +565,8 @@ class LLMEngine:
                     sp = s.sampling_params
                     if self._is_guided(s):
                         return True  # first token must be masked
+                    if sp.logit_bias:
+                        return True  # on-device sample knows no bias
                     return bool(s.generated_token_ids) and (
                         sp.presence_penalty != 0.0
                         or sp.frequency_penalty != 0.0
@@ -616,7 +630,7 @@ class LLMEngine:
                 guided_tables = self._device_guided_tables(seqs)
             if k_steps > 1 and (not needs_guided
                                 or guided_tables is not None):
-                temps, top_ps, top_ks, keys, needs_pen = (
+                temps, top_ps, top_ks, min_ps, keys, needs_pen = (
                     self._sampling_arrays(seqs)
                 )
                 penalties = None
@@ -637,22 +651,24 @@ class LLMEngine:
                 want_lp = any(
                     s.sampling_params.logprobs is not None for s in seqs
                 )
+                bias = self._bias_arrays(seqs)
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
                 ys = self.runner.decode_multi(
                     tokens, positions, tables, ctx_lens, k_steps,
-                    temps, top_ps, top_ks, keys,
+                    temps, top_ps, top_ks, keys, min_ps=min_ps,
                     lora_slots=[self._lora_slot(s) for s in seqs],
                     penalties=penalties,
                     want_logprobs=want_lp,
                     guided=guided_tables,
+                    logit_bias=bias,
                 )  # (k, b) on device [+ logprob arrays]
                 toks_dev, lps_dev = (
                     (ys[0], ys[1:]) if want_lp else (ys, None)
                 )
                 if (self._async_decode and penalties is None
-                        and guided_tables is None):
+                        and guided_tables is None and bias is None):
                     # start the double-buffered pipeline: leave the
                     # tokens on device; the NEXT step dispatches the
                     # following round before fetching this one
@@ -730,14 +746,15 @@ class LLMEngine:
         only on (seed, generated_len), so acceptance-by-equality keeps
         outputs bit-identical to sequential decode at ANY temperature,
         not just greedy (parity asserted by tests/test_spec_decode.py).
-        Eligibility is whole-batch: lanes needing per-step host logits
-        (logprobs, guided masks, logit penalties) fall the batch back to
-        the normal path."""
+        Eligibility is whole-batch: lanes needing per-step logit edits
+        (logprobs, guided masks, logit penalties, logit_bias) fall the
+        batch back to the normal path."""
         for s in seqs:
             sp = s.sampling_params
             if (
                 sp.logprobs is not None
                 or self._is_guided(s)
+                or sp.logit_bias
                 or sp.presence_penalty != 0.0
                 or sp.frequency_penalty != 0.0
                 or sp.repetition_penalty != 1.0
@@ -770,7 +787,9 @@ class LLMEngine:
             [s.all_token_ids[-1]] + d
             for s, d in zip(seqs, drafts_by_lane)
         ]
-        temps, top_ps, top_ks, _keys, _pen = self._sampling_arrays(seqs)
+        temps, top_ps, top_ks, min_ps, _keys, _pen = (
+            self._sampling_arrays(seqs)
+        )
         seeds = np.asarray(
             [self._seq_seed(s) & 0xFFFFFFFF for s in seqs], np.uint32
         )
@@ -784,7 +803,7 @@ class LLMEngine:
             total_lens=[
                 s.num_tokens - 1 + len(c) for s, c in zip(seqs, chunks)
             ],
-            row_sampling=(temps, top_ps, top_ks, seeds, starts),
+            row_sampling=(temps, top_ps, top_ks, min_ps, seeds, starts),
             lora_slots=[self._lora_slot(s) for s in seqs],
         )
         stepped: list[Sequence] = []
@@ -828,7 +847,8 @@ class LLMEngine:
     # -- internals ---------------------------------------------------------
     def _sampling_arrays(
         self, seqs: list[Sequence], b: int | None = None
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, bool]:
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+               np.ndarray, bool]:
         """Per-lane sampling parameter arrays + whether any sequence
         needs logit penalties (multi-step then carries token counts on
         device; single-step applies them host-side in _apply_penalties).
@@ -839,6 +859,7 @@ class LLMEngine:
         temps = np.zeros((b,), np.float32)
         top_ps = np.ones((b,), np.float32)
         top_ks = np.full((b,), -1, np.int32)
+        min_ps = np.zeros((b,), np.float32)
         keys = np.zeros((b, 2), np.uint32)
         needs_penalties = False
         for i, s in enumerate(seqs):
@@ -846,6 +867,7 @@ class LLMEngine:
             temps[i] = sp.temperature
             top_ps[i] = sp.top_p
             top_ks[i] = sp.top_k
+            min_ps[i] = sp.min_p
             if (
                 sp.presence_penalty != 0.0
                 or sp.frequency_penalty != 0.0
@@ -856,7 +878,32 @@ class LLMEngine:
                 np.uint32(self._seq_seed(s) & 0xFFFFFFFF),
                 np.uint32(len(s.generated_token_ids)),
             )
-        return temps, top_ps, top_ks, keys, needs_penalties
+        return temps, top_ps, top_ks, min_ps, keys, needs_penalties
+
+    @staticmethod
+    def _bias_arrays(
+        seqs: list[Sequence],
+    ) -> tuple[np.ndarray, np.ndarray] | None:
+        """Per-lane OpenAI logit_bias as dense (b, cap) id/value arrays
+        for the fused decode scan, or None when no lane has a bias.
+        cap is the pow2 bucket of the largest bias map (>= 8) so the
+        program variant space stays tiny; padding rows add 0.0 to token
+        0 — a no-op."""
+        maxn = max(
+            len(s.sampling_params.logit_bias or {}) for s in seqs
+        )
+        if maxn == 0:
+            return None
+        cap = max(8, 1 << (maxn - 1).bit_length())
+        ids = np.zeros((len(seqs), cap), np.int32)
+        vals = np.zeros((len(seqs), cap), np.float32)
+        for i, sq in enumerate(seqs):
+            for j, (t, v) in enumerate(
+                (sq.sampling_params.logit_bias or {}).items()
+            ):
+                ids[i, j] = t
+                vals[i, j] = v
+        return ids, vals
 
     def _seq_seed(self, s: Sequence) -> int:
         sp = s.sampling_params
@@ -1067,13 +1114,21 @@ class LLMEngine:
     def _sample(self, seqs: list[Sequence], logits,
                 return_logits: bool = False):
         b = logits.shape[0]
-        temps, top_ps, top_ks, keys, needs_penalties = (
+        temps, top_ps, top_ks, min_ps, keys, needs_penalties = (
             self._sampling_arrays(seqs, b)
         )
         if needs_penalties:
             logits = self._apply_penalties(seqs, np.asarray(logits))
+        if any(s.sampling_params.logit_bias for s in seqs):
+            logits = np.array(logits, np.float32, copy=True)
+            vocab = logits.shape[-1]
+            for i, sq in enumerate(seqs):
+                for t, v in (sq.sampling_params.logit_bias or {}).items():
+                    if t < vocab:
+                        logits[i, t] += v
         logits = self._apply_guided_mask(seqs, logits)
-        out = sample_tokens(logits, temps, top_ps, top_ks, keys)
+        out = sample_tokens(logits, temps, top_ps, top_ks, keys,
+                            min_p=min_ps)
         sampled = np.asarray(out)[: len(seqs)]
         if return_logits:
             # the (penalized) logits the sample came from — what

@@ -430,7 +430,7 @@ class ModelRunner:
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, last_row, temps, top_ps,
-                 top_ks, keys, lora=None, lora_slots=None):
+                 top_ks, min_ps, keys, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -450,7 +450,7 @@ class ModelRunner:
             # remote-attached chips (the logits output stays available
             # for penalty/debug paths, unfetched)
             token = sample_tokens(logits[:1], temps, top_ps, top_ks,
-                                  keys)[0]
+                                  keys, min_p=min_ps)[0]
             return token, logits[0], kc, vc
 
         return jax.jit(step, donate_argnums=(1, 2),
@@ -474,8 +474,8 @@ class ModelRunner:
         attn = self._packed_attn_closure(s_pad, t_pad)
 
         def step(params, kc, vc, tokens, positions, write_slots, tables,
-                 q_starts, total_lens, temps, top_ps, top_ks, keys,
-                 lora=None, lora_slots=None):
+                 q_starts, total_lens, temps, top_ps, top_ks, min_ps,
+                 keys, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -490,7 +490,8 @@ class ModelRunner:
                 logits_rows=jnp.arange(s_pad * t_pad),
                 lora=lora, lora_slots=lora_slots,
             )
-            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys)
+            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys,
+                                    min_p=min_ps)
             return sampled, kc, vc
 
         return jax.jit(step, donate_argnums=(1, 2),
@@ -521,14 +522,17 @@ class ModelRunner:
         )
 
         # per-ROW sampling arrays, padded lane-major to (s_pad * t_pad,)
-        l_temps, l_top_ps, l_top_ks, l_seeds, l_starts = row_sampling
+        (l_temps, l_top_ps, l_top_ks, l_min_ps, l_seeds,
+         l_starts) = row_sampling
         temps = np.zeros((s_pad, t_pad), np.float32)
         top_ps = np.ones((s_pad, t_pad), np.float32)
         top_ks = np.full((s_pad, t_pad), -1, np.int32)
+        min_ps_g = np.zeros((s_pad, t_pad), np.float32)
         keys = np.zeros((s_pad, t_pad, 2), np.uint32)
         temps[:n] = np.asarray(l_temps, np.float32)[:, None]
         top_ps[:n] = np.asarray(l_top_ps, np.float32)[:, None]
         top_ks[:n] = np.asarray(l_top_ks, np.int32)[:, None]
+        min_ps_g[:n] = np.asarray(l_min_ps, np.float32)[:, None]
         keys[:n, :, 0] = np.asarray(l_seeds, np.uint32)[:, None]
         keys[:n, :, 1] = (
             np.asarray(l_starts, np.int64)[:, None]
@@ -559,6 +563,7 @@ class ModelRunner:
             jnp.asarray(temps.reshape(-1)),
             jnp.asarray(top_ps.reshape(-1)),
             jnp.asarray(top_ks.reshape(-1)),
+            jnp.asarray(min_ps_g.reshape(-1)),
             jnp.asarray(keys.reshape(-1, 2)),
             **lora_kw,
         )
@@ -718,7 +723,7 @@ class ModelRunner:
 
         def step(params, kc, vc, tokens, positions, write_slots, tables,
                  q_starts, total_lens, last_rows, temps, top_ps, top_ks,
-                 keys, lora=None, lora_slots=None):
+                 min_ps, keys, lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -735,7 +740,8 @@ class ModelRunner:
             )
             # on-device first-token sampling (see _build_prefill): the
             # host fetches (s_pad,) int32, not (s_pad, vocab) f32
-            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys)
+            sampled = sample_tokens(logits, temps, top_ps, top_ks, keys,
+                                    min_p=min_ps)
             return sampled, logits, kc, vc
 
         return jax.jit(step, donate_argnums=(1, 2),
@@ -819,6 +825,7 @@ class ModelRunner:
             ("temps", (b,)),
             ("top_ps", (b,)),
             ("top_ks", (b,)),
+            ("min_ps", (b,)),
             ("keys", (b, 2)),
             ("page_tables", (b, n_pages)),
         ]
@@ -840,7 +847,8 @@ class ModelRunner:
                             use_penalties: bool = False,
                             want_logprobs: bool = False,
                             chained: bool = False,
-                            guided_shapes: tuple | None = None):
+                            guided_shapes: tuple | None = None,
+                            bias_cap: int = 0):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -908,7 +916,8 @@ class ModelRunner:
         def step(params, kc, vc, packed, chained_tokens=None,
                  g_token_class=None, g_class_mask=None, g_class_trans=None,
                  gen_ids=None, presence=None, frequency=None,
-                 repetition=None, lora=None, lora_slots=None):
+                 repetition=None, lb_ids=None, lb_vals=None,
+                 lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             lane = jnp.arange(b)
             tokens = (
@@ -923,6 +932,9 @@ class ModelRunner:
                 _seg(packed, "top_ps"), jnp.float32
             )
             top_ks = _seg(packed, "top_ks")
+            min_ps = jax.lax.bitcast_convert_type(
+                _seg(packed, "min_ps"), jnp.float32
+            )
             base_keys = jax.lax.bitcast_convert_type(
                 _seg(packed, "keys"), jnp.uint32
             )
@@ -977,6 +989,14 @@ class ModelRunner:
                         logits, counts > 0, counts, presence, frequency,
                         repetition,
                     )
+                if bias_cap:
+                    # OpenAI logit_bias: per-lane sparse additive bias
+                    # (padding adds 0.0 to token 0 — a no-op), applied
+                    # after penalties and before any guided mask, same
+                    # order as the host path (_sample)
+                    logits = logits.at[
+                        lane[:, None], lb_ids
+                    ].add(lb_vals)
                 if guided_shapes is not None:
                     # constraint mask from the lane's DFA state (same
                     # penalties->mask->sample order as the host path)
@@ -986,7 +1006,8 @@ class ModelRunner:
                     )                                     # (b, V)
                     logits = jnp.where(allowed, logits, -jnp.inf)
                 keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
-                nxt = sample_tokens(logits, temps, top_ps, top_ks, keys)
+                nxt = sample_tokens(logits, temps, top_ps, top_ks, keys,
+                                    min_p=min_ps)
                 if guided_shapes is not None:
                     cls = jnp.take_along_axis(
                         lane_tc, nxt[:, None], axis=1
@@ -1057,20 +1078,23 @@ class ModelRunner:
     @staticmethod
     def _sampling_args(
         n: int, sampling=None
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+               np.ndarray]:
         """Pad per-sequence sampling params to n rows (greedy defaults)."""
         temps = np.zeros((n,), np.float32)
         top_ps = np.ones((n,), np.float32)
         top_ks = np.full((n,), -1, np.int32)
+        min_ps = np.zeros((n,), np.float32)
         keys = np.zeros((n, 2), np.uint32)
         if sampling is not None:
-            t, p, k, kd = sampling
+            t, p, k, mp, kd = sampling
             m = len(np.asarray(t).reshape(-1))
             temps[:m] = np.asarray(t, np.float32).reshape(-1)
             top_ps[:m] = np.asarray(p, np.float32).reshape(-1)
             top_ks[:m] = np.asarray(k, np.int32).reshape(-1)
+            min_ps[:m] = np.asarray(mp, np.float32).reshape(-1)
             keys[:m] = np.asarray(kd, np.uint32).reshape(m, 2)
-        return temps, top_ps, top_ks, keys
+        return temps, top_ps, top_ks, min_ps, keys
 
     def prefill(
         self,
@@ -1105,7 +1129,9 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": jnp.int32(lora_slot),
             }
-        temps, top_ps, top_ks, keys = self._sampling_args(1, sampling)
+        temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
+            1, sampling
+        )
         token, logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
@@ -1119,6 +1145,7 @@ class ModelRunner:
             jnp.asarray(temps),
             jnp.asarray(top_ps),
             jnp.asarray(top_ks),
+            jnp.asarray(min_ps),
             jnp.asarray(keys),
             **lora_kw,
         )
@@ -1161,7 +1188,9 @@ class ModelRunner:
             )
         fn = self._prefill_batch_fns[key]
         lora_kw = self._packed_lora_kwargs(lora_slots, n, s_pad, t_pad)
-        temps, top_ps, top_ks, keys = self._sampling_args(s_pad, sampling)
+        temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
+            s_pad, sampling
+        )
         sampled, logits, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
@@ -1176,6 +1205,7 @@ class ModelRunner:
             jnp.asarray(temps),
             jnp.asarray(top_ps),
             jnp.asarray(top_ks),
+            jnp.asarray(min_ps),
             jnp.asarray(keys),
             **lora_kw,
         )
@@ -1352,6 +1382,7 @@ class ModelRunner:
                     np.zeros((s,), np.float32),
                     np.ones((s,), np.float32),
                     np.full((s,), -1, np.int32),
+                    np.zeros((s,), np.float32),
                     np.zeros((s,), np.uint32),
                     np.zeros((s,), np.int64),
                 )
@@ -1449,10 +1480,13 @@ class ModelRunner:
         top_ps: np.ndarray,
         top_ks: np.ndarray,
         keys: np.ndarray,       # (b_actual, 2) uint32
+        min_ps: np.ndarray | None = None,  # (b_actual,) f32; None => off
         lora_slots: list[int] | None = None,
         penalties: tuple | None = None,
         want_logprobs: bool = False,
         guided: tuple | None = None,
+        logit_bias: tuple | None = None,  # ((b_actual, cap) i32 ids,
+                                          #  (b_actual, cap) f32 vals)
     ):
         """`steps` fused decode+sample iterations (one dispatch, one
         fetch); returns (steps, b) int32 sampled tokens on device — or,
@@ -1540,6 +1574,10 @@ class ModelRunner:
         k_full = np.full((b,), -1, np.int32)
         k_full[:b_actual] = top_ks
         put("top_ks", k_full)
+        m_full = np.zeros((b,), np.float32)
+        if min_ps is not None:
+            m_full[:b_actual] = min_ps
+        put("min_ps", m_full)
         key_full = np.zeros((b, 2), np.uint32)
         key_full[:b_actual] = keys
         put("keys", key_full)
@@ -1599,19 +1637,32 @@ class ModelRunner:
                 class_mask.shape[1],
             )
 
+        bias_cap = 0
+        bias_kw = {}
+        if logit_bias is not None:
+            lb_ids, lb_vals = logit_bias
+            bias_cap = int(np.asarray(lb_ids).shape[1])
+            ids_full = np.zeros((b, bias_cap), np.int32)
+            vals_full = np.zeros((b, bias_cap), np.float32)
+            ids_full[:b_actual] = lb_ids
+            vals_full[:b_actual] = lb_vals
+            bias_kw = {
+                "lb_ids": jnp.asarray(ids_full),
+                "lb_vals": jnp.asarray(vals_full),
+            }
         cache_key = (b, c_pad, steps, penalties is not None,
-                     want_logprobs, chained, guided_shapes)
+                     want_logprobs, chained, guided_shapes, bias_cap)
         if cache_key not in self._decode_multi_fns:
             logger.info(
                 "compiling multi-step decode b=%d ctx=%d k=%d pen=%s "
-                "lp=%s chained=%s guided=%s",
+                "lp=%s chained=%s guided=%s bias=%d",
                 b, c_pad, steps, penalties is not None, want_logprobs,
-                chained, guided_shapes,
+                chained, guided_shapes, bias_cap,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
                 want_logprobs=want_logprobs, chained=chained,
-                guided_shapes=guided_shapes,
+                guided_shapes=guided_shapes, bias_cap=bias_cap,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}
@@ -1632,6 +1683,7 @@ class ModelRunner:
             **chained_kw,
             **guided_kw,
             **pen_kw,
+            **bias_kw,
             **lora_kw,
         )
         return ys

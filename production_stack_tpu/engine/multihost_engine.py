@@ -91,11 +91,12 @@ class BroadcastingRunner:
     def _sampling_msg(sampling):
         if sampling is None:
             return None
-        temps, top_ps, top_ks, keys = sampling
+        temps, top_ps, top_ks, min_ps, keys = sampling
         return [
             np.asarray(temps, np.float32).tolist(),
             np.asarray(top_ps, np.float32).tolist(),
             np.asarray(top_ks, np.int32).tolist(),
+            np.asarray(min_ps, np.float32).tolist(),
             np.asarray(keys, np.uint32).tolist(),
         ]
 
@@ -152,8 +153,8 @@ class BroadcastingRunner:
 
     def decode_multi(self, token_ids, positions, block_tables,
                      context_lens, steps, temps, top_ps, top_ks, keys,
-                     lora_slots=None, penalties=None,
-                     want_logprobs=False, guided=None):
+                     min_ps=None, lora_slots=None, penalties=None,
+                     want_logprobs=False, guided=None, logit_bias=None):
         msg = {
             "kind": "decode_multi",
             "token_ids": [int(t) for t in token_ids],
@@ -164,6 +165,10 @@ class BroadcastingRunner:
             "temps": np.asarray(temps).tolist(),
             "top_ps": np.asarray(top_ps).tolist(),
             "top_ks": np.asarray(top_ks).tolist(),
+            "min_ps": (
+                np.asarray(min_ps, np.float32).tolist()
+                if min_ps is not None else None
+            ),
             "keys": np.asarray(keys, np.uint32).tolist(),
             # followers must compile the SAME program variant as host 0
             # (the logprobs scan has extra outputs) or SPMD desyncs
@@ -178,6 +183,11 @@ class BroadcastingRunner:
                 "pres": np.asarray(pres).tolist(),
                 "freq": np.asarray(freq).tolist(),
                 "rep": np.asarray(rep).tolist(),
+            }
+        if logit_bias is not None:
+            msg["logit_bias"] = {
+                "ids": np.asarray(logit_bias[0], np.int32).tolist(),
+                "vals": np.asarray(logit_bias[1], np.float32).tolist(),
             }
         if guided is not None:
             tok, init_states, lane_map, tc, cm, ct = guided
@@ -211,14 +221,15 @@ class BroadcastingRunner:
         self._bc.publish(msg)
         return self._runner.decode_multi(
             token_ids, positions, block_tables, context_lens, steps,
-            temps, top_ps, top_ks, keys, lora_slots=lora_slots,
-            penalties=penalties, want_logprobs=want_logprobs,
-            guided=guided,
+            temps, top_ps, top_ks, keys, min_ps=min_ps,
+            lora_slots=lora_slots, penalties=penalties,
+            want_logprobs=want_logprobs, guided=guided,
+            logit_bias=logit_bias,
         )
 
     def verify_batch(self, chunks, start_positions, block_tables,
                      total_lens, row_sampling, lora_slots=None):
-        temps, top_ps, top_ks, seeds, starts = row_sampling
+        temps, top_ps, top_ks, min_ps, seeds, starts = row_sampling
         msg = {
             "kind": "verify_batch",
             "chunks": [[int(t) for t in c] for c in chunks],
@@ -229,6 +240,7 @@ class BroadcastingRunner:
                 np.asarray(temps, np.float32).tolist(),
                 np.asarray(top_ps, np.float32).tolist(),
                 np.asarray(top_ks, np.int32).tolist(),
+                np.asarray(min_ps, np.float32).tolist(),
                 np.asarray(seeds, np.uint32).tolist(),
                 np.asarray(starts, np.int64).tolist(),
             ],
@@ -309,7 +321,15 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
             for arr in ("temps", "top_ps", "top_ks"):
                 msg[arr] = np.asarray(msg[arr], np.float32
                                       if arr != "top_ks" else np.int32)
+            if msg.get("min_ps") is not None:
+                msg["min_ps"] = np.asarray(msg["min_ps"], np.float32)
             msg["keys"] = np.asarray(msg["keys"], np.uint32)
+            lb = msg.pop("logit_bias", None)
+            if lb is not None:
+                msg["logit_bias"] = (
+                    np.asarray(lb["ids"], np.int32),
+                    np.asarray(lb["vals"], np.float32),
+                )
             pen = msg.pop("penalties", None)
             if pen is not None:
                 msg["penalties"] = (
@@ -360,8 +380,9 @@ def follower_loop(runner, timeout_s: float = 600.0) -> None:
                 np.asarray(rs[0], np.float32),
                 np.asarray(rs[1], np.float32),
                 np.asarray(rs[2], np.int32),
-                np.asarray(rs[3], np.uint32),
-                np.asarray(rs[4], np.int64),
+                np.asarray(rs[3], np.float32),
+                np.asarray(rs[4], np.uint32),
+                np.asarray(rs[5], np.int64),
             )
             runner.verify_batch(**msg)
         elif kind == "embed":

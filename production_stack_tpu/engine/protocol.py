@@ -97,6 +97,8 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
             top_k=int(body.get("top_k", -1)),
+            min_p=float(body.get("min_p", 0.0)),
+            logit_bias=body.get("logit_bias"),
             n=int(body.get("n", 1)),
             stop=stop,
             stop_token_ids=list(body.get("stop_token_ids", [])),

@@ -29,6 +29,7 @@ def sample_tokens(
     top_p: jax.Array,  # (b,) float32 in (0, 1]
     top_k: jax.Array,  # (b,) int32; <=0 => disabled
     key_data: jax.Array,  # (b, 2) uint32 per-row PRNG key data
+    min_p: jax.Array | None = None,  # (b,) float32 in [0, 1]; 0 => off
     top_cap: int = TOP_CAP,
 ) -> jax.Array:
     """Sample one token per row. Returns (b,) int32."""
@@ -50,6 +51,11 @@ def sample_tokens(
     keep_p = (cum - probs) < top_p[:, None]
 
     keep = keep_k & keep_p
+    if min_p is not None:
+        # min-p (vLLM min_p role): drop candidates whose post-temperature
+        # probability is below min_p * max_prob. Row 0 of the descending
+        # top-k IS the max-prob candidate.
+        keep = keep & (probs >= min_p[:, None] * probs[:, 0:1])
     keep = keep.at[:, 0].set(True)  # never mask the argmax candidate
     masked = jnp.where(keep, scaled, -jnp.inf)
 

@@ -11,6 +11,13 @@ class SamplingParams:
     temperature: float = 1.0
     top_p: float = 1.0
     top_k: int = -1  # -1 = disabled
+    # vLLM min_p role: drop candidates whose post-temperature probability
+    # is below min_p * max_prob (0 = disabled)
+    min_p: float = 0.0
+    # OpenAI logit_bias role: token id -> additive bias in [-100, 100],
+    # applied to the logits before sampling (after penalties, before any
+    # guided-constraint mask)
+    logit_bias: dict[int, float] | None = None
     n: int = 1
     stop: list[str] = field(default_factory=list)
     stop_token_ids: list[int] = field(default_factory=list)
@@ -56,6 +63,26 @@ class SamplingParams:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k == 0 or self.top_k < -1:
             raise ValueError("top_k must be -1 (disabled) or >= 1")
+        if not 0.0 <= self.min_p <= 1.0:
+            raise ValueError("min_p must be in [0, 1]")
+        if self.logit_bias is not None:
+            try:
+                self.logit_bias = {
+                    int(t): float(v) for t, v in self.logit_bias.items()
+                }
+            except (TypeError, ValueError, AttributeError):
+                raise ValueError(
+                    "logit_bias must map token ids to numbers"
+                ) from None
+            for t, v in self.logit_bias.items():
+                if t < 0:
+                    raise ValueError("logit_bias token ids must be >= 0")
+                if not -100.0 <= v <= 100.0:
+                    raise ValueError(
+                        "logit_bias values must be in [-100, 100]"
+                    )
+            if not self.logit_bias:
+                self.logit_bias = None
         if isinstance(self.stop, str):
             self.stop = [self.stop]
 

@@ -149,3 +149,90 @@ def test_stats_snapshot():
     assert s1.prompt_tokens_total == 5
     assert s1.requests_finished_total == 1
     assert s1.kv_usage == 0.0  # everything freed
+
+
+def test_min_p_engine_paths_agree():
+    """min_p (vLLM min_p role) rides every sampling path: host
+    single-step, fused K-step, and on-device first-token prefill
+    sampling must produce identical streams for the same seed; and
+    min_p=1.0 at temperature>0 must equal greedy."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def eng(k):
+        return LLMEngine(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=8, num_kv_blocks=64,
+            max_num_seqs=2, max_prefill_chunk=32,
+            num_scheduler_steps=k, seed=0,
+        ))
+
+    prompt = list(range(1, 20))
+    sp = SamplingParams(max_tokens=12, temperature=0.7, min_p=0.3,
+                        seed=7, ignore_eos=True)
+    outs = [
+        eng(k).generate([prompt], sp)[0].token_ids for k in (1, 4)
+    ]
+    assert outs[0] == outs[1]  # host path == fused K-step path
+
+    sp_hi = SamplingParams(max_tokens=12, temperature=0.9, min_p=1.0,
+                           seed=3, ignore_eos=True)
+    sp_greedy = SamplingParams(max_tokens=12, temperature=0.0,
+                               ignore_eos=True)
+    hi = eng(1).generate([prompt], sp_hi)[0].token_ids
+    greedy = eng(1).generate([prompt], sp_greedy)[0].token_ids
+    assert hi == greedy
+
+    with __import__("pytest").raises(ValueError):
+        SamplingParams(min_p=1.5)
+
+
+def test_logit_bias_engine_paths_agree():
+    """OpenAI logit_bias: applied on the host single-step path AND
+    inside the fused K-step device scan (a program variant keyed by the
+    pow2 bias cap) — identical streams, and the bias actually steers:
+    +100 on a token makes greedy pick it; a -100 ban removes it."""
+    import pytest
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def eng(k):
+        return LLMEngine(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=8, num_kv_blocks=64,
+            max_num_seqs=2, max_prefill_chunk=32,
+            num_scheduler_steps=k, seed=0,
+        ))
+
+    prompt = list(range(1, 20))
+    # force token 77 at every step
+    sp_force = SamplingParams(max_tokens=6, temperature=0.0,
+                              logit_bias={77: 100.0}, ignore_eos=True)
+    outs = [eng(k).generate([prompt], sp_force)[0].token_ids
+            for k in (1, 4)]
+    assert outs[0] == outs[1] == [77] * 6
+
+    # ban the greedy choice: the stream changes and never contains it
+    base = eng(1).generate(
+        [prompt], SamplingParams(max_tokens=6, temperature=0.0,
+                                 ignore_eos=True),
+    )[0].token_ids
+    banned = base[0]
+    sp_ban = SamplingParams(max_tokens=6, temperature=0.0,
+                            logit_bias={banned: -100.0}, ignore_eos=True)
+    outs_ban = [eng(k).generate([prompt], sp_ban)[0].token_ids
+                for k in (1, 4)]
+    assert outs_ban[0] == outs_ban[1]
+    assert banned not in outs_ban[0]
+
+    # admission-time validation
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias={5: 200.0})
+    e = eng(1)
+    with pytest.raises(ValueError, match="out of range"):
+        e.add_request("bad", prompt_token_ids=[1, 2],
+                      sampling_params=SamplingParams(
+                          logit_bias={10 ** 6: 1.0}))

@@ -113,6 +113,7 @@ def test_broadcast_carries_verify_batch():
         np.asarray([0.0, 0.9], np.float32),
         np.ones(2, np.float32),
         np.full(2, -1, np.int32),
+        np.asarray([0.0, 0.05], np.float32),  # min_p rides the wire too
         np.asarray([7, 11], np.uint32),
         np.asarray([3, 5], np.int64),
     )
@@ -123,15 +124,16 @@ def test_broadcast_carries_verify_batch():
     msg = bc.published[0]
     assert msg["kind"] == "verify_batch"
     assert msg["chunks"] == [[1, 2, 3], [4, 5]]
-    assert msg["row_sampling"][3] == [7, 11]
+    assert msg["row_sampling"][4] == [7, 11]
     assert msg["lora_slots"] == [0, 1]
 
     follower = _RecordingRunner()
     _drain_follower(bc, follower)
     kind, kw = follower.calls[0]
     assert kind == "verify_batch"
-    assert kw["row_sampling"][3].dtype == np.uint32
-    assert kw["row_sampling"][4].dtype == np.int64
+    assert kw["row_sampling"][3].dtype == np.float32
+    assert kw["row_sampling"][4].dtype == np.uint32
+    assert kw["row_sampling"][5].dtype == np.int64
     assert kw["chunks"] == [[1, 2, 3], [4, 5]]
 
 

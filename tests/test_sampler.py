@@ -78,3 +78,50 @@ def test_mixed_greedy_and_sampled_rows():
     )
     assert out[0] == logits[0].argmax()
     assert out[2] == logits[2].argmax()
+
+
+def run_minp(logits, temp, min_p, key=(0, 0)):
+    b = logits.shape[0]
+    return np.asarray(
+        sample_tokens(
+            logits.astype(np.float32),
+            np.full((b,), temp, np.float32),
+            np.ones((b,), np.float32),
+            np.full((b,), -1, np.int32),
+            np.tile(np.asarray(key, np.uint32), (b, 1)),
+            min_p=np.full((b,), min_p, np.float32),
+        )
+    )
+
+
+def test_min_p_one_is_argmax():
+    """min_p=1.0 keeps only candidates at max_prob -> argmax for any
+    temperature (vLLM min_p semantics: threshold = min_p * max_prob)."""
+    rng = np.random.RandomState(3)
+    logits = rng.randn(4, 1000) * 3
+    for key in [(0, i) for i in range(8)]:
+        out = run_minp(logits, temp=1.0, min_p=1.0, key=key)
+        assert (out == logits.argmax(-1)).all()
+
+
+def test_min_p_zero_matches_disabled():
+    """min_p=0 must be bit-identical to not passing min_p at all."""
+    rng = np.random.RandomState(4)
+    logits = rng.randn(4, 1000)
+    for key in [(5, i) for i in range(8)]:
+        a = run(logits, temp=0.8, key=key)
+        b = run_minp(logits, temp=0.8, min_p=0.0, key=key)
+        assert (a == b).all()
+
+
+def test_min_p_filters_tail():
+    """With one dominant token and a high min_p, samples never come
+    from the tail."""
+    logits = np.full((2, 100), 0.0, np.float32)
+    logits[:, 7] = 6.0  # dominant
+    logits[:, 8] = 5.0  # survives min_p=0.2 (prob ratio e^-1 ~ 0.37)
+    seen = set()
+    for i in range(32):
+        out = run_minp(logits, temp=1.0, min_p=0.2, key=(9, i))
+        seen.update(out.tolist())
+    assert seen <= {7, 8}, seen
