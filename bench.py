@@ -74,6 +74,9 @@ PREFILL_CHUNK = int(os.environ.get("PST_BENCH_PREFILL_CHUNK", "512"))
 # busy and delays prefill admission (p50 TTFT 0.78s -> 2.94s), costing
 # more than the fetch overlap buys at K=8
 ASYNC_DECODE = os.environ.get("PST_BENCH_ASYNC", "0") == "1"
+# speculative h2d prefetch (engine prefetch_decode): stage the next
+# fused round's packed inputs during the current round's fetch
+PREFETCH = os.environ.get("PST_BENCH_PREFETCH", "1") == "1"
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -369,6 +372,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         tensor_parallel_size=TP,
         num_scheduler_steps=sched_steps,
         async_decode=async_decode,
+        prefetch_decode=PREFETCH,
         seed=0,
     )
     engine = LLMEngine(config)
@@ -601,6 +605,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "num_scheduler_steps": sched_steps,
             "prefill_seqs": prefill_seqs,
             "async_decode": async_decode,
+            "prefetch_decode": PREFETCH,
             "config_label": label,
             "rounds": ROUNDS,
             "decode_tokens_per_s_aggregate": round(decode_tps, 1),
@@ -614,6 +619,10 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                 float(np.percentile(resume, 50)), 3
             ) if len(resume) else -1,
             "preemptions": engine.stats().num_preemptions_total,
+            # h2d-prefetch effectiveness: hits dispatched on a staged
+            # buffer (no serial upload); misses staged but invalidated
+            "staged_hits": engine._staged_hits_total,
+            "staged_misses": engine._staged_misses_total,
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)
             else -1,

@@ -57,10 +57,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "in one forward (greedy batch-1 decode; 0=off)")
     p.add_argument("--ngram-prompt-lookup-max", type=int, default=3)
     p.add_argument("--ngram-prompt-lookup-min", type=int, default=1)
-    p.add_argument("--async-decode", action="store_true", default=True,
+    p.add_argument("--async-decode", action="store_true", default=False,
                    help="double-buffered decode: dispatch round N+1 on "
-                        "round N's on-device tokens before fetching it")
+                        "round N's on-device tokens before fetching it "
+                        "(measured slower than the default synchronous "
+                        "path with --prefetch-decode at K=8; see PERF.md)")
     p.add_argument("--no-async-decode", dest="async_decode",
+                   action="store_false")
+    p.add_argument("--prefetch-decode", action="store_true", default=True,
+                   help="speculative h2d prefetch: upload the next fused "
+                        "round's inputs while the current one executes")
+    p.add_argument("--no-prefetch-decode", dest="prefetch_decode",
                    action="store_false")
     p.add_argument("--precompile-serving", action="store_true",
                    default=False,
@@ -146,6 +153,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         num_scheduler_steps=args.num_scheduler_steps,
         async_decode=args.async_decode,
         precompile_serving=args.precompile_serving,
+        prefetch_decode=args.prefetch_decode,
         num_speculative_tokens=args.num_speculative_tokens,
         ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,

@@ -51,8 +51,21 @@ class EngineConfig:
     # logit penalties, lane-set changes, or lanes within K tokens of
     # finishing fall back to the synchronous path (outputs stay
     # bit-identical). Ignored under multihost (followers replay host
-    # token lists).
-    async_decode: bool = True
+    # token lists). Default OFF: the round-5 hardware sweep measured
+    # sync-packed above async-packed at K=8 (chained rounds delay
+    # prefill admission), and async taking precedence would make
+    # prefetch_decode below dead code — h2d prefetch gets the overlap
+    # benefit at synchronous admission instead.
+    async_decode: bool = False
+    # speculative h2d prefetch: while a fused decode round executes,
+    # upload the NEXT round's packed host inputs (positions/ctx/keys
+    # advanced by K on the same lanes) and dispatch it chained on the
+    # on-device sampled tokens when the prediction holds. Removes the
+    # serial host->device transfer (~116 ms through a tunneled chip)
+    # from the steady-state round critical path with fully synchronous
+    # admission (unlike async_decode, at most ONE round is in flight).
+    # Requires num_scheduler_steps > 1; single-device; off multihost.
+    prefetch_decode: bool = True
     # compile every steady-state serving program shape at startup
     # (full-chunk + resume-tail prefill, packed groups, fused-K decode,
     # per ctx bucket) so no XLA compile lands inside a live request's

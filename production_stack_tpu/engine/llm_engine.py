@@ -88,6 +88,21 @@ class LLMEngine:
             and config.num_scheduler_steps > 1
             and not config.multihost
         )
+        # speculative h2d prefetch (stage_decode_multi): upload the NEXT
+        # fused round's packed host inputs while the current round is
+        # still executing, then dispatch it chained on the on-device
+        # tokens — the ~116 ms serial h2d leaves the round's critical
+        # path while admission behavior stays fully synchronous (one
+        # round in flight, unlike async_decode). Multihost is out: the
+        # broadcast wire ships host token lists, not device arrays.
+        self._prefetch_decode = (
+            config.prefetch_decode
+            and config.num_scheduler_steps > 1
+            and not config.multihost
+        )
+        self._staged_decode: dict | None = None
+        self._staged_hits_total = 0
+        self._staged_misses_total = 0
         # speculative decoding works under multihost too: verify_batch
         # is part of the broadcast protocol (multihost_engine.py), so
         # followers replay the same packed verify host 0 dispatches
@@ -369,6 +384,18 @@ class LLMEngine:
             id(s) for s in seqs
         ):
             return False  # lane set changed (new prefill-done seq, ...)
+        return self._reserve_next_round(seqs, k)
+
+    def _reserve_next_round(self, seqs: list[Sequence], k: int) -> bool:
+        """Shared bounds + block reservation for dispatching a SECOND
+        fused round before the first one's tokens are applied (async
+        chaining AND h2d-prefetch staging): every lane at least 2K
+        tokens from its max_tokens/max_model_len bounds, and tables
+        grown to cover both rounds. All-or-nothing growth: allocate
+        only after EVERY lane passed its checks, so a late refusal
+        never leaves earlier lanes holding speculatively grown block
+        tables (advisor r3: the predicate must not have partial side
+        effects)."""
         bs = self.block_manager.block_size
         grow = 0
         for s in seqs:
@@ -378,14 +405,10 @@ class LLMEngine:
                 return False  # final rounds run synchronously
             if s.num_tokens + 2 * k >= self.scheduler.config.max_model_len:
                 return False
-            # blocks needed to cover this round + the chained one
+            # blocks needed to cover this round + the next one
             need = (s.num_tokens + 2 * k + bs - 1) // bs - len(s.block_table)
             if need > 0:
                 grow += need
-        # all-or-nothing growth: allocate only after EVERY lane passed its
-        # checks, so a late refusal never leaves earlier lanes holding
-        # speculatively grown block tables (advisor r3: the predicate must
-        # not have partial side effects)
         if grow > self.block_manager.num_free_blocks:
             return False  # needs preemption: go through schedule()
         for s in seqs:
@@ -394,6 +417,38 @@ class LLMEngine:
             )
             assert ok  # guaranteed by the free-block precheck above
         return True
+
+    def _can_stage(self, seqs: list[Sequence], k: int) -> bool:
+        """True when the NEXT fused round on these same lanes can be
+        speculatively staged (h2d prefetch): single device, no waiting
+        admission work, no guided lanes, every lane at least 2K tokens
+        from its bounds, and block tables growable to cover this round
+        plus the staged one (same all-or-nothing rule as _can_chain)."""
+        if self.runner.mesh is not None:
+            return False  # the staged put is a committed single-device
+            # transfer; under a mesh jit would have to reshard it
+        if self.scheduler.waiting:
+            return False  # admission will change the lane set
+        if any(self._is_guided(s) for s in seqs):
+            return False  # per-round DFA state re-init (see _can_chain)
+        return self._reserve_next_round(seqs, k)
+
+    @staticmethod
+    def _stage_fingerprint(
+        seqs: list[Sequence], k: int, future: bool = False
+    ) -> tuple:
+        """State the staged buffer was built for, as observed at the
+        NEXT dispatch: same lanes in the same order, every lane exactly
+        K tokens further, block tables untouched since the stage's
+        growth. `future=True` computes the prediction at stage time
+        (before the in-flight round's tokens are applied)."""
+        d = k if future else 0
+        return (
+            tuple(s.request_id for s in seqs),
+            tuple(s.num_tokens + d for s in seqs),
+            tuple(len(s.block_table) for s in seqs),
+            k,
+        )
 
     def _resolve_pending(self) -> list[RequestOutput]:
         """Fetch the in-flight round's tokens and apply them (identical
@@ -652,6 +707,22 @@ class LLMEngine:
                     s.sampling_params.logprobs is not None for s in seqs
                 )
                 bias = self._bias_arrays(seqs)
+                staged_kw = {}
+                st = self._staged_decode
+                self._staged_decode = None
+                if st is not None:
+                    if (penalties is None and bias is None
+                            and guided_tables is None
+                            and st["fp"] == self._stage_fingerprint(
+                                seqs, k_steps)):
+                        # the prediction held: dispatch chained on the
+                        # previous round's on-device tokens with the
+                        # pre-uploaded packed buffer — zero serial h2d
+                        staged_kw = {"staged": st["handle"]}
+                        tokens = st["chain_tokens"]
+                        self._staged_hits_total += 1
+                    else:
+                        self._staged_misses_total += 1
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
@@ -663,6 +734,7 @@ class LLMEngine:
                     want_logprobs=want_lp,
                     guided=guided_tables,
                     logit_bias=bias,
+                    **staged_kw,
                 )  # (k, b) on device [+ logprob arrays]
                 toks_dev, lps_dev = (
                     (ys[0], ys[1:]) if want_lp else (ys, None)
@@ -677,6 +749,26 @@ class LLMEngine:
                         "lps": lps_dev,
                     }
                     return outputs
+                if (self._prefetch_decode and penalties is None
+                        and guided_tables is None and bias is None
+                        and self._can_stage(seqs, k_steps)):
+                    # upload round N+1's predicted inputs NOW — the
+                    # transfer rides out the fetch below; validated by
+                    # fingerprint before the next dispatch uses it
+                    nk = keys.copy()
+                    nk[:, 1] += k_steps
+                    self._staged_decode = {
+                        "fp": self._stage_fingerprint(
+                            seqs, k_steps, future=True),
+                        "handle": self.runner.stage_decode_multi(
+                            [s.num_tokens - 1 + k_steps for s in seqs],
+                            [s.block_table for s in seqs],
+                            [s.num_tokens + k_steps for s in seqs],
+                            k_steps, temps, top_ps, top_ks, nk,
+                            min_ps=min_ps,
+                        ),
+                        "chain_tokens": toks_dev[-1],
+                    }
                 self._apply_multi_tokens(
                     seqs, np.asarray(toks_dev), k_steps,
                     lps=tuple(np.asarray(a) for a in lps_dev)

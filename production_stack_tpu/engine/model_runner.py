@@ -1469,65 +1469,26 @@ class ModelRunner:
         )
         return logits
 
-    def decode_multi(
+    def _fill_decode_pack(
         self,
-        token_ids: list[int],
-        positions: list[int],
-        block_tables: list[list[int]],
-        context_lens: list[int],
-        steps: int,
-        temps: np.ndarray,      # (b_actual,) float32
-        top_ps: np.ndarray,
-        top_ks: np.ndarray,
-        keys: np.ndarray,       # (b_actual, 2) uint32
-        min_ps: np.ndarray | None = None,  # (b_actual,) f32; None => off
-        lora_slots: list[int] | None = None,
-        penalties: tuple | None = None,
-        want_logprobs: bool = False,
-        guided: tuple | None = None,
-        logit_bias: tuple | None = None,  # ((b_actual, cap) i32 ids,
-                                          #  (b_actual, cap) f32 vals)
-    ):
-        """`steps` fused decode+sample iterations (one dispatch, one
-        fetch); returns (steps, b) int32 sampled tokens on device — or,
-        with `want_logprobs`, a tuple (tokens, chosen_lp (k, b) f32,
-        top_vals (k, b, CAP) f32, top_ids (k, b, CAP) i32). The
-        caller must have grown each block table to cover
-        context_len + steps - 1 positions (scheduler lookahead).
-
-        `penalties`: optional (gen_ids_list, presence, frequency,
-        repetition) — generated-token history per lane (list of int
-        lists) + (b_actual,) penalty arrays; token counts are then
-        maintained on device through the scan (sampler.apply_penalties
-        semantics, bit-identical to the host single-step path).
-
-        `token_ids` may be a full-lane (b,) DEVICE array instead of a
-        host list: the async-decode pipeline chains round N+1 directly on
-        round N's on-device sampled tokens, so no host fetch sits between
-        dispatches.
-
-        `guided`: optional (cache_token, init_states (b,), lane_map (b,),
-        token_class (M, V), class_mask (S, C), class_trans (S, C)) —
-        TokenDFA tables (engine/structured.py) evaluated INSIDE the
-        fused scan so constrained lanes keep the K-step fetch
-        amortization. The three big tables are uploaded once per
-        `cache_token` and reused across dispatches."""
-        if steps > self.block_size:
-            raise ValueError(
-                f"num_scheduler_steps={steps} > block_size="
-                f"{self.block_size}: idle lanes would overrun the trash "
-                "block"
-            )
+        c_pad: int,
+        chained: bool,
+        token_ids,
+        positions,
+        block_tables,
+        context_lens,
+        temps, top_ps, top_ks, keys,
+        min_ps=None,
+        guided_lanes: tuple | None = None,
+    ) -> np.ndarray:
+        """Build the ONE packed int32 host buffer a fused decode
+        dispatch ships (layout: _decode_pack_layout). Shared by the
+        dispatch path (decode_multi) and the speculative prefetch path
+        (stage_decode_multi)."""
         b = self.config.max_num_seqs
-        chained = isinstance(token_ids, jax.Array)
-        b_actual = len(positions) if chained else len(token_ids)
-        c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
-
-        # ONE packed i32 host->device buffer per dispatch (layout shared
-        # with the jitted unpack, _decode_pack_layout): through the
-        # tunneled chip each separate buffer creation pays link latency
+        b_actual = len(positions)
         layout, total = self._decode_pack_layout(
-            b, c_pad, chained, guided=guided is not None
+            b, c_pad, chained, guided=guided_lanes is not None
         )
         packed = np.zeros((total,), np.int32)
 
@@ -1581,6 +1542,112 @@ class ModelRunner:
         key_full = np.zeros((b, 2), np.uint32)
         key_full[:b_actual] = keys
         put("keys", key_full)
+        if guided_lanes is not None:
+            init_states, lane_map = guided_lanes
+            g_state = np.zeros((b,), np.int32)
+            g_state[:b_actual] = init_states[:b_actual]
+            put("g_state", g_state)
+            g_lane = np.zeros((b,), np.int32)
+            g_lane[:b_actual] = lane_map[:b_actual]
+            put("g_lane", g_lane)
+        return packed
+
+    def stage_decode_multi(
+        self, positions, block_tables, context_lens, steps,
+        temps, top_ps, top_ks, keys, min_ps=None,
+    ):
+        """Speculative h2d prefetch for the NEXT chained fused round:
+        build the packed buffer and START its async host->device
+        transfer now, so the upload overlaps the in-flight round's
+        execution and token fetch instead of sitting serially between
+        them (measured ~116 ms per h2d vs ~300 ms total round time
+        through the tunneled chip). The engine stages with PREDICTED
+        state (positions/ctx/keys advanced by K on the same lanes) and
+        validates the prediction before dispatching on it; a stale
+        stage (ctx-bucket mismatch) is ignored by decode_multi.
+        Returns (c_pad, device_array) for decode_multi(staged=...)."""
+        c_pad = self._ctx_bucket(max(context_lens) + max(0, steps - 1))
+        packed = self._fill_decode_pack(
+            c_pad, True, None, positions, block_tables, context_lens,
+            temps, top_ps, top_ks, keys, min_ps=min_ps,
+        )
+        return (c_pad, jax.device_put(packed))
+
+    def decode_multi(
+        self,
+        token_ids: list[int],
+        positions: list[int],
+        block_tables: list[list[int]],
+        context_lens: list[int],
+        steps: int,
+        temps: np.ndarray,      # (b_actual,) float32
+        top_ps: np.ndarray,
+        top_ks: np.ndarray,
+        keys: np.ndarray,       # (b_actual, 2) uint32
+        min_ps: np.ndarray | None = None,  # (b_actual,) f32; None => off
+        lora_slots: list[int] | None = None,
+        penalties: tuple | None = None,
+        want_logprobs: bool = False,
+        guided: tuple | None = None,
+        logit_bias: tuple | None = None,  # ((b_actual, cap) i32 ids,
+                                          #  (b_actual, cap) f32 vals)
+        staged: tuple | None = None,  # pre-uploaded (c_pad, packed_dev)
+                                      # from stage_decode_multi
+    ):
+        """`steps` fused decode+sample iterations (one dispatch, one
+        fetch); returns (steps, b) int32 sampled tokens on device — or,
+        with `want_logprobs`, a tuple (tokens, chosen_lp (k, b) f32,
+        top_vals (k, b, CAP) f32, top_ids (k, b, CAP) i32). The
+        caller must have grown each block table to cover
+        context_len + steps - 1 positions (scheduler lookahead).
+
+        `penalties`: optional (gen_ids_list, presence, frequency,
+        repetition) — generated-token history per lane (list of int
+        lists) + (b_actual,) penalty arrays; token counts are then
+        maintained on device through the scan (sampler.apply_penalties
+        semantics, bit-identical to the host single-step path).
+
+        `token_ids` may be a full-lane (b,) DEVICE array instead of a
+        host list: the async-decode pipeline chains round N+1 directly on
+        round N's on-device sampled tokens, so no host fetch sits between
+        dispatches.
+
+        `guided`: optional (cache_token, init_states (b,), lane_map (b,),
+        token_class (M, V), class_mask (S, C), class_trans (S, C)) —
+        TokenDFA tables (engine/structured.py) evaluated INSIDE the
+        fused scan so constrained lanes keep the K-step fetch
+        amortization. The three big tables are uploaded once per
+        `cache_token` and reused across dispatches."""
+        if steps > self.block_size:
+            raise ValueError(
+                f"num_scheduler_steps={steps} > block_size="
+                f"{self.block_size}: idle lanes would overrun the trash "
+                "block"
+            )
+        b = self.config.max_num_seqs
+        chained = isinstance(token_ids, jax.Array)
+        b_actual = len(positions) if chained else len(token_ids)
+        c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
+
+        # ONE packed i32 host->device buffer per dispatch (layout shared
+        # with the jitted unpack, _decode_pack_layout): through the
+        # tunneled chip each separate buffer creation pays link latency.
+        # A valid speculative stage (stage_decode_multi) skips the build
+        # AND the serial upload entirely — its transfer overlapped the
+        # previous round.
+        guided_lanes = None
+        if guided is not None:
+            guided_lanes = (guided[1], guided[2])
+        packed_dev = None
+        if (staged is not None and chained and guided is None
+                and staged[0] == c_pad):
+            packed_dev = staged[1]
+        if packed_dev is None:
+            packed_dev = jnp.asarray(self._fill_decode_pack(
+                c_pad, chained, token_ids, positions, block_tables,
+                context_lens, temps, top_ps, top_ks, keys,
+                min_ps=min_ps, guided_lanes=guided_lanes,
+            ))
 
         pen_kw = {}
         if penalties is not None:
@@ -1608,14 +1675,9 @@ class ModelRunner:
         guided_kw = {}
         guided_shapes = None
         if guided is not None:
+            # per-lane g_state/g_lane were packed by _fill_decode_pack
             (g_token, init_states, lane_map, token_class, class_mask,
              class_trans) = guided
-            g_state = np.zeros((b,), np.int32)
-            g_state[:b_actual] = init_states[:b_actual]
-            put("g_state", g_state)
-            g_lane = np.zeros((b,), np.int32)
-            g_lane[:b_actual] = lane_map[:b_actual]
-            put("g_lane", g_lane)
             # device-cache the big tables across dispatches: they change
             # only when the set of live constraints changes
             cached = getattr(self, "_guided_dev", None)
@@ -1679,7 +1741,7 @@ class ModelRunner:
             self.params,
             self.k_cache,
             self.v_cache,
-            jnp.asarray(packed),
+            packed_dev,
             **chained_kw,
             **guided_kw,
             **pen_kw,

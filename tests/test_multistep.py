@@ -131,3 +131,76 @@ def test_streaming_deltas_cover_all_tokens():
             break
     assert ids == final.token_ids
     assert "".join(deltas) == final.text
+
+
+def test_prefetch_decode_parity_and_hits():
+    """Speculative h2d prefetch (stage_decode_multi): streams must be
+    bit-identical with prefetch on vs off, and in a steady fused run
+    the staged buffer must actually get consumed (hits > 0)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def eng(prefetch):
+        return LLMEngine(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=8, num_kv_blocks=128,
+            max_num_seqs=4, max_prefill_chunk=32,
+            num_scheduler_steps=4, async_decode=False,
+            prefetch_decode=prefetch, seed=0,
+        ))
+
+    rng = __import__("numpy").random.RandomState(5)
+    prompts = [rng.randint(0, 384, size=n).tolist() for n in (9, 17, 30)]
+    sps = [
+        SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True),
+        SamplingParams(max_tokens=24, temperature=0.8, seed=3,
+                       ignore_eos=True),
+        SamplingParams(max_tokens=24, temperature=0.8, top_p=0.9,
+                       min_p=0.05, seed=9, ignore_eos=True),
+    ]
+    e_on = eng(True)
+    out_on = [o.token_ids for o in e_on.generate(prompts, sps)]
+    e_off = eng(False)
+    out_off = [o.token_ids for o in e_off.generate(prompts, sps)]
+    assert out_on == out_off
+    assert e_on._staged_hits_total > 0
+    assert e_off._staged_hits_total == 0
+
+
+def test_prefetch_survives_mid_stream_admission():
+    """A new arrival between rounds invalidates the staged prediction
+    (lane set changes) — the engine must fall back cleanly and stay
+    bit-identical to the unprefetched engine."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def eng(prefetch):
+        return LLMEngine(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=8, num_kv_blocks=128,
+            max_num_seqs=4, max_prefill_chunk=32,
+            num_scheduler_steps=4, async_decode=False,
+            prefetch_decode=prefetch, seed=0,
+        ))
+
+    sp = SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
+
+    def run(e):
+        outs = {}
+        e.add_request("a", prompt_token_ids=list(range(1, 12)),
+                      sampling_params=sp)
+        steps = 0
+        while e.has_unfinished() or steps == 0:
+            for o in e.step():
+                if o.finished:
+                    outs[o.request_id] = o.token_ids
+            steps += 1
+            if steps == 3:  # mid-decode admission breaks the lane set
+                e.add_request("b", prompt_token_ids=list(range(30, 45)),
+                              sampling_params=sp)
+        return outs
+
+    a, b = run(eng(True)), run(eng(False))
+    assert a == b and set(a) == {"a", "b"}
