@@ -61,6 +61,8 @@ class BlockManager:
         self.enable_prefix_caching = enable_prefix_caching
 
         self.blocks = [Block(i) for i in range(num_blocks)]
+        # bumped on every free(): see the note there
+        self.free_epoch = 0
         # block 0 reserved as null/trash
         self.free_blocks: list[int] = list(range(num_blocks - 1, 0, -1))
         # hash -> block_id for cached full blocks (ref>=0)
@@ -246,6 +248,12 @@ class BlockManager:
 
     def free(self, block_table: list[int]) -> None:
         """Release a sequence's references; cached blocks become evictable."""
+        # table-identity epoch: freed block ids may be handed to another
+        # sequence, so anything caching a snapshot of LIVE page tables
+        # (the staged h2d prefetch, llm_engine._stage_fingerprint) must
+        # observe a bump and rebuild — a same-length re-allocated table
+        # is indistinguishable by shape alone
+        self.free_epoch += 1
         freed_cached: list[tuple[int, int]] = []
         for bid in block_table:
             blk = self.blocks[bid]

@@ -433,20 +433,23 @@ class LLMEngine:
             return False  # per-round DFA state re-init (see _can_chain)
         return self._reserve_next_round(seqs, k)
 
-    @staticmethod
     def _stage_fingerprint(
-        seqs: list[Sequence], k: int, future: bool = False
+        self, seqs: list[Sequence], k: int, future: bool = False
     ) -> tuple:
         """State the staged buffer was built for, as observed at the
         NEXT dispatch: same lanes in the same order, every lane exactly
         K tokens further, block tables untouched since the stage's
-        growth. `future=True` computes the prediction at stage time
-        (before the in-flight round's tokens are applied)."""
+        growth, and NO free() anywhere in between (the free epoch) —
+        freed block ids can be re-handed to another sequence, making a
+        same-length table reference someone else's KV. `future=True`
+        computes the prediction at stage time (before the in-flight
+        round's tokens are applied)."""
         d = k if future else 0
         return (
             tuple(s.request_id for s in seqs),
             tuple(s.num_tokens + d for s in seqs),
             tuple(len(s.block_table) for s in seqs),
+            self.block_manager.free_epoch,
             k,
         )
 
@@ -537,6 +540,11 @@ class LLMEngine:
 
     def _step_scheduled(self) -> list[RequestOutput]:
         sched_out = self.scheduler.schedule()
+        if sched_out.preempted or sched_out.prefills or sched_out.aborted:
+            # any table free/reassignment or lane-set change invalidates
+            # the staged prefetch (the epoch in the fingerprint already
+            # guarantees this; dropping early frees the device buffer)
+            self._staged_decode = None
         self._preemptions_total += len(sched_out.preempted)
         self.last_step_kind = (
             "prefill"
@@ -1600,7 +1608,8 @@ class LLMEngine:
         k = cfg.num_scheduler_steps
         n += rnr.precompile_decode(
             [max(1, c - k + 1) for c in ctxs], k,
-            chained=self._async_decode,
+            # BOTH overlap features dispatch the chained program variant
+            chained=self._async_decode or self._prefetch_decode,
         )
         if cfg.num_speculative_tokens > 0:
             n += rnr.precompile_verify(

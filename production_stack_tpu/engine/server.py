@@ -224,6 +224,35 @@ class EngineServer:
             sp = proto.sampling_params_from_request(body)
         except proto.ProtocolError as e:
             return web.json_response(proto.error_json(str(e)), status=400)
+        if body.get("suffix"):
+            # vLLM-parity: fill-in-the-middle is a model capability the
+            # decoder-only serving path does not provide
+            return web.json_response(
+                proto.error_json("suffix is not supported"), status=400
+            )
+        best_of = body.get("best_of")
+        try:
+            best_of = int(best_of) if best_of is not None else None
+        except (TypeError, ValueError):
+            return web.json_response(
+                proto.error_json("best_of must be an integer"), status=400
+            )
+        if best_of is not None and best_of != sp.n:
+            return web.json_response(
+                proto.error_json(
+                    "best_of != n is not supported (use n-way sampling)"
+                ),
+                status=400,
+            )
+        echo = bool(body.get("echo", False))
+        if echo and sp.logprobs is not None:
+            return web.json_response(
+                proto.error_json(
+                    "echo with logprobs needs prompt logprobs, which "
+                    "are not supported"
+                ),
+                status=400,
+            )
 
         request_id = proto.make_id("cmpl")
         prompt_ids_list: list[list[int]] = []
@@ -237,6 +266,15 @@ class EngineServer:
             prompt_ids_list.append(ids)
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
+        # OpenAI echo: the response text leads with the prompt (string
+        # prompts echo verbatim; token-id prompts echo their decoding)
+        echo_prefixes = None
+        if echo:
+            echo_prefixes = [
+                p if isinstance(p, str)
+                else self.engine.tokenizer.decode(list(p))
+                for p in raw_prompts
+            ]
 
         if len(prompt_ids_list) * sp.n > 1:
             return await self._multi_completion(
@@ -244,16 +282,19 @@ class EngineServer:
                 chat=False, model=body.get("model") or self.model_name,
                 stream=bool(body.get("stream")),
                 include_usage=self._wants_usage(body),
+                echo_prefixes=echo_prefixes,
             )
         kwargs = {"prompt_token_ids": prompt_ids_list[0]}
         if body.get("stream"):
             return await self._stream_completion(
                 request, request_id, sp, kwargs, lora_name, chat=False,
                 include_usage=self._wants_usage(body),
+                echo_prefix=echo_prefixes[0] if echo_prefixes else None,
             )
         return await self._blocking_completion(
             request_id, sp, kwargs, lora_name, chat=False,
             model=body.get("model") or self.model_name,
+            echo_prefix=echo_prefixes[0] if echo_prefixes else None,
         )
 
     # -- chat --------------------------------------------------------------
@@ -442,7 +483,7 @@ class EngineServer:
     async def _blocking_completion(
         self, request_id: str, sp: SamplingParams, kwargs: dict,
         lora_name: str | None, chat: bool, model: str,
-        parse_tools: bool = False,
+        parse_tools: bool = False, echo_prefix: str | None = None,
     ) -> web.Response:
         arrival = time.time()
         final = None
@@ -475,7 +516,8 @@ class EngineServer:
             )
             return web.json_response(resp)
         resp = proto.completion_response(
-            request_id, model, final.text, final.finish_reason,
+            request_id, model,
+            (echo_prefix or "") + final.text, final.finish_reason,
             len(final.prompt_token_ids), len(final.token_ids),
         )
         resp["choices"][0]["logprobs"] = self._fmt_completion_logprobs(
@@ -488,6 +530,7 @@ class EngineServer:
         prompt_ids_list: list[list[int]], lora_name: str | None,
         chat: bool, model: str, stream: bool,
         include_usage: bool = False, parse_tools: bool = False,
+        echo_prefixes: list[str] | None = None,
     ) -> web.StreamResponse:
         """Batch prompts and/or n>1 sampling: fan the choices out as
         engine sub-requests (continuous batching coalesces them on
@@ -566,8 +609,11 @@ class EngineServer:
                     )
                     choices.append(choice)
                 else:
+                    pfx = (
+                        echo_prefixes[idx // n] if echo_prefixes else ""
+                    )
                     choices.append({
-                        "index": idx, "text": final.text,
+                        "index": idx, "text": pfx + final.text,
                         "logprobs": self._fmt_completion_logprobs(
                             final.logprobs
                         ),
@@ -594,6 +640,14 @@ class EngineServer:
             await resp.write(
                 b"data: " + json.dumps(data).encode() + b"\n\n"
             )
+
+        if echo_prefixes and not chat:
+            # OpenAI echo: each choice's stream leads with its prompt
+            for idx, _, _ in plan:
+                await send(proto.completion_chunk(
+                    request_id, model, echo_prefixes[idx // n], None,
+                    index=idx,
+                ))
 
         queue: asyncio.Queue = asyncio.Queue()
 
@@ -673,7 +727,7 @@ class EngineServer:
     async def _stream_completion(
         self, request: web.Request, request_id: str, sp: SamplingParams,
         kwargs: dict, lora_name: str | None, chat: bool,
-        include_usage: bool = False,
+        include_usage: bool = False, echo_prefix: str | None = None,
     ) -> web.StreamResponse:
         arrival = time.time()
         model = self.model_name
@@ -693,6 +747,11 @@ class EngineServer:
             )
 
         try:
+            if echo_prefix and not chat:
+                # OpenAI echo streams the prompt text as the first chunk
+                await send(proto.completion_chunk(
+                    request_id, model, echo_prefix, None
+                ))
             if chat:
                 await send(
                     proto.chat_chunk(

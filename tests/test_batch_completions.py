@@ -174,3 +174,77 @@ def test_malformed_token_ids_rejected_not_fatal():
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_echo_suffix_best_of():
+    """OpenAI completions params: echo prepends the prompt text
+    (blocking, streaming, and batch paths), suffix and best_of != n
+    are rejected with 400, echo+logprobs is rejected (prompt logprobs
+    unsupported)."""
+    async def scenario():
+        client = TestClient(TestServer(make_server().app))
+        await client.start_server()
+        try:
+            # blocking echo, string prompt
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": "hi there", "max_tokens": 4,
+                "temperature": 0, "echo": True,
+            })
+            assert status == 200
+            text = data["choices"][0]["text"]
+            assert text.startswith("hi there") and len(text) > len(
+                "hi there")
+
+            # token-id prompt echoes its decoding (byte tokenizer)
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": [104, 105], "max_tokens": 2,
+                "temperature": 0, "echo": True,
+            })
+            assert status == 200
+            assert data["choices"][0]["text"].startswith("hi")
+
+            # batch echo: every choice leads with ITS prompt
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": ["aaa", "bbb"], "max_tokens": 2,
+                "temperature": 0, "echo": True,
+            })
+            assert status == 200
+            by_idx = {c["index"]: c["text"] for c in data["choices"]}
+            assert by_idx[0].startswith("aaa")
+            assert by_idx[1].startswith("bbb")
+
+            # streaming echo: first data chunk carries the prompt
+            r = await client.post("/v1/completions", json={
+                "prompt": "xyz", "max_tokens": 2, "temperature": 0,
+                "echo": True, "stream": True,
+            })
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            first = json.loads(
+                raw.split("data: ")[1].split("\n")[0]
+            )
+            assert first["choices"][0]["text"] == "xyz"
+
+            # rejections
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": "x", "suffix": "tail", "max_tokens": 2,
+            })
+            assert status == 400 and "suffix" in str(data)
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": "x", "best_of": 3, "n": 1, "max_tokens": 2,
+            })
+            assert status == 400 and "best_of" in str(data)
+            status, _ = await _post(client, "/v1/completions", {
+                "prompt": "x", "best_of": 2, "n": 2, "max_tokens": 2,
+                "temperature": 0.5,
+            })
+            assert status == 200  # best_of == n is the supported case
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": "x", "echo": True, "logprobs": 1,
+                "max_tokens": 2,
+            })
+            assert status == 400 and "echo" in str(data)
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

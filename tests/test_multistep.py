@@ -204,3 +204,37 @@ def test_prefetch_survives_mid_stream_admission():
 
     a, b = run(eng(True)), run(eng(False))
     assert a == b and set(a) == {"a", "b"}
+
+
+def test_stage_invalidated_by_block_free_epoch():
+    """Any block free() between stage and consume must invalidate the
+    staged buffer (code-review r5: freed block ids can be re-handed to
+    another sequence, so a same-length table could silently reference
+    someone else's KV). The epoch rides the fingerprint."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    eng = LLMEngine(EngineConfig(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=128,
+        max_num_seqs=2, max_prefill_chunk=32,
+        num_scheduler_steps=4, async_decode=False,
+        prefetch_decode=True, seed=0,
+    ))
+    sp = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    eng.add_request("a", prompt_token_ids=list(range(1, 12)),
+                    sampling_params=sp)
+    outs = []
+    while eng.has_unfinished():
+        before = eng._staged_decode is not None
+        if before:
+            # simulate a concurrent table free (abort/preempt of some
+            # other sequence) between rounds
+            eng.block_manager.free_epoch += 1
+        for o in eng.step():
+            if o.finished:
+                outs.append(o.token_ids)
+    assert eng._staged_misses_total > 0
+    assert eng._staged_hits_total == 0  # every stage was invalidated
+    assert len(outs) == 1 and len(outs[0]) == 24
