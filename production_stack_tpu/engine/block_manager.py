@@ -153,17 +153,24 @@ class BlockManager:
         return matched, len(matched) * self.block_size
 
     def allocate_prompt(
-        self, token_ids: list[int], seed: int = 0
+        self, token_ids: list[int], seed: int = 0,
+        reuse_cache: bool = True,
     ) -> tuple[list[int], int] | None:
         """Allocate the block table for a prompt, reusing cached prefix blocks.
 
         Returns (block_table, num_cached_tokens) or None if out of blocks.
         num_cached_tokens is capped at len(token_ids)-1 so at least one token
         is computed (we need its logits to start decoding).
-        """
+
+        `reuse_cache=False` skips prefix matching (the computed blocks
+        still REGISTER afterwards): prompt_logprobs needs every position
+        actually computed — a cache hit would skip its rows."""
         n = len(token_ids)
         self.prefix_queries += n
-        matched, cached_tokens = self.match_prefix(token_ids, seed)
+        if not reuse_cache:
+            matched, cached_tokens = [], 0
+        else:
+            matched, cached_tokens = self.match_prefix(token_ids, seed)
         cached_tokens = min(cached_tokens, n - 1)
         num_matched_blocks = cached_tokens // self.block_size
         matched = matched[:num_matched_blocks]

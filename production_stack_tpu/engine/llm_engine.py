@@ -249,6 +249,13 @@ class LLMEngine:
             # kill the whole engine (one malformed request = DoS)
             raise ValueError("prompt_token_ids must be integers")
         sp0 = sampling_params or SamplingParams()
+        if sp0.prompt_logprobs is not None:
+            from production_stack_tpu.engine.sampler import LOGPROB_CAP
+
+            if sp0.prompt_logprobs > LOGPROB_CAP:
+                raise ValueError(
+                    f"prompt_logprobs > {LOGPROB_CAP} unsupported"
+                )
         if sp0.logit_bias:
             vocab = self.runner.model_config.vocab_size
             bad = [t for t in sp0.logit_bias if t >= vocab]
@@ -570,47 +577,96 @@ class LLMEngine:
             for w in works:
                 if w.seq.metrics.first_scheduled_time is None:
                     w.seq.metrics.first_scheduled_time = now
-            seqs_w = [w.seq for w in works]
-            temps, top_ps, top_ks, min_ps, keys, _ = (
-                self._sampling_arrays(seqs_w)
-            )
-            sampling = (temps, top_ps, top_ks, min_ps, keys)
-            if len(works) == 1:
-                # single-sequence path keeps the round-2 compile buckets
-                w = works[0]
+            # prompt_logprobs requests take the single-sequence program
+            # variant (every row's distribution scored on device); they
+            # never pack — their per-row outputs are per-sequence
+            plp_works = [
+                (i, w) for i, w in enumerate(works)
+                if w.seq.sampling_params.prompt_logprobs is not None
+            ]
+            std_works = [
+                (i, w) for i, w in enumerate(works)
+                if w.seq.sampling_params.prompt_logprobs is None
+            ]
+            last_logits: dict[int, object] = {}
+            tok_of: dict[int, int] = {}  # original idx -> sampled token
+            for i, w in plp_works:
                 seq = w.seq
                 chunk = seq.prompt_token_ids[
                     w.chunk_start : w.chunk_start + w.chunk_len
                 ]
-                token_dev, logits = self.runner.prefill(
+                # row j scores the NEXT prompt token; the final chunk's
+                # last row has none (its continuation is generated)
+                tgts = seq.prompt_token_ids[
+                    w.chunk_start + 1 : w.chunk_start + w.chunk_len + 1
+                ]
+                t1, p1, k1, m1, keys1, _ = self._sampling_arrays([seq])
+                token_dev, logits, chosen, tv, ti = self.runner.prefill(
                     chunk,
                     start_pos=w.chunk_start,
                     block_table=seq.block_table,
                     total_len=w.chunk_start + w.chunk_len,
                     lora_slot=self._lora_slot(seq),
-                    sampling=sampling,
+                    sampling=(t1, p1, k1, m1, keys1),
+                    prompt_lp_targets=[int(x) for x in tgts],
                 )
-                tokens_dev = token_dev[None]
-                last_logits = {0: logits}
-            else:
-                # packed cross-sequence prefill: one dispatch covers
-                # every scheduled chunk (burst-TTFT fix)
-                tokens_dev, logits = self.runner.prefill_batch(
-                    [
-                        w.seq.prompt_token_ids[
-                            w.chunk_start : w.chunk_start + w.chunk_len
-                        ]
-                        for w in works
-                    ],
-                    start_positions=[w.chunk_start for w in works],
-                    block_tables=[w.seq.block_table for w in works],
-                    total_lens=[
-                        w.chunk_start + w.chunk_len for w in works
-                    ],
-                    lora_slots=[self._lora_slot(w.seq) for w in works],
-                    sampling=sampling,
+                tok_of[i] = int(np.asarray(token_dev))
+                last_logits[i] = logits
+                self._accumulate_prompt_lps(
+                    seq, w.chunk_start, tgts,
+                    np.asarray(chosen), np.asarray(tv), np.asarray(ti),
                 )
-                last_logits = {i: logits[i] for i in range(len(works))}
+            if std_works:
+                sworks = [w for _, w in std_works]
+                seqs_w = [w.seq for w in sworks]
+                temps, top_ps, top_ks, min_ps, keys, _ = (
+                    self._sampling_arrays(seqs_w)
+                )
+                sampling = (temps, top_ps, top_ks, min_ps, keys)
+                if len(sworks) == 1:
+                    # single-sequence path keeps the round-2 buckets
+                    w = sworks[0]
+                    seq = w.seq
+                    chunk = seq.prompt_token_ids[
+                        w.chunk_start : w.chunk_start + w.chunk_len
+                    ]
+                    token_dev, logits = self.runner.prefill(
+                        chunk,
+                        start_pos=w.chunk_start,
+                        block_table=seq.block_table,
+                        total_len=w.chunk_start + w.chunk_len,
+                        lora_slot=self._lora_slot(seq),
+                        sampling=sampling,
+                    )
+                    tokens_dev = token_dev[None]
+                    last_logits[std_works[0][0]] = logits
+                else:
+                    # packed cross-sequence prefill: one dispatch covers
+                    # every scheduled chunk (burst-TTFT fix)
+                    tokens_dev, logits = self.runner.prefill_batch(
+                        [
+                            w.seq.prompt_token_ids[
+                                w.chunk_start : w.chunk_start + w.chunk_len
+                            ]
+                            for w in sworks
+                        ],
+                        start_positions=[w.chunk_start for w in sworks],
+                        block_tables=[w.seq.block_table for w in sworks],
+                        total_lens=[
+                            w.chunk_start + w.chunk_len for w in sworks
+                        ],
+                        lora_slots=[
+                            self._lora_slot(w.seq) for w in sworks
+                        ],
+                        sampling=sampling,
+                    )
+                    for j, (i, _) in enumerate(std_works):
+                        last_logits[i] = logits[j]
+                # ONE fetch for the whole std group's sampled tokens
+                if any(w.is_last_chunk for w in sworks):
+                    toks_np = np.asarray(tokens_dev)
+                    for j, (i, _) in enumerate(std_works):
+                        tok_of[i] = int(toks_np[j])
             for i, w in enumerate(works):
                 w.seq.num_computed_tokens += w.chunk_len
                 self._prompt_tokens_total += w.chunk_len
@@ -641,16 +697,15 @@ class LLMEngine:
                 clean = [(i, w) for i, w in finals
                          if not _needs_host_sample(w.seq)]
                 if clean:
-                    toks = np.asarray(tokens_dev)
                     for i, w in clean:
                         entry = None
                         n = w.seq.sampling_params.logprobs
                         if n is not None:
                             entry = self._host_logprob_entry(
                                 np.asarray(last_logits[i]),
-                                int(toks[i]), n,
+                                tok_of[i], n,
                             )
-                        self._append_token(w.seq, int(toks[i]), entry)
+                        self._append_token(w.seq, tok_of[i], entry)
                         stepped.append(w.seq)
                 if pen:
                     fl = jnp.stack([last_logits[i] for i, _ in pen])
@@ -1238,6 +1293,38 @@ class LLMEngine:
         return sampled
 
     @staticmethod
+    def _accumulate_prompt_lps(
+        seq: Sequence, chunk_start: int, tgts: list[int],
+        chosen: np.ndarray, tv: np.ndarray, ti: np.ndarray,
+    ) -> None:
+        """Collect this chunk's per-position prompt logprobs (device
+        arrays already fetched). Capped at the ORIGINAL prompt length:
+        preemption-by-recomputation folds generated tokens into the
+        prompt, and re-prefilling must not extend the prompt logprobs
+        past the real prompt."""
+        n = seq.sampling_params.prompt_logprobs
+        entries = getattr(seq, "_prompt_lp_entries", None)
+        if entries is None:
+            entries = []
+            seq._prompt_lp_entries = entries  # type: ignore[attr-defined]
+        limit = seq.orig_prompt_len - 1
+        for j, t in enumerate(tgts):
+            pos = chunk_start + 1 + j  # prompt position this row scores
+            if pos > limit:
+                break  # folded-in generated tokens are NOT prompt
+            if pos - 1 < len(entries):
+                continue  # recompute replays earlier chunks
+            entries.append({
+                "token_id": int(t),
+                "logprob": float(chosen[j]),
+                "top_logprobs": [
+                    {"token_id": int(ti[j, m]),
+                     "logprob": float(tv[j, m])}
+                    for m in range(n)
+                ],
+            })
+
+    @staticmethod
     def _host_logprob_entry(
         logits_row: np.ndarray, token: int, n: int
     ) -> dict:
@@ -1423,6 +1510,11 @@ class LLMEngine:
             # copying it per streamed step would be O(T^2) per request
             if seq.finished:
                 lp_all = list(getattr(seq, "_logprob_entries", []))
+        plp = None
+        if seq.sampling_params.prompt_logprobs is not None and seq.finished:
+            # vLLM shape: one entry per prompt position, None first
+            # (no context scores position 0)
+            plp = [None] + list(getattr(seq, "_prompt_lp_entries", []))
         return RequestOutput(
             request_id=seq.request_id,
             prompt_token_ids=seq.prompt_token_ids[: seq.orig_prompt_len],
@@ -1436,6 +1528,7 @@ class LLMEngine:
             num_cached_tokens=seq.metrics.num_cached_prompt_tokens,
             logprobs=lp_all,
             new_logprobs=lp_new,
+            prompt_logprobs=plp,
         )
 
     # -- LoRA hot-load (adapters applied in the jitted steps; engine/lora.py)

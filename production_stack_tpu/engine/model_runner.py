@@ -422,15 +422,20 @@ class ModelRunner:
             gather_slots = self._gather_slots_for_table(block_table, c_pad)
         return tokens, positions_dev, write_slots, gather_slots, t_pad, c_pad
 
-    def _build_prefill(self, t_pad: int, c_pad: int):
+    def _build_prefill(self, t_pad: int, c_pad: int,
+                       want_prompt_lp: bool = False):
         mc = self.model_config
-        from production_stack_tpu.engine.sampler import sample_tokens
+        from production_stack_tpu.engine.sampler import (
+            sample_tokens,
+            token_logprobs,
+        )
 
         attn = self._prefill_attn_closure()
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  gather_slots, total_len, last_row, temps, top_ps,
-                 top_ks, min_ps, keys, lora=None, lora_slots=None):
+                 top_ks, min_ps, keys, targets=None,
+                 lora=None, lora_slots=None):
             kc, vc = self._pin_cache_layout(kc, vc)
             attn_fn = functools.partial(
                 attn,
@@ -441,20 +446,41 @@ class ModelRunner:
             logits, kc, vc = self._forward(
                 mc, params, tokens, positions, kc, vc, write_slots,
                 lambda q, l, k, v: attn_fn(q, l, k, v),
-                logits_rows=last_row[None],
+                # prompt-logprobs needs every row's distribution; the
+                # normal path materializes only the LAST row (the first
+                # generated token's) to keep the program output small
+                logits_rows=(
+                    jnp.arange(t_pad) if want_prompt_lp
+                    else last_row[None]
+                ),
                 lora=lora, lora_slots=lora_slots,
             )
+            last_logits = logits[last_row] if want_prompt_lp else logits[0]
             # sample the first generated token ON DEVICE: the host then
             # fetches 4 bytes instead of a (vocab,) f32 row — the logit
             # fetch was the dominant per-prompt TTFT cost through
             # remote-attached chips (the logits output stays available
             # for penalty/debug paths, unfetched)
-            token = sample_tokens(logits[:1], temps, top_ps, top_ks,
-                                  keys, min_p=min_ps)[0]
-            return token, logits[0], kc, vc
+            token = sample_tokens(last_logits[None], temps, top_ps,
+                                  top_ks, keys, min_p=min_ps)[0]
+            if not want_prompt_lp:
+                return token, last_logits, kc, vc
+            # vLLM prompt_logprobs role, computed ON DEVICE: row i's
+            # distribution scores prompt token i+1 (`targets`, -1 =
+            # masked padding row). The host fetches (t_pad,) chosen +
+            # (t_pad, CAP) alternatives — never (t_pad, vocab) rows.
+            # Same extraction as generation logprobs (sampler.
+            # token_logprobs), so the two stay semantics-identical.
+            chosen, top_vals, top_ids = token_logprobs(
+                logits, jnp.maximum(targets, 0)
+            )
+            chosen = jnp.where(targets >= 0, chosen, 0.0)
+            return (token, last_logits, chosen, top_vals, top_ids,
+                    kc, vc)
 
         return jax.jit(step, donate_argnums=(1, 2),
-                       **self._step_jit_kwargs(2))
+                       **self._step_jit_kwargs(2 if not want_prompt_lp
+                                               else 5))
 
     def _build_verify_batch(self, s_pad: int, t_pad: int, c_pad: int):
         """Batched speculative verification: s_pad lanes' draft chunks
@@ -1104,22 +1130,33 @@ class ModelRunner:
         total_len: int,
         lora_slot: int = 0,
         sampling=None,
-    ) -> tuple[jax.Array, jax.Array]:
+        prompt_lp_targets: list[int] | None = None,
+    ) -> tuple:
         """Run one prefill chunk; returns (token, logits) ON DEVICE where
         `token` is the first generated token sampled from the chunk's last
         *actual* row with `sampling` = (temps, top_ps, top_ks, keys)
         (greedy/zero-key defaults), and `logits` is that row's fp32
         (vocab,) for penalty/debug paths. K/V for the chunk is written
-        into the cache."""
+        into the cache.
+
+        `prompt_lp_targets` (vLLM prompt_logprobs role): per-row NEXT
+        prompt token ids (-1 = no target); selects a program variant
+        that additionally returns (chosen (t_pad,) f32, top_vals
+        (t_pad, CAP) f32, top_ids (t_pad, CAP) i32) device arrays —
+        row i scores targets[i] under the model's distribution."""
         t = len(token_ids)
         (tokens, positions_dev, write_slots, gather_slots,
          t_pad, c_pad) = self._prefill_host_prep(
             token_ids, block_table, start_pos, total_len
         )
-        key = (t_pad, c_pad)
+        want_plp = prompt_lp_targets is not None
+        key = (t_pad, c_pad, "plp") if want_plp else (t_pad, c_pad)
         if key not in self._prefill_fns:
-            logger.info("compiling prefill step t=%d ctx=%d", t_pad, c_pad)
-            self._prefill_fns[key] = self._build_prefill(t_pad, c_pad)
+            logger.info("compiling prefill step t=%d ctx=%d plp=%s",
+                        t_pad, c_pad, want_plp)
+            self._prefill_fns[key] = self._build_prefill(
+                t_pad, c_pad, want_prompt_lp=want_plp
+            )
         fn = self._prefill_fns[key]
         lora_kw = {}
         if self.lora_manager is not None:
@@ -1132,7 +1169,12 @@ class ModelRunner:
         temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
             1, sampling
         )
-        token, logits, self.k_cache, self.v_cache = fn(
+        plp_kw = {}
+        if want_plp:
+            tg = np.full((t_pad,), -1, np.int32)
+            tg[: len(prompt_lp_targets)] = prompt_lp_targets
+            plp_kw = {"targets": jnp.asarray(tg)}
+        ys = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -1147,9 +1189,11 @@ class ModelRunner:
             jnp.asarray(top_ks),
             jnp.asarray(min_ps),
             jnp.asarray(keys),
+            **plp_kw,
             **lora_kw,
         )
-        return token, logits
+        self.k_cache, self.v_cache = ys[-2], ys[-1]
+        return ys[:-2]
 
     def prefill_batch(
         self,

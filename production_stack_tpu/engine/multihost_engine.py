@@ -101,7 +101,7 @@ class BroadcastingRunner:
         ]
 
     def prefill(self, token_ids, start_pos, block_table, total_len,
-                lora_slot=0, sampling=None):
+                lora_slot=0, sampling=None, prompt_lp_targets=None):
         self._bc.publish({
             "kind": "prefill",
             "token_ids": [int(t) for t in token_ids],
@@ -110,10 +110,17 @@ class BroadcastingRunner:
             "total_len": int(total_len),
             "lora_slot": int(lora_slot),
             "sampling": self._sampling_msg(sampling),
+            # followers must select the SAME program variant (the plp
+            # prefill materializes every row) or SPMD desyncs
+            "prompt_lp_targets": (
+                [int(t) for t in prompt_lp_targets]
+                if prompt_lp_targets is not None else None
+            ),
         })
         return self._runner.prefill(
             token_ids, start_pos, block_table, total_len,
             lora_slot=lora_slot, sampling=sampling,
+            prompt_lp_targets=prompt_lp_targets,
         )
 
     def prefill_batch(self, chunks, start_positions, block_tables,

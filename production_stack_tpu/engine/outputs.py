@@ -23,6 +23,9 @@ class RequestOutput:
     # {"token_id", "logprob", "top_logprobs": [{"token_id", "logprob"}]}
     logprobs: list[dict] | None = None  # all tokens so far
     new_logprobs: list[dict] | None = None  # this step (streaming)
+    # vLLM prompt_logprobs role: one entry per prompt position (None
+    # first), populated on the FINAL output only
+    prompt_logprobs: list[dict | None] | None = None
 
 
 @dataclass

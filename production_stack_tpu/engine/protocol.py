@@ -40,6 +40,11 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
             logprobs = int(logprobs)
             if not 0 <= logprobs <= 20:
                 raise ProtocolError("logprobs must be in [0, 20]")
+        prompt_logprobs = body.get("prompt_logprobs")
+        if prompt_logprobs is not None:
+            prompt_logprobs = int(prompt_logprobs)
+            if not 0 <= prompt_logprobs <= 20:
+                raise ProtocolError("prompt_logprobs must be in [0, 20]")
         guided_choice = body.get("guided_choice")
         if guided_choice is not None and (
             not isinstance(guided_choice, list)
@@ -89,6 +94,7 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
                         )
         return SamplingParams(
             logprobs=logprobs,
+            prompt_logprobs=prompt_logprobs,
             guided_choice=guided_choice,
             guided_json=guided_json,
             guided_regex=guided_regex,

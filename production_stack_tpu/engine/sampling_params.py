@@ -27,6 +27,11 @@ class SamplingParams:
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
     logprobs: int | None = None
+    # vLLM prompt_logprobs role: per-prompt-token logprob of the token
+    # given its preceding context, plus top-N alternatives (position 0
+    # has no context -> None). Disables prefix-cache reuse for the
+    # request (cached positions would otherwise skip computation).
+    prompt_logprobs: int | None = None
     min_tokens: int = 0
     # structured output (vLLM guided_choice role): the generation must
     # be exactly one of these strings — logits are masked to the tokens
@@ -65,6 +70,10 @@ class SamplingParams:
             raise ValueError("top_k must be -1 (disabled) or >= 1")
         if not 0.0 <= self.min_p <= 1.0:
             raise ValueError("min_p must be in [0, 1]")
+        if self.prompt_logprobs is not None and not (
+            0 <= self.prompt_logprobs <= 20
+        ):
+            raise ValueError("prompt_logprobs must be in [0, 20]")
         if self.logit_bias is not None:
             try:
                 self.logit_bias = {

@@ -183,7 +183,13 @@ class Scheduler:
                     # is simply recomputed from scratch)
                     logger.exception("kv restore failed; recomputing prefix")
             alloc = self.block_manager.allocate_prompt(
-                seq.prompt_token_ids, seed=seq.hash_seed
+                seq.prompt_token_ids, seed=seq.hash_seed,
+                # prompt_logprobs must COMPUTE every position; a prefix
+                # hit would skip its rows (vLLM disables reuse the same
+                # way for these requests)
+                reuse_cache=(
+                    seq.sampling_params.prompt_logprobs is None
+                ),
             )
             if alloc is None:
                 break  # out of blocks; retry next step

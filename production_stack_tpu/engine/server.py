@@ -248,8 +248,8 @@ class EngineServer:
         if echo and sp.logprobs is not None:
             return web.json_response(
                 proto.error_json(
-                    "echo with logprobs needs prompt logprobs, which "
-                    "are not supported"
+                    "echo with logprobs is not supported; request "
+                    "prompt_logprobs for per-prompt-token logprobs"
                 ),
                 status=400,
             )
@@ -520,6 +520,9 @@ class EngineServer:
             (echo_prefix or "") + final.text, final.finish_reason,
             len(final.prompt_token_ids), len(final.token_ids),
         )
+        if final.prompt_logprobs is not None:
+            # vLLM field: per-prompt-position entries, None first
+            resp["choices"][0]["prompt_logprobs"] = final.prompt_logprobs
         resp["choices"][0]["logprobs"] = self._fmt_completion_logprobs(
             final.logprobs
         )
@@ -612,13 +615,16 @@ class EngineServer:
                     pfx = (
                         echo_prefixes[idx // n] if echo_prefixes else ""
                     )
-                    choices.append({
+                    choice = {
                         "index": idx, "text": pfx + final.text,
                         "logprobs": self._fmt_completion_logprobs(
                             final.logprobs
                         ),
                         "finish_reason": final.finish_reason,
-                    })
+                    }
+                    if final.prompt_logprobs is not None:
+                        choice["prompt_logprobs"] = final.prompt_logprobs
+                    choices.append(choice)
             return web.json_response(proto.multi_choice_response(
                 request_id, model, chat, choices,
                 sum(len(ids) for ids in prompt_ids_list),
@@ -673,14 +679,21 @@ class EngineServer:
         completion_tokens = 0
         lp_pos: dict[int, int] = {}  # per-choice text_offset seeds
 
-        async def send_finish(idx: int, reason: str) -> None:
-            await send(
-                proto.chat_chunk(request_id, model, {}, reason, index=idx)
-                if chat
-                else proto.completion_chunk(
-                    request_id, model, "", reason, index=idx
-                )
+        async def send_finish(idx: int, reason: str,
+                              prompt_lps=None) -> None:
+            if chat:
+                await send(proto.chat_chunk(
+                    request_id, model, {}, reason, index=idx
+                ))
+                return
+            fin = proto.completion_chunk(
+                request_id, model, "", reason, index=idx
             )
+            if prompt_lps is not None:
+                # same contract as the single-stream path: the field
+                # rides the finishing chunk
+                fin["choices"][0]["prompt_logprobs"] = prompt_lps
+            await send(fin)
         try:
             if chat:
                 for idx, _, _ in plan:
@@ -703,7 +716,8 @@ class EngineServer:
                     if payload is not None:
                         self._observe_finish(payload, arrival)
                         completion_tokens += len(payload.token_ids)
-                        await send_finish(idx, payload.finish_reason)
+                        await send_finish(idx, payload.finish_reason,
+                                          payload.prompt_logprobs)
                 else:  # error
                     remaining -= 1
                     await send(proto.error_json(str(payload)))
@@ -779,11 +793,18 @@ class EngineServer:
                         )
                     )
                 else:
-                    await send(
-                        proto.completion_chunk(
-                            request_id, model, "", final.finish_reason
-                        )
+                    fin = proto.completion_chunk(
+                        request_id, model, "", final.finish_reason
                     )
+                    if final.prompt_logprobs is not None:
+                        # streamed requests get the field on the
+                        # finishing chunk (blocking puts it on the
+                        # choice) — the engine paid to compute it either
+                        # way
+                        fin["choices"][0]["prompt_logprobs"] = (
+                            final.prompt_logprobs
+                        )
+                    await send(fin)
                 if include_usage:
                     # OpenAI stream_options.include_usage contract: one
                     # final chunk with empty choices + the usage totals

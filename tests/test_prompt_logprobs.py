@@ -1,0 +1,195 @@
+"""vLLM prompt_logprobs role: per-prompt-position logprob of each
+prompt token under its preceding context (+ top-N alternatives),
+computed ON DEVICE in a prefill program variant (the host fetches
+(t_pad,) + (t_pad, CAP) arrays, never per-row vocab logits).
+Reference capability: SURVEY §2.7 vLLM-equivalent engine."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.engine.server import EngineServer
+
+
+def make_engine(**overrides) -> LLMEngine:
+    kw = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=128,
+        max_num_seqs=2, max_prefill_chunk=64, seed=0,
+    )
+    kw.update(overrides)
+    return LLMEngine(EngineConfig(**kw))
+
+
+PROMPT = list(range(7, 40))  # 33 tokens
+
+
+def run_plp(eng, prompt, n=2, max_tokens=2):
+    sp = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                        prompt_logprobs=n, ignore_eos=True)
+    return eng.generate([prompt], sp)[0]
+
+
+def test_shape_and_chunking_invariance():
+    """One entry per prompt position (None first), and the entries are
+    IDENTICAL whether the prompt prefills in one chunk or many (the
+    cross-chunk target alignment is the tricky part)."""
+    one = run_plp(make_engine(max_prefill_chunk=64), PROMPT)
+    many = run_plp(make_engine(max_prefill_chunk=8), PROMPT)
+    for out in (one, many):
+        assert out.prompt_logprobs is not None
+        assert len(out.prompt_logprobs) == len(PROMPT)
+        assert out.prompt_logprobs[0] is None
+        for e, tok in zip(out.prompt_logprobs[1:], PROMPT[1:]):
+            assert e["token_id"] == tok
+            assert e["logprob"] <= 0.0
+            assert len(e["top_logprobs"]) == 2
+    assert [e["token_id"] for e in one.prompt_logprobs[1:]] == [
+        e["token_id"] for e in many.prompt_logprobs[1:]
+    ]
+    # different chunk shapes fuse differently: allow f32 noise
+    np.testing.assert_allclose(
+        [e["logprob"] for e in one.prompt_logprobs[1:]],
+        [e["logprob"] for e in many.prompt_logprobs[1:]],
+        rtol=1e-3, atol=1e-3,
+    )
+
+
+def test_last_prompt_position_matches_generation_logprob():
+    """Scoring token t as the LAST prompt position must equal the
+    generation-logprobs entry for t when it was generated at that very
+    position (same context, same distribution)."""
+    eng = make_engine()
+    sp = SamplingParams(max_tokens=1, temperature=0.0, logprobs=0,
+                        ignore_eos=True)
+    gen = eng.generate([PROMPT], sp)[0]
+    t = gen.token_ids[0]
+    gen_lp = gen.logprobs[0]["logprob"]
+
+    eng2 = make_engine()
+    out = run_plp(eng2, PROMPT + [t], n=0, max_tokens=1)
+    last = out.prompt_logprobs[-1]
+    assert last["token_id"] == t
+    assert np.isclose(last["logprob"], gen_lp, rtol=1e-4, atol=1e-4)
+
+
+def test_prefix_cache_reuse_disabled():
+    """A cached prefix would skip the rows prompt_logprobs must score:
+    the request bypasses reuse (and still registers its blocks)."""
+    eng = make_engine()
+    warm = SamplingParams(max_tokens=1, temperature=0.0, ignore_eos=True)
+    eng.generate([PROMPT], warm)  # fills the prefix cache
+    out = run_plp(eng, PROMPT)
+    assert len(out.prompt_logprobs) == len(PROMPT)
+    assert out.metrics.num_cached_prompt_tokens == 0
+    # a normal request after it still hits the cache
+    out2 = eng.generate([PROMPT], warm)[0]
+    assert out2.num_cached_tokens > 0
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(prompt_logprobs=21)
+    with pytest.raises(ValueError):
+        SamplingParams(prompt_logprobs=-1)
+
+
+def test_http_completions_field():
+    async def scenario():
+        server = EngineServer(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=4, num_kv_blocks=128,
+            max_num_seqs=2, max_prefill_chunk=32, seed=0,
+        ))
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={
+                "prompt": "hello world", "max_tokens": 2,
+                "temperature": 0, "prompt_logprobs": 1,
+            })
+            assert r.status == 200
+            data = await r.json()
+            plp = data["choices"][0]["prompt_logprobs"]
+            assert plp[0] is None
+            assert len(plp) == data["usage"]["prompt_tokens"]
+            assert all(e["top_logprobs"] is not None for e in plp[1:])
+            # echo+logprobs stays a clean 400 pointing here
+            r = await client.post("/v1/completions", json={
+                "prompt": "x", "echo": True, "logprobs": 1,
+                "max_tokens": 1,
+            })
+            assert r.status == 400
+            assert "prompt_logprobs" in (await r.text())
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_http_streaming_carries_prompt_logprobs():
+    """Streamed requests must deliver the field too (on the finishing
+    chunk) — the engine pays to compute it either way."""
+    import json as _json
+
+    async def scenario():
+        server = EngineServer(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=4, num_kv_blocks=128,
+            max_num_seqs=2, max_prefill_chunk=32, seed=0,
+        ))
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={
+                "prompt": "hello", "max_tokens": 2, "temperature": 0,
+                "prompt_logprobs": 1, "stream": True,
+            })
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            found = None
+            for line in raw.split("\n"):
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    d = _json.loads(line[6:])
+                    for c in d.get("choices", []):
+                        if c.get("prompt_logprobs") is not None:
+                            found = c["prompt_logprobs"]
+            assert found is not None and found[0] is None
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_multihost_broadcast_carries_plp_targets():
+    """prompt_logprobs under multihost: the targets ride the prefill
+    broadcast so followers compile/dispatch the SAME program variant
+    (code-review r5)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_multihost_engine import (  # type: ignore
+        _FakeBroadcaster,
+        _RecordingRunner,
+        _drain_follower,
+    )
+
+    from production_stack_tpu.engine import multihost_engine as mhe
+
+    runner = _RecordingRunner()
+    bc = _FakeBroadcaster()
+    proxy = mhe.BroadcastingRunner(runner, bc)
+    proxy.prefill([1, 2, 3], 0, [1], 3, prompt_lp_targets=[2, 3, -1])
+    assert bc.published[0]["prompt_lp_targets"] == [2, 3, -1]
+    follower = _RecordingRunner()
+    _drain_follower(bc, follower)
+    kind, kw = follower.calls[0]
+    assert kind == "prefill"
+    assert kw["prompt_lp_targets"] == [2, 3, -1]
