@@ -159,6 +159,7 @@ inline Json engine_container(const Json& cr) {
   arg_if(args, eng, "numSchedulerSteps", "--num-scheduler-steps");
   arg_if(args, eng, "numSpeculativeTokens", "--num-speculative-tokens");
   arg_if(args, eng, "precompileServing", "--precompile-serving");
+  arg_if(args, eng, "schedulingPolicy", "--scheduling-policy");
   arg_if(args, eng, "enableLora", "--enable-lora");
   if (!eng.get("hbmUtilization").is_null())
     arg(args, "--hbm-utilization",

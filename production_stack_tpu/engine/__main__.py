@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cross-sequence prefill packing: chunks from up "
                         "to this many sequences share one dispatch "
                         "(1 = no packing)")
+    p.add_argument("--scheduling-policy", default="fcfs",
+                   choices=["fcfs", "priority"],
+                   help="priority: requests carry an integer 'priority' "
+                        "(lower = served first); preemption evicts the "
+                        "lowest-priority victim")
     p.add_argument("--decode-interleave", type=int, default=1,
                    help="max consecutive prefill chunks while decodes "
                         "wait (0 = prefill always wins)")
@@ -146,6 +151,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         hbm_utilization=args.hbm_utilization,
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
+        scheduling_policy=args.scheduling_policy,
         max_prefill_chunk=args.max_prefill_chunk,
         enable_chunked_prefill=args.enable_chunked_prefill,
         max_prefill_seqs=args.max_prefill_seqs,

@@ -130,6 +130,7 @@ class AsyncLLMEngine:
         prompt_token_ids: list[int] | None = None,
         sampling_params: SamplingParams | None = None,
         lora_name: str | None = None,
+        priority: int = 0,
     ) -> AsyncIterator[RequestOutput]:
         if self.sleeping:
             raise EngineSleepingError("engine is sleeping")
@@ -145,6 +146,7 @@ class AsyncLLMEngine:
                     sampling_params=sampling_params,
                     arrival_time=time.time(),
                     lora_name=lora_name,
+                    priority=priority,
                 )
             self._wake.set()
             while True:

@@ -24,6 +24,10 @@ class EngineConfig:
     hbm_utilization: float = 0.9
 
     # scheduling
+    # vLLM --scheduling-policy: "fcfs" (arrival order) or "priority"
+    # (requests carry an integer `priority`; lower = served first,
+    # preemption evicts the LOWEST-priority victim)
+    scheduling_policy: str = "fcfs"
     max_model_len: int | None = None  # None -> model's max
     max_num_seqs: int = 8
     max_prefill_chunk: int = 512
@@ -124,6 +128,10 @@ class EngineConfig:
     kv_instance_id: str = "default-instance"
 
     def __post_init__(self) -> None:
+        if self.scheduling_policy not in ("fcfs", "priority"):
+            raise ValueError(
+                "scheduling_policy must be 'fcfs' or 'priority'"
+            )
         # n=0 would make the prompt-lookup window match every position
         # (arr[-0:] is the whole context), degenerating drafts to noise.
         if self.num_speculative_tokens:

@@ -73,6 +73,7 @@ class LLMEngine:
                 max_model_len=config.resolved_max_model_len(),
                 enable_chunked_prefill=config.enable_chunked_prefill,
                 max_prefill_seqs=config.max_prefill_seqs,
+                scheduling_policy=config.scheduling_policy,
                 decode_interleave=config.decode_interleave,
                 decode_lookahead=max(0, config.num_scheduler_steps - 1),
             ),
@@ -233,6 +234,7 @@ class LLMEngine:
         sampling_params: SamplingParams | None = None,
         arrival_time: float | None = None,
         lora_name: str | None = None,
+        priority: int = 0,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -291,6 +293,7 @@ class LLMEngine:
             arrival_time=arrival_time,
             lora_name=lora_name,
             hash_seed=hash_seed,
+            priority=int(priority),
         )
         if sp.guided_choice is not None:
             if not sp.guided_choice or not all(

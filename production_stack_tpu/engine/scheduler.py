@@ -93,6 +93,8 @@ class SchedulerConfig:
     # most max_prefill_seqs x max_prefill_chunk tokens); with chunking
     # off, groups stay at 1.
     max_prefill_seqs: int = 8
+    # "fcfs" | "priority" (see EngineConfig.scheduling_policy)
+    scheduling_policy: str = "fcfs"
     # max consecutive prefill dispatches (each packing up to
     # max_prefill_seqs chunks) while decode-ready sequences wait;
     # 0 disables interleaving (prefill runs to completion first)
@@ -156,6 +158,18 @@ class Scheduler:
 
         # 1) admit waiting sequences while there is room
         while self.waiting and len(self.running) < self.config.max_num_seqs:
+            if self.config.scheduling_policy == "priority":
+                # lower priority value first, FIFO within a class; the
+                # waiting queue is short (bounded by arrival rate), so a
+                # linear scan beats maintaining a heap through the
+                # deque's other uses (preemption pushes LEFT)
+                seq = min(
+                    self.waiting,
+                    key=lambda s: (s.priority, s.arrival_ordinal),
+                )
+                if seq is not self.waiting[0]:
+                    self.waiting.remove(seq)
+                    self.waiting.appendleft(seq)
             seq = self.waiting[0]
             bm = self.block_manager
             min_blocks = (
@@ -192,6 +206,8 @@ class Scheduler:
                 ),
             )
             if alloc is None:
+                if self._priority_preempt_for(seq, out):
+                    continue  # blocks freed; retry this admission
                 break  # out of blocks; retry next step
             table, cached = alloc
             seq.block_table = table
@@ -200,6 +216,32 @@ class Scheduler:
             seq.status = SequenceStatus.RUNNING
             self.waiting.popleft()
             self.running.append(seq)
+        # priority policy: a waiting higher-priority request CLAIMS a
+        # lane from a running lower-priority one (vLLM preempts for
+        # priority, not just for block exhaustion) — without this,
+        # priority would only reorder the waiting queue and inversion
+        # under a full lane pool would be unbounded
+        if (
+            self.config.scheduling_policy == "priority"
+            and self.waiting
+            and len(self.running) >= self.config.max_num_seqs
+        ):
+            cand = min(
+                self.waiting,
+                key=lambda s: (s.priority, s.arrival_ordinal),
+            )
+            worst = max(
+                self.running,
+                key=lambda s: (s.priority, s.arrival_ordinal),
+            )
+            if (cand.priority, cand.arrival_ordinal) < (
+                worst.priority, worst.arrival_ordinal
+            ):
+                self._preempt(worst, out)
+                # one lane per step keeps the preemption cost bounded;
+                # the next schedule() admits cand through the normal
+                # loop (and preempts again if more claims remain)
+                return self.schedule_admit_retry(out)
 
         # 2) prefill priority: oldest running sequence with prompt left —
         # UNLESS decode-ready sequences have already waited through
@@ -288,7 +330,55 @@ class Scheduler:
             out.decode = DecodeWork(seqs=decode_seqs)
         return out
 
+    def schedule_admit_retry(self, out: SchedulerOutput) -> SchedulerOutput:
+        """Re-run schedule() after a priority claim, merging the
+        preemption bookkeeping into the same step's output."""
+        nxt = self.schedule()
+        nxt.preempted = out.preempted + nxt.preempted
+        nxt.aborted = out.aborted + nxt.aborted
+        return nxt
+
+    def _priority_preempt_for(
+        self, seq: Sequence, out: SchedulerOutput
+    ) -> bool:
+        """Block-shortage variant of the priority claim: free blocks by
+        evicting a strictly lower-standing RUNNING sequence so `seq`
+        can allocate. Returns True when a victim was preempted."""
+        if self.config.scheduling_policy != "priority" or not self.running:
+            return False
+        worst = max(
+            self.running,
+            key=lambda s: (s.priority, s.arrival_ordinal),
+        )
+        if (seq.priority, seq.arrival_ordinal) < (
+            worst.priority, worst.arrival_ordinal
+        ):
+            self._preempt(worst, out)
+            return True
+        return False
+
     def _pick_preemption_victim(self, exclude: Sequence) -> Sequence | None:
+        if self.config.scheduling_policy == "priority":
+            # evict the LOWEST-priority running sequence (largest value),
+            # youngest among ties — a high-priority request must not be
+            # recomputed to make room for a low-priority one. If the
+            # REQUESTER itself is the lowest-standing sequence, return
+            # None so it self-preempts instead of evicting a
+            # higher-priority neighbour.
+            best = None
+            for seq in self.running:
+                if seq is exclude:
+                    continue
+                key = (seq.priority, seq.arrival_ordinal)
+                if best is None or key > (best.priority,
+                                          best.arrival_ordinal):
+                    best = seq
+            if best is not None and (
+                (best.priority, best.arrival_ordinal)
+                > (exclude.priority, exclude.arrival_ordinal)
+            ):
+                return best
+            return None
         for seq in reversed(self.running):  # youngest first
             if seq is not exclude:
                 return seq

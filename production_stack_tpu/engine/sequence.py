@@ -41,6 +41,8 @@ class RequestMetrics:
 class Sequence:
     """One request's sequence (n=1; parallel sampling fans out to n Sequences)."""
 
+    _arrival_counter = 0
+
     def __init__(
         self,
         request_id: str,
@@ -50,6 +52,7 @@ class Sequence:
         arrival_time: float | None = None,
         lora_name: str | None = None,
         hash_seed: int | None = None,
+        priority: int = 0,
     ):
         self.request_id = request_id
         self.prompt_token_ids = list(prompt_token_ids)
@@ -72,6 +75,12 @@ class Sequence:
             self.hash_seed = xxhash.xxh64(
                 b"lora:" + lora_name.encode()
             ).intdigest()
+        # vLLM --scheduling-policy priority role: LOWER value = served
+        # first; ties break by arrival order (a per-process ordinal, not
+        # wall time, so equal-timestamp arrivals stay FIFO)
+        self.priority = priority
+        Sequence._arrival_counter += 1
+        self.arrival_ordinal = Sequence._arrival_counter
         self.status = SequenceStatus.WAITING
         self.metrics = RequestMetrics()
         if arrival_time is not None:

@@ -173,6 +173,17 @@ class EngineServer:
             )
         return None
 
+    @staticmethod
+    def _parse_priority(body: dict):
+        """-> (priority, None) or (0, 400-response)."""
+        try:
+            return int(body.get("priority", 0)), None
+        except (TypeError, ValueError):
+            return 0, web.json_response(
+                proto.error_json("priority must be an integer"),
+                status=400,
+            )
+
     def _observe_finish(self, out, arrival: float) -> None:
         m = out.metrics
         ttft = (
@@ -244,6 +255,9 @@ class EngineServer:
                 ),
                 status=400,
             )
+        req_priority, perr = self._parse_priority(body)
+        if perr is not None:
+            return perr
         echo = bool(body.get("echo", False))
         if echo and sp.logprobs is not None:
             return web.json_response(
@@ -283,8 +297,10 @@ class EngineServer:
                 stream=bool(body.get("stream")),
                 include_usage=self._wants_usage(body),
                 echo_prefixes=echo_prefixes,
+                priority=req_priority,
             )
-        kwargs = {"prompt_token_ids": prompt_ids_list[0]}
+        kwargs = {"prompt_token_ids": prompt_ids_list[0],
+                  "priority": req_priority}
         if body.get("stream"):
             return await self._stream_completion(
                 request, request_id, sp, kwargs, lora_name, chat=False,
@@ -351,6 +367,9 @@ class EngineServer:
         prompt_ids = self.engine.tokenizer.encode(prompt)
         if err := self._check_context_len(prompt_ids):
             return err
+        req_priority, perr = self._parse_priority(body)
+        if perr is not None:
+            return perr
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
         if sp.n > 1:
@@ -360,17 +379,22 @@ class EngineServer:
                 stream=bool(body.get("stream")),
                 include_usage=self._wants_usage(body),
                 parse_tools=use_tools,
+                priority=req_priority,
             )
         if body.get("stream"):
             # streamed responses pass tool-call text through verbatim
             # (parsing happens client-side); blocking mode parses
             return await self._stream_completion(
-                request, request_id, sp, {"prompt_token_ids": prompt_ids},
+                request, request_id, sp,
+                {"prompt_token_ids": prompt_ids,
+                 "priority": req_priority},
                 lora_name, chat=True,
                 include_usage=self._wants_usage(body),
             )
         return await self._blocking_completion(
-            request_id, sp, {"prompt_token_ids": prompt_ids}, lora_name,
+            request_id, sp,
+            {"prompt_token_ids": prompt_ids, "priority": req_priority},
+            lora_name,
             chat=True,
             model=body.get("model") or self.model_name,
             parse_tools=use_tools,
@@ -534,6 +558,7 @@ class EngineServer:
         chat: bool, model: str, stream: bool,
         include_usage: bool = False, parse_tools: bool = False,
         echo_prefixes: list[str] | None = None,
+        priority: int = 0,
     ) -> web.StreamResponse:
         """Batch prompts and/or n>1 sampling: fan the choices out as
         engine sub-requests (continuous batching coalesces them on
@@ -558,6 +583,7 @@ class EngineServer:
             async for out in self.engine.generate(
                 f"{request_id}-c{idx}", sampling_params=sp_i,
                 lora_name=lora_name, prompt_token_ids=ids,
+                priority=priority,
             ):
                 final = out
             return final
@@ -663,6 +689,7 @@ class EngineServer:
                 async for out in self.engine.generate(
                     f"{request_id}-c{idx}", sampling_params=sp_i,
                     lora_name=lora_name, prompt_token_ids=ids,
+                    priority=priority,
                 ):
                     final = out
                     if out.delta_text or out.new_logprobs:

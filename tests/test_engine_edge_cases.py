@@ -250,3 +250,33 @@ def test_repeat_prompt_prefix_cache_exact_match():
     second = eng.generate([prompt], sp)[0]
     assert second.num_cached_tokens == 8  # floored to adopted blocks
     assert second.token_ids == first.token_ids
+
+
+def test_priority_request_jumps_queue_end_to_end():
+    """--scheduling-policy priority at the engine tier: with the lane
+    pool full, a high-priority (lower value) arrival admits before an
+    earlier low-priority one."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    eng = LLMEngine(EngineConfig(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=64,
+        max_num_seqs=1, max_prefill_chunk=32,
+        scheduling_policy="priority", seed=0,
+    ))
+    sp = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    eng.add_request("running", prompt_token_ids=list(range(1, 9)),
+                    sampling_params=sp)
+    eng.step()  # admit + prefill the running lane (pool of 1 lane)
+    eng.add_request("low", prompt_token_ids=list(range(10, 18)),
+                    sampling_params=sp, priority=5)
+    eng.add_request("high", prompt_token_ids=list(range(20, 28)),
+                    sampling_params=sp, priority=0)
+    order = []
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished:
+                order.append(o.request_id)
+    assert order.index("high") < order.index("low")

@@ -164,7 +164,8 @@ def test_modelspec_knob_parity():
         "servedModelName", "tensorParallelSize", "pipelineParallelSize",
         "maxModelLen", "maxNumSeqs", "blockSize", "dtype", "kvCacheDtype",
         "hbmUtilization", "attentionImpl", "numSchedulerSteps",
-        "numSpeculativeTokens", "precompileServing", "enableLora",
+        "numSpeculativeTokens", "precompileServing", "schedulingPolicy",
+        "enableLora",
         "cpuOffloadingBufferGB",
         "diskOffloadingBufferGB", "remoteCacheUrl", "kvControllerUrl",
         "kvRole", "kvTransferPort", "kvPeer", "pvcStorage",
