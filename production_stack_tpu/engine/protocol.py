@@ -108,6 +108,14 @@ def sampling_params_from_request(body: dict) -> SamplingParams:
             n=int(body.get("n", 1)),
             stop=stop,
             stop_token_ids=list(body.get("stop_token_ids", [])),
+            include_stop_str_in_output=bool(
+                body.get("include_stop_str_in_output", False)
+            ),
+            truncate_prompt_tokens=(
+                int(body["truncate_prompt_tokens"])
+                if body.get("truncate_prompt_tokens") is not None
+                else None
+            ),
             ignore_eos=bool(body.get("ignore_eos", False)),
             seed=body.get("seed"),
             presence_penalty=float(body.get("presence_penalty", 0.0)),

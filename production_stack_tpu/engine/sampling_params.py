@@ -21,6 +21,12 @@ class SamplingParams:
     n: int = 1
     stop: list[str] = field(default_factory=list)
     stop_token_ids: list[int] = field(default_factory=list)
+    # vLLM include_stop_str_in_output role: keep the matched stop string
+    # in the returned text instead of truncating before it
+    include_stop_str_in_output: bool = False
+    # vLLM truncate_prompt_tokens role: keep only the LAST N prompt
+    # tokens; -1 = truncate to the model's max length (None = off)
+    truncate_prompt_tokens: int | None = None
     ignore_eos: bool = False
     seed: int | None = None
     presence_penalty: float = 0.0
@@ -74,6 +80,14 @@ class SamplingParams:
             0 <= self.prompt_logprobs <= 20
         ):
             raise ValueError("prompt_logprobs must be in [0, 20]")
+        if self.truncate_prompt_tokens is not None and (
+            self.truncate_prompt_tokens < 1
+            and self.truncate_prompt_tokens != -1
+        ):
+            raise ValueError(
+                "truncate_prompt_tokens must be >= 1, or -1 for the "
+                "model's max length"
+            )
         if self.logit_bias is not None:
             try:
                 self.logit_bias = {

@@ -236,7 +236,7 @@ class Scheduler:
             )
             if (cand.priority, cand.arrival_ordinal) < (
                 worst.priority, worst.arrival_ordinal
-            ):
+            ) and self._eviction_can_fit(cand):
                 self._preempt(worst, out)
                 # one lane per step keeps the preemption cost bounded;
                 # the next schedule() admits cand through the normal
@@ -338,6 +338,22 @@ class Scheduler:
         nxt.aborted = out.aborted + nxt.aborted
         return nxt
 
+    def _eviction_can_fit(self, cand: Sequence) -> bool:
+        """Feasibility gate before ANY priority eviction: evicting every
+        strictly lower-standing runner must free enough blocks for
+        `cand`'s minimum allocation — otherwise victims would lose
+        their KV progress while the claimed lane sits idle (the freed
+        capacity can never admit cand, and lower-priority waiters must
+        not jump it under strict priority)."""
+        bs = self.block_manager.block_size
+        need = (cand.num_prompt_tokens + 1 + bs - 1) // bs
+        avail = self.block_manager.num_free_blocks
+        ck = (cand.priority, cand.arrival_ordinal)
+        for s in self.running:
+            if (s.priority, s.arrival_ordinal) > ck:
+                avail += len(s.block_table)
+        return avail >= need
+
     def _priority_preempt_for(
         self, seq: Sequence, out: SchedulerOutput
     ) -> bool:
@@ -345,6 +361,8 @@ class Scheduler:
         evicting a strictly lower-standing RUNNING sequence so `seq`
         can allocate. Returns True when a victim was preempted."""
         if self.config.scheduling_policy != "priority" or not self.running:
+            return False
+        if not self._eviction_can_fit(seq):
             return False
         worst = max(
             self.running,

@@ -166,7 +166,11 @@ class Sequence:
             for s in sp.stop:
                 idx = self.output_text.find(s)
                 if idx != -1:
-                    self.output_text = self.output_text[:idx]
+                    # vLLM include_stop_str_in_output: keep the matched
+                    # stop string (truncate AFTER it, not before)
+                    end = idx + (len(s) if sp.include_stop_str_in_output
+                                 else 0)
+                    self.output_text = self.output_text[:end]
                     self._stopped_by = s
                     self.status = SequenceStatus.FINISHED_STOPPED
                     return

@@ -173,6 +173,16 @@ class EngineServer:
             )
         return None
 
+    def _apply_truncation(self, ids: list[int], sp) -> list[int]:
+        """vLLM truncate_prompt_tokens, applied BEFORE the context-length
+        gate — the feature exists to make over-long prompts fit."""
+        n = sp.truncate_prompt_tokens
+        if n is None:
+            return ids
+        if n == -1:
+            n = self.config.resolved_max_model_len() - 1
+        return ids[-n:]
+
     @staticmethod
     def _parse_priority(body: dict):
         """-> (priority, None) or (0, 400-response)."""
@@ -275,6 +285,7 @@ class EngineServer:
                 list(p) if isinstance(p, list)
                 else self.engine.tokenizer.encode(p)
             )
+            ids = self._apply_truncation(ids, sp)
             if err := self._check_context_len(ids):
                 return err
             prompt_ids_list.append(ids)
@@ -365,6 +376,7 @@ class EngineServer:
 
         request_id = proto.make_id("chatcmpl")
         prompt_ids = self.engine.tokenizer.encode(prompt)
+        prompt_ids = self._apply_truncation(prompt_ids, sp)
         if err := self._check_context_len(prompt_ids):
             return err
         req_priority, perr = self._parse_priority(body)

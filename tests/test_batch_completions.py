@@ -248,3 +248,37 @@ def test_echo_suffix_best_of():
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_truncate_prompt_tokens_beats_context_gate():
+    """An over-long prompt with truncate_prompt_tokens must be ACCEPTED
+    (truncation applies before the context-length 400 gate — that is
+    the feature's whole purpose) and -1 maps to the model max."""
+    async def scenario():
+        server = make_server()
+        limit = server.config.resolved_max_model_len()
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            long_ids = list(range(1, 200)) * ((limit + 400) // 199)
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": long_ids, "max_tokens": 2, "temperature": 0,
+                "truncate_prompt_tokens": 8,
+            })
+            assert status == 200, data
+            assert data["usage"]["prompt_tokens"] == 8
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": long_ids, "max_tokens": 2, "temperature": 0,
+                "truncate_prompt_tokens": -1,
+            })
+            assert status == 200, data
+            assert data["usage"]["prompt_tokens"] == limit - 1
+            # without truncation the same prompt is a clean 400
+            status, _ = await _post(client, "/v1/completions", {
+                "prompt": long_ids, "max_tokens": 2,
+            })
+            assert status == 400
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -280,3 +280,51 @@ def test_priority_request_jumps_queue_end_to_end():
             if o.finished:
                 order.append(o.request_id)
     assert order.index("high") < order.index("low")
+
+
+def test_include_stop_str_and_truncate_prompt():
+    """vLLM include_stop_str_in_output (keep the matched stop string)
+    and truncate_prompt_tokens (keep the LAST N prompt tokens)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def eng():
+        return LLMEngine(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=8, num_kv_blocks=64,
+            max_num_seqs=2, max_prefill_chunk=32, seed=0,
+        ))
+
+    prompt = list(range(1, 20))
+    base = eng().generate([prompt], SamplingParams(
+        max_tokens=16, temperature=0.0, ignore_eos=True,
+    ))[0]
+    assert len(base.text) > 2
+    stop = base.text[1:3]  # a substring the greedy stream will hit
+    excl = eng().generate([prompt], SamplingParams(
+        max_tokens=16, temperature=0.0, ignore_eos=True, stop=[stop],
+    ))[0]
+    incl = eng().generate([prompt], SamplingParams(
+        max_tokens=16, temperature=0.0, ignore_eos=True, stop=[stop],
+        include_stop_str_in_output=True,
+    ))[0]
+    assert excl.finish_reason == "stop" and incl.finish_reason == "stop"
+    assert not excl.text.endswith(stop)
+    assert incl.text == excl.text + stop
+
+    # truncation: only the last 5 prompt tokens are used — identical
+    # output to sending just the suffix
+    full = eng().generate([prompt], SamplingParams(
+        max_tokens=8, temperature=0.0, ignore_eos=True,
+        truncate_prompt_tokens=5,
+    ))[0]
+    suffix = eng().generate([prompt[-5:]], SamplingParams(
+        max_tokens=8, temperature=0.0, ignore_eos=True,
+    ))[0]
+    assert full.token_ids == suffix.token_ids
+    assert len(full.prompt_token_ids) == 5
+
+    import pytest
+    with pytest.raises(ValueError):
+        SamplingParams(truncate_prompt_tokens=0)

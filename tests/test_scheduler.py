@@ -301,3 +301,88 @@ def test_priority_claims_lane_from_running_lower_priority():
     assert [s.request_id for s in out.preempted] == ["low"]
     assert any(w.seq.request_id == "hi" for w in out.prefills)
     assert "hi" in [s.request_id for s in sched.running]
+
+
+def test_priority_claim_skipped_when_candidate_cannot_fit():
+    """Feasibility gate: when evicting every lower-priority runner
+    still cannot free enough blocks for the candidate, NO victim is
+    preempted (no lost KV work for an unadmittable claim)."""
+    from production_stack_tpu.engine.block_manager import BlockManager
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.sequence import Sequence
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    bm = BlockManager(num_blocks=8, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=1, max_prefill_chunk=32,
+                        max_model_len=256,
+                        scheduling_policy="priority"),
+        bm,
+    )
+    low = Sequence(request_id="low", prompt_token_ids=list(range(1, 9)),
+                   sampling_params=SamplingParams(max_tokens=32),
+                   eos_token_id=None, priority=9)
+    sched.add_seq(low)
+    out = sched.schedule()
+    for w in out.prefills:
+        w.seq.num_computed_tokens += w.chunk_len
+    low.append_token(1)
+    # the candidate needs more blocks than the WHOLE pool can offer
+    # even after evicting `low` (7 usable blocks < 26 needed)
+    huge = Sequence(request_id="huge",
+                    prompt_token_ids=list(range(1, 102)),
+                    sampling_params=SamplingParams(max_tokens=8),
+                    eos_token_id=None, priority=0)
+    sched.add_seq(huge)
+    out = sched.schedule()
+    assert not out.preempted  # low keeps its lane and its KV
+    assert "low" in [s.request_id for s in sched.running]
+
+
+def test_priority_claim_gate_respects_better_standing_holders():
+    """The gate counts only STRICTLY lower-standing runners as evictable:
+    blocks held by a better-priority runner never free up for the
+    candidate, so no victim is evicted when the math cannot work."""
+    from production_stack_tpu.engine.block_manager import BlockManager
+    from production_stack_tpu.engine.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+    from production_stack_tpu.engine.sequence import Sequence
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    def seq(rid, prio, n_tok):
+        return Sequence(
+            request_id=rid, prompt_token_ids=list(range(1, n_tok + 1)),
+            sampling_params=SamplingParams(max_tokens=32),
+            eos_token_id=None, priority=prio,
+        )
+
+    bm = BlockManager(num_blocks=9, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(
+        SchedulerConfig(max_num_seqs=2, max_prefill_chunk=32,
+                        max_model_len=256,
+                        scheduling_policy="priority"),
+        bm,
+    )
+    best = seq("best", 0, 16)   # 4+ blocks, better standing than cand
+    low = seq("low", 9, 6)      # 2 blocks, evictable
+    sched.add_seq(best)
+    sched.add_seq(low)
+    out = sched.schedule()
+    for w in out.prefills:
+        w.seq.num_computed_tokens += w.chunk_len
+    for s in (best, low):
+        s.append_token(1)
+    # cand needs 5 blocks; free + low's 2 < 5, and best's blocks are
+    # untouchable -> the claim must NOT evict low
+    cand = seq("cand", 1, 17)
+    sched.add_seq(cand)
+    out = sched.schedule()
+    assert not out.preempted
+    assert "low" in [s.request_id for s in sched.running]
