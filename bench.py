@@ -168,6 +168,54 @@ def main() -> None:
     )))
 
 
+def _parse_sweep_labels(spec: str) -> list[tuple]:
+    """Parse the sweep config list. Base labels are
+    k<N>-{sync|async}-{packed|nopack}; optional @-suffixes override the
+    per-config workload env (the reference's run.sh sweeps QPS across
+    one deployment — this lets one chip session walk the serving
+    curve): k8-sync-packed@qps4@u32@r1 -> QPS=4, USERS=32, ROUNDS=1;
+    @chunk<N> sets the prefill chunk; @nopfx disables h2d prefetch.
+    Returns (label, k, prefill_seqs, async, env_overrides) tuples."""
+    configs: list[tuple] = []
+    for label in [x.strip() for x in spec.split(",") if x.strip()]:
+        base, *mods = label.split("@")
+        overrides: dict[str, str] = {}
+        for m in mods:
+            if m.startswith("qps"):
+                overrides["PST_BENCH_QPS"] = str(float(m[3:]))
+            elif m.startswith("chunk"):
+                overrides["PST_BENCH_PREFILL_CHUNK"] = str(int(m[5:]))
+            elif m.startswith("u"):
+                overrides["PST_BENCH_USERS"] = str(int(m[1:]))
+            elif m.startswith("r"):
+                overrides["PST_BENCH_ROUNDS"] = str(int(m[1:]))
+            elif m == "nopfx":
+                overrides["PST_BENCH_PREFETCH"] = "0"
+            else:
+                raise ValueError(
+                    f"bad sweep label modifier {m!r} in {label!r}: want "
+                    "qps<F> | u<N> | r<N> | chunk<N> | nopfx"
+                )
+        kpart, mode, pack = base.split("-")
+        # fail fast on typos: a scarce chip window must not silently run
+        # the sync path under an "asynch" label
+        if (not kpart.startswith("k") or mode not in ("sync", "async")
+                or pack not in ("packed", "nopack")):
+            raise ValueError(
+                f"bad sweep config label {label!r}: want "
+                "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
+                "|@chunk<N>|@nopfx]"
+            )
+        configs.append((
+            label,
+            int(kpart[1:]),
+            PREFILL_SEQS if pack == "packed" else 1,
+            mode == "async",
+            overrides,
+        ))
+    return configs
+
+
 def _run_sweep() -> None:
     """The full measurement matrix: K=1 control, K=8, packing on/off,
     async on/off — ONE SUBPROCESS PER CONFIG. Process exit is the only
@@ -187,31 +235,16 @@ def _run_sweep() -> None:
         "PST_BENCH_SWEEP_CONFIGS",
         "k1-sync-nopack,k{K}-sync-nopack,k{K}-sync-packed,k{K}-async-packed"
     ).replace("{K}", str(SCHED_STEPS))
-    configs = []
-    for label in [s.strip() for s in spec.split(",") if s.strip()]:
-        kpart, mode, pack = label.split("-")
-        # fail fast on typos: a scarce chip window must not silently run
-        # the sync path under an "asynch" label
-        if (not kpart.startswith("k") or mode not in ("sync", "async")
-                or pack not in ("packed", "nopack")):
-            raise ValueError(
-                f"bad sweep config label {label!r}: want "
-                "k<N>-{sync|async}-{packed|nopack}"
-            )
-        configs.append((
-            label,
-            int(kpart[1:]),
-            PREFILL_SEQS if pack == "packed" else 1,
-            mode == "async",
-        ))
+    configs = _parse_sweep_labels(spec)
     out_path = os.environ.get("PST_BENCH_SWEEP_OUT", "BENCH_SWEEP.json")
     per_config_timeout = float(
         os.environ.get("PST_BENCH_CONFIG_TIMEOUT", "1500")
     )
     results: list[dict] = []
-    for label, k, ps, ad in configs:
+    for label, k, ps, ad, overrides in configs:
         env = dict(os.environ)
         env.pop("PST_BENCH_SWEEP", None)
+        env.update(overrides)
         env.update({
             "PST_BENCH_SCHED_STEPS": str(k),
             "PST_BENCH_PREFILL_SEQS": str(ps),

@@ -252,13 +252,13 @@ class LLMEngine:
             raise ValueError("prompt_token_ids must be integers")
         sp0 = sampling_params or SamplingParams()
         if sp0.truncate_prompt_tokens is not None:
-            # vLLM truncate_prompt_tokens: keep the LAST N tokens
-            # (-1 = the model's max length, leaving room for one
-            # generated token)
-            n = sp0.truncate_prompt_tokens
-            if n == -1:
-                n = self.scheduler.config.max_model_len - 1
-            prompt_token_ids = prompt_token_ids[-n:]
+            from production_stack_tpu.engine.sampling_params import (
+                truncate_prompt,
+            )
+
+            prompt_token_ids = truncate_prompt(
+                prompt_token_ids, sp0, self.scheduler.config.max_model_len
+            )
         if sp0.prompt_logprobs is not None:
             from production_stack_tpu.engine.sampler import LOGPROB_CAP
 

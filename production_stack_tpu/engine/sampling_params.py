@@ -112,3 +112,18 @@ class SamplingParams:
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+
+def truncate_prompt(
+    ids: list[int], sp: "SamplingParams", max_model_len: int
+) -> list[int]:
+    """vLLM truncate_prompt_tokens: keep the LAST N prompt tokens
+    (-1 = the model's max length, leaving room for one generated
+    token). The ONE implementation shared by the server gate and
+    engine admission so the two can never drift."""
+    n = sp.truncate_prompt_tokens
+    if n is None:
+        return ids
+    if n == -1:
+        n = max_model_len - 1
+    return ids[-n:]

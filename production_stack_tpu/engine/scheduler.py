@@ -347,6 +347,17 @@ class Scheduler:
         not jump it under strict priority)."""
         bs = self.block_manager.block_size
         need = (cand.num_prompt_tokens + 1 + bs - 1) // bs
+        if (self.block_manager.enable_prefix_caching
+                and cand.sampling_params.prompt_logprobs is None):
+            # shared cached prefix blocks cost no new allocation (same
+            # cap as allocate_prompt: at least one token computes)
+            _, cached_tokens = self.block_manager.match_prefix(
+                cand.prompt_token_ids, cand.hash_seed
+            )
+            cached_tokens = min(
+                cached_tokens, cand.num_prompt_tokens - 1
+            )
+            need -= cached_tokens // bs
         avail = self.block_manager.num_free_blocks
         ck = (cand.priority, cand.arrival_ordinal)
         for s in self.running:

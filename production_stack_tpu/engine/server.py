@@ -176,12 +176,13 @@ class EngineServer:
     def _apply_truncation(self, ids: list[int], sp) -> list[int]:
         """vLLM truncate_prompt_tokens, applied BEFORE the context-length
         gate — the feature exists to make over-long prompts fit."""
-        n = sp.truncate_prompt_tokens
-        if n is None:
-            return ids
-        if n == -1:
-            n = self.config.resolved_max_model_len() - 1
-        return ids[-n:]
+        from production_stack_tpu.engine.sampling_params import (
+            truncate_prompt,
+        )
+
+        return truncate_prompt(
+            ids, sp, self.config.resolved_max_model_len()
+        )
 
     @staticmethod
     def _parse_priority(body: dict):
@@ -291,14 +292,16 @@ class EngineServer:
             prompt_ids_list.append(ids)
         lora_name = body.get("model") if (
             body.get("model") in self.lora_adapters) else None
-        # OpenAI echo: the response text leads with the prompt (string
-        # prompts echo verbatim; token-id prompts echo their decoding)
+        # OpenAI echo: the response text leads with the prompt the
+        # engine ACTUALLY processed — after truncation (string prompts
+        # echo verbatim only when untruncated)
         echo_prefixes = None
         if echo:
             echo_prefixes = [
-                p if isinstance(p, str)
-                else self.engine.tokenizer.decode(list(p))
-                for p in raw_prompts
+                p if (isinstance(p, str)
+                      and sp.truncate_prompt_tokens is None)
+                else self.engine.tokenizer.decode(list(ids))
+                for p, ids in zip(raw_prompts, prompt_ids_list)
             ]
 
         if len(prompt_ids_list) * sp.n > 1:

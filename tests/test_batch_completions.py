@@ -282,3 +282,26 @@ def test_truncate_prompt_tokens_beats_context_gate():
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_echo_reflects_truncated_prompt():
+    """echo=true with truncate_prompt_tokens must echo the prompt the
+    engine ACTUALLY processed, not the untruncated original."""
+    async def scenario():
+        client = TestClient(TestServer(make_server().app))
+        await client.start_server()
+        try:
+            status, data = await _post(client, "/v1/completions", {
+                "prompt": "abcdefgh", "max_tokens": 2, "temperature": 0,
+                "echo": True, "truncate_prompt_tokens": 3,
+            })
+            assert status == 200
+            text = data["choices"][0]["text"]
+            # byte tokenizer: last 3 ids of "abcdefgh" decode to "fgh"
+            assert text.startswith("fgh"), text
+            assert not text.startswith("abc")
+            assert data["usage"]["prompt_tokens"] == 3
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -195,3 +195,37 @@ def test_recycle_holds_concurrency(tmp_path):
     # finished sessions are NOT retained: their chat history would
     # otherwise accumulate for the whole run
     assert len(b.sessions) == 2
+
+
+def test_sweep_label_modifiers_parse():
+    """bench.py sweep labels: @-suffixes override per-config workload
+    env so one chip session can walk the reference's QPS/user serving
+    curve (run.sh sweeps QPS)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    cfgs = bench._parse_sweep_labels(
+        "k8-sync-packed@qps4@u32@r1,k12-async-nopack@chunk1024,"
+        "k8-sync-packed@nopfx"
+    )
+    label, k, ps, ad, ov = cfgs[0]
+    assert (label, k, ad) == ("k8-sync-packed@qps4@u32@r1", 8, False)
+    assert ps > 1  # packed
+    assert ov == {"PST_BENCH_QPS": "4.0", "PST_BENCH_USERS": "32",
+                  "PST_BENCH_ROUNDS": "1"}
+    _, k2, ps2, ad2, ov2 = cfgs[1]
+    assert (k2, ps2, ad2) == (12, 1, True)
+    assert ov2 == {"PST_BENCH_PREFILL_CHUNK": "1024"}
+    assert cfgs[2][4] == {"PST_BENCH_PREFETCH": "0"}
+
+    import pytest
+    with pytest.raises(ValueError, match="modifier"):
+        bench._parse_sweep_labels("k8-sync-packed@bogus7")
+    with pytest.raises(ValueError, match="bad sweep config"):
+        bench._parse_sweep_labels("k8-asynch-packed")
