@@ -553,6 +553,10 @@ class EngineServer:
             resp["choices"][0]["logprobs"] = self._fmt_chat_logprobs(
                 final.logprobs
             )
+            if final.prompt_logprobs is not None:
+                resp["choices"][0]["prompt_logprobs"] = (
+                    final.prompt_logprobs
+                )
             return web.json_response(resp)
         resp = proto.completion_response(
             request_id, model,
@@ -651,6 +655,8 @@ class EngineServer:
                     choice["logprobs"] = self._fmt_chat_logprobs(
                         final.logprobs
                     )
+                    if final.prompt_logprobs is not None:
+                        choice["prompt_logprobs"] = final.prompt_logprobs
                     choices.append(choice)
                 else:
                     pfx = (
@@ -723,13 +729,12 @@ class EngineServer:
 
         async def send_finish(idx: int, reason: str,
                               prompt_lps=None) -> None:
-            if chat:
-                await send(proto.chat_chunk(
-                    request_id, model, {}, reason, index=idx
-                ))
-                return
-            fin = proto.completion_chunk(
-                request_id, model, "", reason, index=idx
+            fin = (
+                proto.chat_chunk(request_id, model, {}, reason, index=idx)
+                if chat
+                else proto.completion_chunk(
+                    request_id, model, "", reason, index=idx
+                )
             )
             if prompt_lps is not None:
                 # same contract as the single-stream path: the field
@@ -829,11 +834,16 @@ class EngineServer:
             if final is not None:
                 self._observe_finish(final, arrival)
                 if chat:
-                    await send(
-                        proto.chat_chunk(
-                            request_id, model, {}, final.finish_reason
-                        )
+                    fin = proto.chat_chunk(
+                        request_id, model, {}, final.finish_reason
                     )
+                    if final.prompt_logprobs is not None:
+                        # same contract as completions: the field rides
+                        # the finishing chunk
+                        fin["choices"][0]["prompt_logprobs"] = (
+                            final.prompt_logprobs
+                        )
+                    await send(fin)
                 else:
                     fin = proto.completion_chunk(
                         request_id, model, "", final.finish_reason

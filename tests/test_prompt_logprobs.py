@@ -193,3 +193,65 @@ def test_multihost_broadcast_carries_plp_targets():
     kind, kw = follower.calls[0]
     assert kind == "prefill"
     assert kw["prompt_lp_targets"] == [2, 3, -1]
+
+
+def test_http_chat_carries_prompt_logprobs():
+    """Chat completions accept and return prompt_logprobs too (vLLM
+    exposes the field on both endpoints)."""
+    async def scenario():
+        server = EngineServer(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=4, num_kv_blocks=128,
+            max_num_seqs=2, max_prefill_chunk=32, seed=0,
+        ))
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, "temperature": 0,
+                "prompt_logprobs": 1,
+            })
+            assert r.status == 200
+            data = await r.json()
+            plp = data["choices"][0]["prompt_logprobs"]
+            assert plp[0] is None and len(plp) > 1
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_http_chat_streaming_carries_prompt_logprobs():
+    """Streamed chat delivers the field on the finishing chunk, same as
+    streamed completions."""
+    import json as _json
+
+    async def scenario():
+        server = EngineServer(EngineConfig(
+            model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+            cache_dtype="float32", block_size=4, num_kv_blocks=128,
+            max_num_seqs=2, max_prefill_chunk=32, seed=0,
+        ))
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, "temperature": 0,
+                "prompt_logprobs": 1, "stream": True,
+            })
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            found = None
+            for line in raw.split("\n"):
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    d = _json.loads(line[6:])
+                    for c in d.get("choices", []):
+                        if c.get("prompt_logprobs") is not None:
+                            found = c["prompt_logprobs"]
+            assert found is not None and found[0] is None
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -139,6 +139,7 @@ class _FakeOut:
         self.metrics = None
         self.logprobs = None
         self.new_logprobs = None
+        self.prompt_logprobs = None
 
 
 def _make_server(canned_text, finish_reason="stop", **cfg_kw):
