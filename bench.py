@@ -77,6 +77,11 @@ ASYNC_DECODE = os.environ.get("PST_BENCH_ASYNC", "0") == "1"
 # speculative h2d prefetch (engine prefetch_decode): stage the next
 # fused round's packed inputs during the current round's fetch
 PREFETCH = os.environ.get("PST_BENCH_PREFETCH", "1") == "1"
+# pipelined prefill (engine prefill_pipeline): fused h2d buffer per
+# prefill dispatch + staged chunk uploads + cold-prompt chunk chaining.
+# Attribution slots: BENCH_SWEEP_pfpipe.json (on, default) vs
+# BENCH_SWEEP_nopfpipe.json (@nopfpipe label modifier)
+PREFILL_PIPELINE = os.environ.get("PST_BENCH_PREFILL_PIPELINE", "1") == "1"
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -191,10 +196,12 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_ROUNDS"] = str(int(m[1:]))
             elif m == "nopfx":
                 overrides["PST_BENCH_PREFETCH"] = "0"
+            elif m == "nopfpipe":
+                overrides["PST_BENCH_PREFILL_PIPELINE"] = "0"
             else:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
-                    "qps<F> | u<N> | r<N> | chunk<N> | nopfx"
+                    "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe"
                 )
         kpart, mode, pack = base.split("-")
         # fail fast on typos: a scarce chip window must not silently run
@@ -204,7 +211,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
             raise ValueError(
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
-                "|@chunk<N>|@nopfx]"
+                "|@chunk<N>|@nopfx|@nopfpipe]"
             )
         configs.append((
             label,
@@ -406,6 +413,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         num_scheduler_steps=sched_steps,
         async_decode=async_decode,
         prefetch_decode=PREFETCH,
+        prefill_pipeline=PREFILL_PIPELINE,
         seed=0,
     )
     engine = LLMEngine(config)
@@ -639,6 +647,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "prefill_seqs": prefill_seqs,
             "async_decode": async_decode,
             "prefetch_decode": PREFETCH,
+            "prefill_pipeline": PREFILL_PIPELINE,
             "config_label": label,
             "rounds": ROUNDS,
             "decode_tokens_per_s_aggregate": round(decode_tps, 1),
@@ -656,6 +665,19 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             # buffer (no serial upload); misses staged but invalidated
             "staged_hits": engine._staged_hits_total,
             "staged_misses": engine._staged_misses_total,
+            # pipelined-prefill attribution: where prefill wall time
+            # went (prep / h2d / dispatch / fetch) + staging and
+            # cold-prompt chaining effectiveness
+            "prefill_phase_s": {
+                k: round(v, 3)
+                for k, v in engine.runner.prefill_phase_s.items()
+            },
+            # per-phase sample counts: phase_s / phase_n = mean wall
+            # time per dispatch for that phase
+            "prefill_phase_n": dict(engine.runner.prefill_phase_n),
+            "prefill_staged_hits": engine._pf_staged_hits_total,
+            "prefill_staged_misses": engine._pf_staged_misses_total,
+            "prefill_chained_chunks": engine._pf_chained_chunks_total,
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)
             else -1,

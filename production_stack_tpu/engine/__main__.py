@@ -74,6 +74,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "round's inputs while the current one executes")
     p.add_argument("--no-prefetch-decode", dest="prefetch_decode",
                    action="store_false")
+    p.add_argument("--prefill-pipeline", action="store_true",
+                   default=True,
+                   help="pipelined prefill: one fused h2d buffer per "
+                        "prefill dispatch, chunk N+1 staged while chunk "
+                        "N computes, cold multi-chunk prompts chained "
+                        "without host round-trips")
+    p.add_argument("--no-prefill-pipeline", dest="prefill_pipeline",
+                   action="store_false",
+                   help="serial per-array prefill uploads (the "
+                        "pre-pipeline path; bench attribution control)")
     p.add_argument("--precompile-serving", action="store_true",
                    default=False,
                    help="compile every steady-state prefill/decode "
@@ -160,6 +170,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         async_decode=args.async_decode,
         precompile_serving=args.precompile_serving,
         prefetch_decode=args.prefetch_decode,
+        prefill_pipeline=args.prefill_pipeline,
         num_speculative_tokens=args.num_speculative_tokens,
         ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,

@@ -70,6 +70,20 @@ class EngineConfig:
     # admission (unlike async_decode, at most ONE round is in flight).
     # Requires num_scheduler_steps > 1; single-device; off multihost.
     prefetch_decode: bool = True
+    # pipelined prefill: (1) every prefill dispatch ships ONE packed i32
+    # host->device buffer (tokens/positions/write slots/tables/sampling
+    # args fused, mirroring the decode pack) instead of ~8 small
+    # transfers that each pay link latency through a tunneled chip;
+    # (2) while chunk N computes on device, chunk N+1's buffer is built
+    # and uploaded so the h2d overlaps compute; (3) cold multi-chunk
+    # prompts chain their chunks back-to-back without a host round-trip
+    # in between (only the final chunk's sampled token is fetched), and
+    # a staged-and-ready chunk is admitted as zero-cost by the
+    # scheduler's decode interleave. Outputs are bit-identical to the
+    # serial path (tests/test_prefill_pipeline.py). False = the
+    # pre-pipeline per-array upload path (--no-prefill-pipeline, the
+    # bench attribution control).
+    prefill_pipeline: bool = True
     # compile every steady-state serving program shape at startup
     # (full-chunk + resume-tail prefill, packed groups, fused-K decode,
     # per ctx bucket) so no XLA compile lands inside a live request's

@@ -27,6 +27,7 @@ from production_stack_tpu.engine.sampler import (
 )
 from production_stack_tpu.engine.sampling_params import SamplingParams
 from production_stack_tpu.engine.scheduler import (
+    PrefillWork,
     Scheduler,
     SchedulerConfig,
 )
@@ -104,6 +105,21 @@ class LLMEngine:
         self._staged_decode: dict | None = None
         self._staged_hits_total = 0
         self._staged_misses_total = 0
+        # pipelined prefill (RTT-amortisation extended to the prefill
+        # path): chunk N+1's packed h2d buffer uploads while chunk N
+        # computes, cold multi-chunk prompts chain their chunks
+        # back-to-back in one engine round when nothing is decode-ready,
+        # and a staged-and-ready chunk is admitted as zero cost by the
+        # scheduler's interleave. Multihost is out for the staging part
+        # (the broadcast wire ships host argument lists, not device
+        # buffers) — the fused-buffer dispatch itself works everywhere.
+        self._prefill_pipeline = (
+            config.prefill_pipeline and not config.multihost
+        )
+        self._staged_prefill: dict | None = None
+        self._pf_staged_hits_total = 0
+        self._pf_staged_misses_total = 0
+        self._pf_chained_chunks_total = 0
         # speculative decoding works under multihost too: verify_batch
         # is part of the broadcast protocol (multihost_engine.py), so
         # followers replay the same packed verify host 0 dispatches
@@ -563,6 +579,20 @@ class LLMEngine:
             # the staged prefetch (the epoch in the fingerprint already
             # guarantees this; dropping early frees the device buffer)
             self._staged_decode = None
+        if sched_out.preempted:
+            # same rule for the staged PREFILL buffer: preemption frees
+            # tables that can be re-handed. (Admission ABORTS don't
+            # invalidate — rejected prompts never held tables, and
+            # aborts of running requests bump free_epoch, which the
+            # fingerprint already catches.) If this very schedule()
+            # admitted a prefill as a zero-cost bypass, that dispatch
+            # now pays the full serial h2d — convert the bypass back
+            # into a charged one so the ITL accounting holds
+            if self._staged_prefill is not None:
+                self._pf_staged_misses_total += 1
+                self.scheduler.note_staged_prefill_miss()
+            self._staged_prefill = None
+            self.scheduler.staged_prefill_ready = False
         self._preemptions_total += len(sched_out.preempted)
         self.last_step_kind = (
             "prefill"
@@ -583,158 +613,34 @@ class LLMEngine:
 
         stepped: list[Sequence] = []
         if sched_out.prefills:
+            # pipelined prefill: a buffer staged in an earlier round may
+            # cover this dispatch (validated by fingerprint inside
+            # _run_prefill_works); afterwards, a cold group's remaining
+            # chunks chain back-to-back in THIS engine round while
+            # nothing is decode-ready, and otherwise the next chunk is
+            # staged so its upload overlaps the interleaved decode round
+            staged = self._staged_prefill
+            self._staged_prefill = None
+            self.scheduler.staged_prefill_ready = False
             works = sched_out.prefills
-            now = time.time()
-            for w in works:
-                if w.seq.metrics.first_scheduled_time is None:
-                    w.seq.metrics.first_scheduled_time = now
-            # prompt_logprobs requests take the single-sequence program
-            # variant (every row's distribution scored on device); they
-            # never pack — their per-row outputs are per-sequence
-            plp_works = [
-                (i, w) for i, w in enumerate(works)
-                if w.seq.sampling_params.prompt_logprobs is not None
-            ]
-            std_works = [
-                (i, w) for i, w in enumerate(works)
-                if w.seq.sampling_params.prompt_logprobs is None
-            ]
-            last_logits: dict[int, object] = {}
-            tok_of: dict[int, int] = {}  # original idx -> sampled token
-            for i, w in plp_works:
-                seq = w.seq
-                chunk = seq.prompt_token_ids[
-                    w.chunk_start : w.chunk_start + w.chunk_len
-                ]
-                # row j scores the NEXT prompt token; the final chunk's
-                # last row has none (its continuation is generated)
-                tgts = seq.prompt_token_ids[
-                    w.chunk_start + 1 : w.chunk_start + w.chunk_len + 1
-                ]
-                t1, p1, k1, m1, keys1, _ = self._sampling_arrays([seq])
-                token_dev, logits, chosen, tv, ti = self.runner.prefill(
-                    chunk,
-                    start_pos=w.chunk_start,
-                    block_table=seq.block_table,
-                    total_len=w.chunk_start + w.chunk_len,
-                    lora_slot=self._lora_slot(seq),
-                    sampling=(t1, p1, k1, m1, keys1),
-                    prompt_lp_targets=[int(x) for x in tgts],
-                )
-                tok_of[i] = int(np.asarray(token_dev))
-                last_logits[i] = logits
-                self._accumulate_prompt_lps(
-                    seq, w.chunk_start, tgts,
-                    np.asarray(chosen), np.asarray(tv), np.asarray(ti),
-                )
-            if std_works:
-                sworks = [w for _, w in std_works]
-                seqs_w = [w.seq for w in sworks]
-                temps, top_ps, top_ks, min_ps, keys, _ = (
-                    self._sampling_arrays(seqs_w)
-                )
-                sampling = (temps, top_ps, top_ks, min_ps, keys)
-                if len(sworks) == 1:
-                    # single-sequence path keeps the round-2 buckets
-                    w = sworks[0]
-                    seq = w.seq
-                    chunk = seq.prompt_token_ids[
-                        w.chunk_start : w.chunk_start + w.chunk_len
-                    ]
-                    token_dev, logits = self.runner.prefill(
-                        chunk,
-                        start_pos=w.chunk_start,
-                        block_table=seq.block_table,
-                        total_len=w.chunk_start + w.chunk_len,
-                        lora_slot=self._lora_slot(seq),
-                        sampling=sampling,
-                    )
-                    tokens_dev = token_dev[None]
-                    last_logits[std_works[0][0]] = logits
-                else:
-                    # packed cross-sequence prefill: one dispatch covers
-                    # every scheduled chunk (burst-TTFT fix)
-                    tokens_dev, logits = self.runner.prefill_batch(
-                        [
-                            w.seq.prompt_token_ids[
-                                w.chunk_start : w.chunk_start + w.chunk_len
-                            ]
-                            for w in sworks
-                        ],
-                        start_positions=[w.chunk_start for w in sworks],
-                        block_tables=[w.seq.block_table for w in sworks],
-                        total_lens=[
-                            w.chunk_start + w.chunk_len for w in sworks
-                        ],
-                        lora_slots=[
-                            self._lora_slot(w.seq) for w in sworks
-                        ],
-                        sampling=sampling,
-                    )
-                    for j, (i, _) in enumerate(std_works):
-                        last_logits[i] = logits[j]
-                # ONE fetch for the whole std group's sampled tokens
-                if any(w.is_last_chunk for w in sworks):
-                    toks_np = np.asarray(tokens_dev)
-                    for j, (i, _) in enumerate(std_works):
-                        tok_of[i] = int(toks_np[j])
-            for i, w in enumerate(works):
-                w.seq.num_computed_tokens += w.chunk_len
-                self._prompt_tokens_total += w.chunk_len
-            finals = [
-                (i, w) for i, w in enumerate(works) if w.is_last_chunk
-            ]
-            if finals:
-                # first tokens were sampled ON DEVICE inside the prefill
-                # program — the host fetches (s_pad,) int32 instead of
-                # (s_pad, vocab) f32 logits. Only a post-preemption
-                # sequence with active penalties (its generated history
-                # is folded into the prompt, so penalty counts are
-                # non-empty at the "first" token) needs the logits.
-                def _needs_host_sample(s: Sequence) -> bool:
-                    sp = s.sampling_params
-                    if self._is_guided(s):
-                        return True  # first token must be masked
-                    if sp.logit_bias:
-                        return True  # on-device sample knows no bias
-                    return bool(s.generated_token_ids) and (
-                        sp.presence_penalty != 0.0
-                        or sp.frequency_penalty != 0.0
-                        or sp.repetition_penalty != 1.0
-                    )
-
-                pen = [(i, w) for i, w in finals
-                       if _needs_host_sample(w.seq)]
-                clean = [(i, w) for i, w in finals
-                         if not _needs_host_sample(w.seq)]
-                if clean:
-                    for i, w in clean:
-                        entry = None
-                        n = w.seq.sampling_params.logprobs
-                        if n is not None:
-                            entry = self._host_logprob_entry(
-                                np.asarray(last_logits[i]),
-                                tok_of[i], n,
-                            )
-                        self._append_token(w.seq, tok_of[i], entry)
-                        stepped.append(w.seq)
-                if pen:
-                    fl = jnp.stack([last_logits[i] for i, _ in pen])
-                    sampled, used_logits = self._sample(
-                        [w.seq for _, w in pen], fl, return_logits=True
-                    )
-                    used_logits = np.asarray(used_logits)
-                    for j, ((i, w), token) in enumerate(
-                        zip(pen, sampled)
-                    ):
-                        entry = None
-                        n = w.seq.sampling_params.logprobs
-                        if n is not None:
-                            entry = self._host_logprob_entry(
-                                used_logits[j], int(token), n
-                            )
-                        self._append_token(w.seq, int(token), entry)
-                        stepped.append(w.seq)
+            # chain cap: one engine.step() holds the server's step lock,
+            # so an unbounded chain would freeze add_request/abort (and
+            # with them the whole HTTP loop) for a very long prompt's
+            # entire prefill. Bounded, the remaining chunks keep
+            # draining via staged zero-cost admission on later rounds.
+            chain_budget = self.scheduler.config.max_staged_prefill_run
+            while True:
+                stepped.extend(self._run_prefill_works(works, staged))
+                staged = None
+                if chain_budget <= 0:
+                    break
+                nxt = self._chain_next_prefill(works)
+                if nxt is None:
+                    break
+                chain_budget -= 1
+                self._pf_chained_chunks_total += len(nxt)
+                works = nxt
+            self._maybe_stage_prefill(works)
         elif sched_out.decode is not None:
             seqs = sched_out.decode.seqs
             if self._spec_enabled:
@@ -756,7 +662,20 @@ class LLMEngine:
             guided_tables = None
             needs_guided = any(self._is_guided(s) for s in seqs)
             if needs_guided and k_steps > 1:
-                guided_tables = self._device_guided_tables(seqs)
+                # leave the fused path when any guided lane is close to
+                # its token budget: the final steps need budget-aware
+                # completion steering (_steer_allowed), which only the
+                # host-masked path evaluates. Parity with K=1 holds —
+                # unsteered steps mask identically on both paths.
+                near_budget = any(
+                    self._is_guided(s)
+                    and (s.sampling_params.max_tokens
+                         - len(s.generated_token_ids))
+                    <= k_steps + self.GUIDED_STEER_BOUND
+                    for s in seqs
+                )
+                if not near_budget:
+                    guided_tables = self._device_guided_tables(seqs)
             if k_steps > 1 and (not needs_guided
                                 or guided_tables is not None):
                 temps, top_ps, top_ks, min_ps, keys, needs_pen = (
@@ -871,6 +790,313 @@ class LLMEngine:
 
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
+
+    # -- pipelined prefill --------------------------------------------------
+    def _prefill_fingerprint(self, works: list[PrefillWork]) -> tuple:
+        """State a staged prefill buffer was built for, as observed at
+        dispatch: same sequences in the same order at the same chunk
+        offsets, block tables untouched (length + the allocator's free
+        epoch — freed ids can be re-handed to another sequence), and no
+        tokens appended since the stage (the sampling keys depend on
+        generated_len)."""
+        return (
+            tuple(w.seq.request_id for w in works),
+            tuple(w.chunk_start for w in works),
+            tuple(w.chunk_len for w in works),
+            tuple(len(w.seq.block_table) for w in works),
+            tuple(len(w.seq.generated_token_ids) for w in works),
+            self.block_manager.free_epoch,
+        )
+
+    def _next_prefill_works(
+        self, works: list[PrefillWork]
+    ) -> list[PrefillWork]:
+        """Predicted next chunk set after `works` completes: the same
+        sequences (order kept) that still have prompt left. prompt_
+        logprobs sequences are excluded — their per-chunk host fetches
+        serialize anyway."""
+        nxt: list[PrefillWork] = []
+        chunked = self.scheduler.config.enable_chunked_prefill
+        for w in works:
+            s = w.seq
+            if s.finished or s not in self.scheduler.running:
+                continue
+            if s.sampling_params.prompt_logprobs is not None:
+                continue
+            rem = s.num_uncomputed_prompt_tokens
+            if rem <= 0:
+                continue
+            clen = (
+                min(rem, self.scheduler.config.max_prefill_chunk)
+                if chunked else rem
+            )
+            nxt.append(PrefillWork(
+                seq=s, chunk_start=s.num_computed_tokens, chunk_len=clen,
+            ))
+        return nxt
+
+    def _chain_next_prefill(
+        self, works: list[PrefillWork]
+    ) -> list[PrefillWork] | None:
+        """Chained multi-chunk dispatch: when every scheduled chunk was
+        non-final and NOTHING is decode-ready or waiting, the group's
+        next chunks run in this same engine round — the host round-trip
+        (scheduler pass + an interleaved decode's blocking fetch)
+        between consecutive chunks of a cold prompt disappears, and each
+        chunk's packed upload overlaps the previous chunk's device
+        compute (the dispatches are async enqueues). Only the final
+        chunk's sampled token is ever fetched."""
+        if not self._prefill_pipeline:
+            return None
+        if any(w.is_last_chunk for w in works):
+            return None  # finals made their seqs decode-ready
+        if any(
+            w.seq.sampling_params.prompt_logprobs is not None
+            for w in works
+        ):
+            return None
+        if self.scheduler.waiting:
+            return None  # admission may pack new arrivals into the group
+        if any(
+            s.prefill_done and not s.finished
+            for s in self.scheduler.running
+        ):
+            return None  # a decode stream would be starved: interleave
+        nxt = self._next_prefill_works(works)
+        return nxt or None
+
+    def _maybe_stage_prefill(self, works: list[PrefillWork]) -> None:
+        """Stage the predicted next chunk group's packed buffer so its
+        h2d transfer rides out the interleaved decode round instead of
+        sitting serially before the next prefill dispatch. Validated by
+        fingerprint before use; single-device only (a mesh would have to
+        reshard the committed transfer)."""
+        if not self._prefill_pipeline or self.runner.mesh is not None:
+            return
+        if self.scheduler.waiting:
+            return  # the next group will include new admissions: miss
+        nxt = self._next_prefill_works(works)
+        if not nxt:
+            return
+        seqs = [w.seq for w in nxt]
+        temps, top_ps, top_ks, min_ps, keys, _ = (
+            self._sampling_arrays(seqs)
+        )
+        sampling = (temps, top_ps, top_ks, min_ps, keys)
+        if len(nxt) == 1:
+            w = nxt[0]
+            handle = self.runner.stage_prefill(
+                w.seq.prompt_token_ids[
+                    w.chunk_start : w.chunk_start + w.chunk_len
+                ],
+                w.chunk_start,
+                w.seq.block_table,
+                w.chunk_start + w.chunk_len,
+                sampling=sampling,
+            )
+        else:
+            handle = self.runner.stage_prefill_batch(
+                [
+                    w.seq.prompt_token_ids[
+                        w.chunk_start : w.chunk_start + w.chunk_len
+                    ]
+                    for w in nxt
+                ],
+                start_positions=[w.chunk_start for w in nxt],
+                block_tables=[w.seq.block_table for w in nxt],
+                total_lens=[w.chunk_start + w.chunk_len for w in nxt],
+                sampling=sampling,
+            )
+        self._staged_prefill = {
+            "fp": self._prefill_fingerprint(nxt),
+            "handle": handle,
+        }
+        self.scheduler.staged_prefill_ready = True
+
+    def _run_prefill_works(
+        self, works: list[PrefillWork], staged: dict | None = None,
+    ) -> list[Sequence]:
+        """Dispatch one scheduled prefill chunk group (the body of the
+        prefill step): prompt_logprobs sequences on the single-sequence
+        program variant, everything else in one packed dispatch, first
+        tokens appended for final chunks. Returns the stepped sequences.
+        `staged` = a _maybe_stage_prefill record; used when its
+        fingerprint matches this exact group."""
+        stepped: list[Sequence] = []
+        now = time.time()
+        for w in works:
+            if w.seq.metrics.first_scheduled_time is None:
+                w.seq.metrics.first_scheduled_time = now
+        staged_kw = {}
+        if staged is not None:
+            if staged["fp"] == self._prefill_fingerprint(works):
+                # the prediction held: the packed buffer is already on
+                # device — zero serial h2d for this dispatch
+                staged_kw = {"staged": staged["handle"]}
+                self._pf_staged_hits_total += 1
+            else:
+                self._pf_staged_misses_total += 1
+                self.scheduler.note_staged_prefill_miss()
+        # prompt_logprobs requests take the single-sequence program
+        # variant (every row's distribution scored on device); they
+        # never pack — their per-row outputs are per-sequence
+        plp_works = [
+            (i, w) for i, w in enumerate(works)
+            if w.seq.sampling_params.prompt_logprobs is not None
+        ]
+        std_works = [
+            (i, w) for i, w in enumerate(works)
+            if w.seq.sampling_params.prompt_logprobs is None
+        ]
+        last_logits: dict[int, object] = {}
+        tok_of: dict[int, int] = {}  # original idx -> sampled token
+        for i, w in plp_works:
+            seq = w.seq
+            chunk = seq.prompt_token_ids[
+                w.chunk_start : w.chunk_start + w.chunk_len
+            ]
+            # row j scores the NEXT prompt token; the final chunk's
+            # last row has none (its continuation is generated)
+            tgts = seq.prompt_token_ids[
+                w.chunk_start + 1 : w.chunk_start + w.chunk_len + 1
+            ]
+            t1, p1, k1, m1, keys1, _ = self._sampling_arrays([seq])
+            token_dev, logits, chosen, tv, ti = self.runner.prefill(
+                chunk,
+                start_pos=w.chunk_start,
+                block_table=seq.block_table,
+                total_len=w.chunk_start + w.chunk_len,
+                lora_slot=self._lora_slot(seq),
+                sampling=(t1, p1, k1, m1, keys1),
+                prompt_lp_targets=[int(x) for x in tgts],
+            )
+            tf = time.perf_counter()
+            tok_of[i] = int(np.asarray(token_dev))
+            chosen, tv, ti = (
+                np.asarray(chosen), np.asarray(tv), np.asarray(ti)
+            )
+            self.runner._phase_add(
+                "fetch", time.perf_counter() - tf
+            )
+            last_logits[i] = logits
+            self._accumulate_prompt_lps(
+                seq, w.chunk_start, tgts, chosen, tv, ti,
+            )
+        if std_works:
+            sworks = [w for _, w in std_works]
+            seqs_w = [w.seq for w in sworks]
+            temps, top_ps, top_ks, min_ps, keys, _ = (
+                self._sampling_arrays(seqs_w)
+            )
+            sampling = (temps, top_ps, top_ks, min_ps, keys)
+            if len(sworks) == 1:
+                # single-sequence path keeps the round-2 buckets
+                w = sworks[0]
+                seq = w.seq
+                chunk = seq.prompt_token_ids[
+                    w.chunk_start : w.chunk_start + w.chunk_len
+                ]
+                token_dev, logits = self.runner.prefill(
+                    chunk,
+                    start_pos=w.chunk_start,
+                    block_table=seq.block_table,
+                    total_len=w.chunk_start + w.chunk_len,
+                    lora_slot=self._lora_slot(seq),
+                    sampling=sampling,
+                    **staged_kw,
+                )
+                tokens_dev = token_dev[None]
+                last_logits[std_works[0][0]] = logits
+            else:
+                # packed cross-sequence prefill: one dispatch covers
+                # every scheduled chunk (burst-TTFT fix)
+                tokens_dev, logits = self.runner.prefill_batch(
+                    [
+                        w.seq.prompt_token_ids[
+                            w.chunk_start : w.chunk_start + w.chunk_len
+                        ]
+                        for w in sworks
+                    ],
+                    start_positions=[w.chunk_start for w in sworks],
+                    block_tables=[w.seq.block_table for w in sworks],
+                    total_lens=[
+                        w.chunk_start + w.chunk_len for w in sworks
+                    ],
+                    lora_slots=[
+                        self._lora_slot(w.seq) for w in sworks
+                    ],
+                    sampling=sampling,
+                    **staged_kw,
+                )
+                for j, (i, _) in enumerate(std_works):
+                    last_logits[i] = logits[j]
+            # ONE fetch for the whole std group's sampled tokens
+            if any(w.is_last_chunk for w in sworks):
+                tf = time.perf_counter()
+                toks_np = np.asarray(tokens_dev)
+                self.runner._phase_add(
+                    "fetch", time.perf_counter() - tf
+                )
+                for j, (i, _) in enumerate(std_works):
+                    tok_of[i] = int(toks_np[j])
+        for i, w in enumerate(works):
+            w.seq.num_computed_tokens += w.chunk_len
+            self._prompt_tokens_total += w.chunk_len
+        finals = [
+            (i, w) for i, w in enumerate(works) if w.is_last_chunk
+        ]
+        if finals:
+            # first tokens were sampled ON DEVICE inside the prefill
+            # program — the host fetches (s_pad,) int32 instead of
+            # (s_pad, vocab) f32 logits. Only a post-preemption
+            # sequence with active penalties (its generated history
+            # is folded into the prompt, so penalty counts are
+            # non-empty at the "first" token) needs the logits.
+            def _needs_host_sample(s: Sequence) -> bool:
+                sp = s.sampling_params
+                if self._is_guided(s):
+                    return True  # first token must be masked
+                if sp.logit_bias:
+                    return True  # on-device sample knows no bias
+                return bool(s.generated_token_ids) and (
+                    sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0
+                    or sp.repetition_penalty != 1.0
+                )
+
+            pen = [(i, w) for i, w in finals
+                   if _needs_host_sample(w.seq)]
+            clean = [(i, w) for i, w in finals
+                     if not _needs_host_sample(w.seq)]
+            if clean:
+                for i, w in clean:
+                    entry = None
+                    n = w.seq.sampling_params.logprobs
+                    if n is not None:
+                        entry = self._host_logprob_entry(
+                            np.asarray(last_logits[i]),
+                            tok_of[i], n,
+                        )
+                    self._append_token(w.seq, tok_of[i], entry)
+                    stepped.append(w.seq)
+            if pen:
+                fl = jnp.stack([last_logits[i] for i, _ in pen])
+                sampled, used_logits = self._sample(
+                    [w.seq for _, w in pen], fl, return_logits=True
+                )
+                used_logits = np.asarray(used_logits)
+                for j, ((i, w), token) in enumerate(
+                    zip(pen, sampled)
+                ):
+                    entry = None
+                    n = w.seq.sampling_params.logprobs
+                    if n is not None:
+                        entry = self._host_logprob_entry(
+                            used_logits[j], int(token), n
+                        )
+                    self._append_token(w.seq, int(token), entry)
+                    stepped.append(w.seq)
+        return stepped
 
     # -- speculative decoding (prompt-lookup n-gram drafts) ----------------
     # haystack bound for prompt-lookup: the scan runs per lane per step
@@ -1128,6 +1354,20 @@ class LLMEngine:
                     {int(seq.eos_token_id)}
                     if seq.eos_token_id is not None else set()
                 )
+            # budget-aware completion steering: with only a few budget
+            # tokens left, keep only moves from which the machine can
+            # still reach an accepting state within what remains —
+            # otherwise a greedy model rides a repeatable construct
+            # ("ab+c", ("," [0-9])*) straight past max_tokens and the
+            # stream ends non-conforming
+            remaining = (seq.sampling_params.max_tokens
+                         - len(seq.generated_token_ids))
+            if 0 < remaining <= self.GUIDED_STEER_BOUND:
+                steered = self._steer_allowed(
+                    machine, states, allowed, remaining
+                )
+                if steered is not None:
+                    allowed = steered
             if machine.accepting(states) and seq.eos_token_id is not None:
                 allowed.add(int(seq.eos_token_id))
             if not allowed and seq.eos_token_id is not None:
@@ -1153,6 +1393,105 @@ class LLMEngine:
             # choice unreachable
             allowed.add(int(seq.eos_token_id))
         return allowed
+
+    # budget window (tokens) in which constraint steering engages; also
+    # the margin by which guided lanes leave the fused device path so
+    # their final steered steps run host-masked (K-step parity holds:
+    # unsteered steps mask identically on both paths)
+    GUIDED_STEER_BOUND = 8
+    # frontier cap for the completion-distance search: a node offering
+    # more distinct next strings than this (e.g. a JSON machine inside a
+    # free-form string) is too wide to steer — give up rather than burn
+    # the step loop
+    GUIDED_STEER_FANOUT = 128
+
+    def _dist_to_accept(self, machine, states, cap: int) -> int | None:
+        """Shortest number of further tokens from `states` to an
+        accepting state (token-level BFS, deduped by token STRING), or
+        None when no accepting state is reachable within `cap` tokens
+        or the frontier is too wide to search. Memoized per LIVE
+        machine object (weak-keyed, so a finished request's machine
+        takes its entries with it and a recycled address can never
+        serve another grammar's distances); steering only runs in the
+        final GUIDED_STEER_BOUND tokens of a request, so each
+        machine's memo stays tiny."""
+        import weakref
+
+        memos = getattr(self, "_guided_dist_memo", None)
+        if memos is None:
+            memos = weakref.WeakKeyDictionary()
+            self._guided_dist_memo = memos
+        memo = memos.get(machine)
+        if memo is None:
+            memo = {}
+            memos[machine] = memo
+        cached = memo.get(states)
+        if cached is not None:
+            dist, searched_cap = cached
+            if dist is not None or cap <= searched_cap:
+                return dist
+        mc = self._mask_cache()
+        if machine.accepting(states):
+            memo[states] = (0, cap)
+            return 0
+        seen = {states}
+        frontier = [states]
+        for d in range(1, cap + 1):
+            nxt = []
+            for st in frontier:
+                try:
+                    allowed = mc.allowed(machine, st)
+                except ValueError:
+                    continue  # diverging constraint: unsearchable here
+                strs = {mc.token_str(t) for t in allowed}
+                strs.discard("")
+                if len(strs) > self.GUIDED_STEER_FANOUT:
+                    memo[states] = (None, cap)
+                    return None
+                for s in strs:
+                    try:
+                        ns = machine.step_str(st, s)
+                    except ValueError:
+                        continue
+                    if not ns or ns in seen:
+                        continue
+                    if machine.accepting(ns):
+                        memo[states] = (d, cap)
+                        return d
+                    seen.add(ns)
+                    nxt.append(ns)
+            if not nxt:
+                break
+            frontier = nxt
+        memo[states] = (None, cap)
+        return None
+
+    def _steer_allowed(
+        self, machine, states, allowed: set[int], remaining: int,
+    ) -> set[int] | None:
+        """Subset of `allowed` whose successor states can still accept
+        within `remaining - 1` further tokens, or None when steering is
+        infeasible (search too wide / nothing completes) — the caller
+        then keeps the unsteered mask."""
+        mc = self._mask_cache()
+        by_str: dict[str, list[int]] = {}
+        for t in allowed:
+            by_str.setdefault(mc.token_str(t), []).append(t)
+        by_str.pop("", None)
+        if len(by_str) > self.GUIDED_STEER_FANOUT:
+            return None
+        keep: set[int] = set()
+        for s, ids in by_str.items():
+            try:
+                ns = machine.step_str(states, s)
+            except ValueError:
+                continue
+            if not ns:
+                continue
+            d = self._dist_to_accept(machine, ns, remaining - 1)
+            if d is not None and d <= remaining - 1:
+                keep.update(ids)
+        return keep or None
 
     def _device_guided_tables(self, seqs: list[Sequence]):
         """Assemble TokenDFA tables for a batch with guided lanes so the
@@ -1620,6 +1959,21 @@ class LLMEngine:
             requests_finished_total=self._finished_total,
             spec_draft_tokens_total=self._spec_drafts_total,
             spec_accepted_tokens_total=self._spec_accepted_total,
+            prefill_prep_seconds_total=(
+                self.runner.prefill_phase_s["prep"]
+            ),
+            prefill_h2d_seconds_total=(
+                self.runner.prefill_phase_s["h2d"]
+            ),
+            prefill_dispatch_seconds_total=(
+                self.runner.prefill_phase_s["dispatch"]
+            ),
+            prefill_fetch_seconds_total=(
+                self.runner.prefill_phase_s["fetch"]
+            ),
+            prefill_staged_hits_total=self._pf_staged_hits_total,
+            prefill_staged_misses_total=self._pf_staged_misses_total,
+            prefill_chained_chunks_total=self._pf_chained_chunks_total,
         )
 
     # -- offline convenience (tests, benchmarks) ---------------------------

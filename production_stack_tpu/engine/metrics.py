@@ -84,6 +84,41 @@ class EngineMetrics:
             "vllm:spec_decode_num_accepted_tokens",
             "Speculative draft tokens accepted", label, registry=reg,
         )
+        # pipelined-prefill attribution (tpu-native): wall seconds per
+        # phase of the prefill dispatch path + staging effectiveness,
+        # so a dashboard can see WHERE prefill time goes (prep / h2d /
+        # dispatch / fetch) and whether the h2d overlap is landing
+        self.prefill_prep_s = Counter(
+            "tpu:prefill_prep_seconds", "Prefill host-prep wall time",
+            label, registry=reg,
+        )
+        self.prefill_h2d_s = Counter(
+            "tpu:prefill_h2d_seconds",
+            "Prefill host->device upload wall time", label, registry=reg,
+        )
+        self.prefill_dispatch_s = Counter(
+            "tpu:prefill_dispatch_seconds",
+            "Prefill dispatch-enqueue wall time", label, registry=reg,
+        )
+        self.prefill_fetch_s = Counter(
+            "tpu:prefill_fetch_seconds",
+            "Prefill device->host fetch wall time", label, registry=reg,
+        )
+        self.prefill_staged_hits = Counter(
+            "tpu:prefill_staged_hits",
+            "Prefill dispatches served from a pre-uploaded staged "
+            "buffer", label, registry=reg,
+        )
+        self.prefill_staged_misses = Counter(
+            "tpu:prefill_staged_misses",
+            "Staged prefill buffers invalidated before dispatch",
+            label, registry=reg,
+        )
+        self.prefill_chained_chunks = Counter(
+            "tpu:prefill_chained_chunks",
+            "Prefill chunks dispatched via cold-prompt chaining "
+            "(no host round-trip between chunks)", label, registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -130,6 +165,27 @@ class EngineMetrics:
             max(0, s.spec_accepted_tokens_total
                 - prev.spec_accepted_tokens_total)
         )
+        self.prefill_prep_s.labels(m).inc(max(
+            0.0, s.prefill_prep_seconds_total
+            - prev.prefill_prep_seconds_total))
+        self.prefill_h2d_s.labels(m).inc(max(
+            0.0, s.prefill_h2d_seconds_total
+            - prev.prefill_h2d_seconds_total))
+        self.prefill_dispatch_s.labels(m).inc(max(
+            0.0, s.prefill_dispatch_seconds_total
+            - prev.prefill_dispatch_seconds_total))
+        self.prefill_fetch_s.labels(m).inc(max(
+            0.0, s.prefill_fetch_seconds_total
+            - prev.prefill_fetch_seconds_total))
+        self.prefill_staged_hits.labels(m).inc(max(
+            0, s.prefill_staged_hits_total
+            - prev.prefill_staged_hits_total))
+        self.prefill_staged_misses.labels(m).inc(max(
+            0, s.prefill_staged_misses_total
+            - prev.prefill_staged_misses_total))
+        self.prefill_chained_chunks.labels(m).inc(max(
+            0, s.prefill_chained_chunks_total
+            - prev.prefill_chained_chunks_total))
         self._counter_state = s
 
     def observe_request(

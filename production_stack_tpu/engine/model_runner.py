@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import jax
 import jax.numpy as jnp
@@ -213,6 +214,28 @@ class ModelRunner:
         # can pull them to the host for sampling (shards on follower hosts
         # are not addressable from host 0)
         self.replicate_logits = bool(config.multihost)
+
+        # pipelined prefill (one packed h2d buffer per dispatch +
+        # staged uploads): program variants take the fused buffer.
+        # Single-device only: the fused-buffer transport targets the
+        # tunneled single-chip link, and under a pp/tp mesh the packed
+        # operand's inferred sharding trips SPMD partitioning (observed:
+        # "PartitionId instruction is not supported" under pp x tp) —
+        # meshed engines keep the per-array upload path
+        self.prefill_pipeline = (
+            bool(config.prefill_pipeline) and self.mesh is None
+        )
+        # per-phase prefill wall time (seconds) + dispatch counts, fed
+        # to /metrics and the bench attribution slots: prep = host array
+        # build, h2d = upload enqueue (staged uploads overlap compute
+        # but still count — they are real link work), dispatch = jitted
+        # call enqueue, fetch = device->host reads (engine-side)
+        self.prefill_phase_s = {
+            "prep": 0.0, "h2d": 0.0, "dispatch": 0.0, "fetch": 0.0,
+        }
+        self.prefill_phase_n = {
+            "prep": 0, "h2d": 0, "dispatch": 0, "fetch": 0,
+        }
 
         # jit caches keyed by bucket tuple
         self._prefill_fns: dict[tuple[int, int], object] = {}
@@ -422,6 +445,206 @@ class ModelRunner:
             gather_slots = self._gather_slots_for_table(block_table, c_pad)
         return tokens, positions_dev, write_slots, gather_slots, t_pad, c_pad
 
+    # -- pipelined prefill: fused h2d buffer --------------------------------
+    def _phase_add(self, name: str, dt: float) -> None:
+        self.prefill_phase_s[name] += dt
+        self.prefill_phase_n[name] += 1
+
+    @staticmethod
+    def _layout_of(fields: list[tuple[str, tuple[int, ...]]]):
+        layout: dict[str, tuple[int, tuple[int, ...]]] = {}
+        off = 0
+        for name, shape in fields:
+            layout[name] = (off, shape)
+            off += int(np.prod(shape))
+        return layout, off
+
+    def _prefill_pack_layout(self, t_pad: int, c_pad: int,
+                             want_plp: bool = False):
+        """Static layout of the ONE int32 host->device buffer a
+        single-sequence prefill dispatch ships (mirror of
+        _decode_pack_layout: through a tunneled chip every separate
+        buffer creation pays link latency, so the ~8 small per-dispatch
+        arrays fuse into one transfer; f32/u32 fields travel bitcast)."""
+        g_shape = (
+            (c_pad // self.block_size,)
+            if self.attention_impl == "pallas" else (c_pad,)
+        )
+        fields = [
+            ("tokens", (t_pad,)),
+            ("positions", (t_pad,)),
+            ("write_slots", (t_pad,)),
+            ("gather_slots", g_shape),
+            ("total_len", (1,)),
+            ("last_row", (1,)),
+            ("temps", (1,)),
+            ("top_ps", (1,)),
+            ("top_ks", (1,)),
+            ("min_ps", (1,)),
+            ("keys", (1, 2)),
+        ]
+        if want_plp:
+            fields.append(("targets", (t_pad,)))
+        return self._layout_of(fields)
+
+    def _packed_prefill_pack_layout(self, s_pad: int, t_pad: int,
+                                    c_pad: int):
+        """Packed cross-sequence variant of _prefill_pack_layout."""
+        tab_shape = (
+            (s_pad, c_pad // self.block_size)
+            if self.attention_impl == "pallas" else (s_pad, c_pad)
+        )
+        fields = [
+            ("tokens", (s_pad * t_pad,)),
+            ("positions", (s_pad * t_pad,)),
+            ("write_slots", (s_pad * t_pad,)),
+            ("tables", tab_shape),
+            ("q_starts", (s_pad,)),
+            ("total_lens", (s_pad,)),
+            ("last_rows", (s_pad,)),
+            ("temps", (s_pad,)),
+            ("top_ps", (s_pad,)),
+            ("top_ks", (s_pad,)),
+            ("min_ps", (s_pad,)),
+            ("keys", (s_pad, 2)),
+        ]
+        return self._layout_of(fields)
+
+    @staticmethod
+    def _pack_put(packed: np.ndarray, layout: dict, name: str,
+                  arr: np.ndarray) -> None:
+        off, shape = layout[name]
+        n = int(np.prod(shape))
+        packed[off:off + n] = np.asarray(arr).reshape(-1).view(np.int32)
+
+    @staticmethod
+    def _pack_seg(packed, layout: dict, name: str):
+        """Device-side static-slice read of one packed-buffer field
+        (the unpack mirror of _pack_put), shared by every fused-buffer
+        step builder."""
+        off, shape = layout[name]
+        n = int(np.prod(shape))
+        return packed[off:off + n].reshape(shape)
+
+    def _fill_prefill_pack(
+        self, token_ids: list[int], start_pos: int,
+        block_table: list[int], total_len: int, sampling=None,
+        prompt_lp_targets: list[int] | None = None,
+    ) -> tuple[int, int, np.ndarray]:
+        """Host-side build of the single-sequence prefill pack; returns
+        (t_pad, c_pad, packed)."""
+        t = len(token_ids)
+        (tokens, positions_dev, write_slots, gather_slots,
+         t_pad, c_pad) = self._prefill_host_prep(
+            token_ids, block_table, start_pos, total_len
+        )
+        want_plp = prompt_lp_targets is not None
+        layout, size = self._prefill_pack_layout(t_pad, c_pad, want_plp)
+        packed = np.zeros((size,), np.int32)
+        put = functools.partial(self._pack_put, packed, layout)
+        put("tokens", tokens)
+        put("positions", positions_dev)
+        put("write_slots", write_slots)
+        put("gather_slots", gather_slots)
+        put("total_len", np.asarray([total_len], np.int32))
+        put("last_row", np.asarray([t - 1], np.int32))
+        temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
+            1, sampling
+        )
+        put("temps", temps)
+        put("top_ps", top_ps)
+        put("top_ks", top_ks)
+        put("min_ps", min_ps)
+        put("keys", keys)
+        if want_plp:
+            tg = np.full((t_pad,), -1, np.int32)
+            tg[: len(prompt_lp_targets)] = prompt_lp_targets
+            put("targets", tg)
+        return t_pad, c_pad, packed
+
+    def _fill_packed_prefill_pack(
+        self,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+        sampling=None,
+    ) -> tuple[int, int, int, np.ndarray]:
+        """Host-side build of the packed cross-sequence prefill pack;
+        returns (s_pad, t_pad, c_pad, packed)."""
+        n = len(chunks)
+        (s_pad, t_pad, c_pad, tokens, positions_dev, write_slots,
+         q_starts, tl_full, tables) = self._packed_host_prep(
+            chunks, start_positions, block_tables, total_lens
+        )
+        last_rows = np.zeros((s_pad,), dtype=np.int32)
+        for s, ids in enumerate(chunks):
+            last_rows[s] = s * t_pad + (len(ids) - 1)
+        for s in range(n, s_pad):
+            last_rows[s] = s * t_pad
+        layout, size = self._packed_prefill_pack_layout(
+            s_pad, t_pad, c_pad
+        )
+        packed = np.zeros((size,), np.int32)
+        put = functools.partial(self._pack_put, packed, layout)
+        put("tokens", tokens.reshape(-1))
+        put("positions", positions_dev.reshape(-1))
+        put("write_slots", write_slots.reshape(-1))
+        put("tables", tables)
+        put("q_starts", q_starts)
+        put("total_lens", tl_full)
+        put("last_rows", last_rows)
+        temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
+            s_pad, sampling
+        )
+        put("temps", temps)
+        put("top_ps", top_ps)
+        put("top_ks", top_ks)
+        put("min_ps", min_ps)
+        put("keys", keys)
+        return s_pad, t_pad, c_pad, packed
+
+    def stage_prefill(
+        self, token_ids: list[int], start_pos: int,
+        block_table: list[int], total_len: int, sampling=None,
+    ) -> tuple:
+        """Speculative h2d prefetch for a FUTURE prefill chunk: build
+        the packed buffer and START its async host->device transfer now
+        so the upload overlaps the in-flight dispatch's compute instead
+        of sitting serially before the next one (prefill mirror of
+        stage_decode_multi). Returns a handle for prefill(staged=...);
+        the caller (engine) validates its fingerprint before use."""
+        t0 = time.perf_counter()
+        t_pad, c_pad, packed = self._fill_prefill_pack(
+            token_ids, start_pos, block_table, total_len,
+            sampling=sampling,
+        )
+        t1 = time.perf_counter()
+        self._phase_add("prep", t1 - t0)
+        handle = (("single", t_pad, c_pad), jax.device_put(packed))
+        self._phase_add("h2d", time.perf_counter() - t1)
+        return handle
+
+    def stage_prefill_batch(
+        self,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+        sampling=None,
+    ) -> tuple:
+        """Packed-group variant of stage_prefill."""
+        t0 = time.perf_counter()
+        s_pad, t_pad, c_pad, packed = self._fill_packed_prefill_pack(
+            chunks, start_positions, block_tables, total_lens,
+            sampling=sampling,
+        )
+        t1 = time.perf_counter()
+        self._phase_add("prep", t1 - t0)
+        handle = (("packed", s_pad, t_pad, c_pad), jax.device_put(packed))
+        self._phase_add("h2d", time.perf_counter() - t1)
+        return handle
+
     def _build_prefill(self, t_pad: int, c_pad: int,
                        want_prompt_lp: bool = False):
         mc = self.model_config
@@ -478,9 +701,49 @@ class ModelRunner:
             return (token, last_logits, chosen, top_vals, top_ids,
                     kc, vc)
 
-        return jax.jit(step, donate_argnums=(1, 2),
-                       **self._step_jit_kwargs(2 if not want_prompt_lp
-                                               else 5))
+        jit_kw = self._step_jit_kwargs(2 if not want_prompt_lp else 5)
+        if not self.prefill_pipeline:
+            return jax.jit(step, donate_argnums=(1, 2), **jit_kw)
+
+        # pipelined variant: ONE fused i32 operand instead of ~8 small
+        # h2d transfers (layout shared with the host build,
+        # _prefill_pack_layout); unpack on device then run the SAME step
+        layout, _size = self._prefill_pack_layout(
+            t_pad, c_pad, want_prompt_lp
+        )
+
+        def _seg(packed, name, _lo=layout):
+            return self._pack_seg(packed, _lo, name)
+
+        def packed_step(params, kc, vc, packed, lora=None,
+                        lora_slots=None):
+            def f32(name):
+                return jax.lax.bitcast_convert_type(
+                    _seg(packed, name), jnp.float32
+                )
+
+            plp_kw = (
+                {"targets": _seg(packed, "targets")}
+                if want_prompt_lp else {}
+            )
+            return step(
+                params, kc, vc,
+                _seg(packed, "tokens"),
+                _seg(packed, "positions"),
+                _seg(packed, "write_slots"),
+                _seg(packed, "gather_slots"),
+                _seg(packed, "total_len")[0],
+                _seg(packed, "last_row")[0],
+                f32("temps"), f32("top_ps"),
+                _seg(packed, "top_ks"), f32("min_ps"),
+                jax.lax.bitcast_convert_type(
+                    _seg(packed, "keys"), jnp.uint32
+                ),
+                lora=lora, lora_slots=lora_slots,
+                **plp_kw,
+            )
+
+        return jax.jit(packed_step, donate_argnums=(1, 2), **jit_kw)
 
     def _build_verify_batch(self, s_pad: int, t_pad: int, c_pad: int):
         """Batched speculative verification: s_pad lanes' draft chunks
@@ -770,8 +1033,43 @@ class ModelRunner:
                                     min_p=min_ps)
             return sampled, logits, kc, vc
 
-        return jax.jit(step, donate_argnums=(1, 2),
-                       **self._step_jit_kwargs(2))
+        jit_kw = self._step_jit_kwargs(2)
+        if not self.prefill_pipeline:
+            return jax.jit(step, donate_argnums=(1, 2), **jit_kw)
+
+        # pipelined variant: one fused i32 operand (see _build_prefill)
+        layout, _size = self._packed_prefill_pack_layout(
+            s_pad, t_pad, c_pad
+        )
+
+        def _seg(packed, name, _lo=layout):
+            return self._pack_seg(packed, _lo, name)
+
+        def packed_step(params, kc, vc, packed, lora=None,
+                        lora_slots=None):
+            def f32(name):
+                return jax.lax.bitcast_convert_type(
+                    _seg(packed, name), jnp.float32
+                )
+
+            return step(
+                params, kc, vc,
+                _seg(packed, "tokens"),
+                _seg(packed, "positions"),
+                _seg(packed, "write_slots"),
+                _seg(packed, "tables"),
+                _seg(packed, "q_starts"),
+                _seg(packed, "total_lens"),
+                _seg(packed, "last_rows"),
+                f32("temps"), f32("top_ps"),
+                _seg(packed, "top_ks"), f32("min_ps"),
+                jax.lax.bitcast_convert_type(
+                    _seg(packed, "keys"), jnp.uint32
+                ),
+                lora=lora, lora_slots=lora_slots,
+            )
+
+        return jax.jit(packed_step, donate_argnums=(1, 2), **jit_kw)
 
     def _build_decode(self, b: int, c_pad: int):
         mc = self.model_config
@@ -861,13 +1159,7 @@ class ModelRunner:
             fields += [("g_state", (b,)), ("g_lane", (b,))]
         if self.attention_impl != "pallas":
             fields.append(("gather_tables", (b, c_pad)))
-        layout: dict[str, tuple[int, tuple[int, ...]]] = {}
-        off = 0
-        for name, shape in fields:
-            n = int(np.prod(shape))
-            layout[name] = (off, shape)
-            off += n
-        return layout, off
+        return self._layout_of(fields)
 
     def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
                             use_penalties: bool = False,
@@ -934,10 +1226,8 @@ class ModelRunner:
             b, c_pad, chained, guided=guided_shapes is not None
         )
 
-        def _seg(packed, name):
-            off, shape = layout[name]
-            n = int(np.prod(shape))
-            return packed[off:off + n].reshape(shape)  # static slice
+        def _seg(packed, name, _lo=layout):
+            return self._pack_seg(packed, _lo, name)
 
         def step(params, kc, vc, packed, chained_tokens=None,
                  g_token_class=None, g_class_mask=None, g_class_trans=None,
@@ -1131,6 +1421,7 @@ class ModelRunner:
         lora_slot: int = 0,
         sampling=None,
         prompt_lp_targets: list[int] | None = None,
+        staged: tuple | None = None,
     ) -> tuple:
         """Run one prefill chunk; returns (token, logits) ON DEVICE where
         `token` is the first generated token sampled from the chunk's last
@@ -1143,21 +1434,13 @@ class ModelRunner:
         prompt token ids (-1 = no target); selects a program variant
         that additionally returns (chosen (t_pad,) f32, top_vals
         (t_pad, CAP) f32, top_ids (t_pad, CAP) i32) device arrays —
-        row i scores targets[i] under the model's distribution."""
-        t = len(token_ids)
-        (tokens, positions_dev, write_slots, gather_slots,
-         t_pad, c_pad) = self._prefill_host_prep(
-            token_ids, block_table, start_pos, total_len
-        )
+        row i scores targets[i] under the model's distribution.
+
+        `staged` = a stage_prefill handle whose packed buffer was
+        uploaded ahead of time (chunk pipelining); used only when its
+        bucket key matches — the CALLER guarantees the staged content
+        equals what these arguments would build."""
         want_plp = prompt_lp_targets is not None
-        key = (t_pad, c_pad, "plp") if want_plp else (t_pad, c_pad)
-        if key not in self._prefill_fns:
-            logger.info("compiling prefill step t=%d ctx=%d plp=%s",
-                        t_pad, c_pad, want_plp)
-            self._prefill_fns[key] = self._build_prefill(
-                t_pad, c_pad, want_prompt_lp=want_plp
-            )
-        fn = self._prefill_fns[key]
         lora_kw = {}
         if self.lora_manager is not None:
             # scalar slot: prefill is one sequence, so the whole chunk
@@ -1166,6 +1449,53 @@ class ModelRunner:
                 "lora": self.lora_manager.buffers,
                 "lora_slots": jnp.int32(lora_slot),
             }
+        if self.prefill_pipeline:
+            t_pad = self._prefill_bucket(len(token_ids))
+            c_pad = self._ctx_bucket(total_len)
+            packed_dev = None
+            if (staged is not None and not want_plp
+                    and staged[0] == ("single", t_pad, c_pad)):
+                packed_dev = staged[1]  # upload already overlapped
+            if packed_dev is None:
+                t0 = time.perf_counter()
+                t_pad, c_pad, packed = self._fill_prefill_pack(
+                    token_ids, start_pos, block_table, total_len,
+                    sampling=sampling,
+                    prompt_lp_targets=prompt_lp_targets,
+                )
+                t1 = time.perf_counter()
+                self._phase_add("prep", t1 - t0)
+                packed_dev = jnp.asarray(packed)
+                self._phase_add("h2d", time.perf_counter() - t1)
+            key = (t_pad, c_pad, "plp") if want_plp else (t_pad, c_pad)
+            if key not in self._prefill_fns:
+                logger.info("compiling prefill step t=%d ctx=%d plp=%s",
+                            t_pad, c_pad, want_plp)
+                self._prefill_fns[key] = self._build_prefill(
+                    t_pad, c_pad, want_prompt_lp=want_plp
+                )
+            t2 = time.perf_counter()
+            ys = self._prefill_fns[key](
+                self.params, self.k_cache, self.v_cache, packed_dev,
+                **lora_kw,
+            )
+            self._phase_add("dispatch", time.perf_counter() - t2)
+            self.k_cache, self.v_cache = ys[-2], ys[-1]
+            return ys[:-2]
+        t = len(token_ids)
+        t0 = time.perf_counter()
+        (tokens, positions_dev, write_slots, gather_slots,
+         t_pad, c_pad) = self._prefill_host_prep(
+            token_ids, block_table, start_pos, total_len
+        )
+        key = (t_pad, c_pad, "plp") if want_plp else (t_pad, c_pad)
+        if key not in self._prefill_fns:
+            logger.info("compiling prefill step t=%d ctx=%d plp=%s",
+                        t_pad, c_pad, want_plp)
+            self._prefill_fns[key] = self._build_prefill(
+                t_pad, c_pad, want_prompt_lp=want_plp
+            )
+        fn = self._prefill_fns[key]
         temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
             1, sampling
         )
@@ -1174,10 +1504,9 @@ class ModelRunner:
             tg = np.full((t_pad,), -1, np.int32)
             tg[: len(prompt_lp_targets)] = prompt_lp_targets
             plp_kw = {"targets": jnp.asarray(tg)}
-        ys = fn(
-            self.params,
-            self.k_cache,
-            self.v_cache,
+        t1 = time.perf_counter()
+        self._phase_add("prep", t1 - t0)
+        args = (
             jnp.asarray(tokens),
             jnp.asarray(positions_dev),
             jnp.asarray(write_slots),
@@ -1189,9 +1518,18 @@ class ModelRunner:
             jnp.asarray(top_ks),
             jnp.asarray(min_ps),
             jnp.asarray(keys),
+        )
+        t2 = time.perf_counter()
+        self._phase_add("h2d", t2 - t1)
+        ys = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            *args,
             **plp_kw,
             **lora_kw,
         )
+        self._phase_add("dispatch", time.perf_counter() - t2)
         self.k_cache, self.v_cache = ys[-2], ys[-1]
         return ys[:-2]
 
@@ -1203,14 +1541,59 @@ class ModelRunner:
         total_lens: list[int],
         lora_slots: list[int] | None = None,
         sampling=None,
+        staged: tuple | None = None,
     ) -> tuple[jax.Array, jax.Array]:
         """Run one prompt chunk for EACH of n sequences in a single packed
         dispatch; returns (tokens, logits) ON DEVICE — tokens (s_pad,)
         sampled from each chunk's last *actual* row with `sampling` =
         per-sequence (temps, top_ps, top_ks, keys), logits (s_pad, vocab)
         for penalty/debug paths (rows >= n are padding). K/V for every
-        chunk is written into the cache."""
+        chunk is written into the cache.
+
+        `staged` = a stage_prefill_batch handle (see prefill)."""
         n = len(chunks)
+        if self.prefill_pipeline:
+            s_pad = next_pow2(max(n, 1))
+            t_pad = self._prefill_bucket(max(len(c) for c in chunks))
+            c_pad = max(self._ctx_bucket(tl) for tl in total_lens)
+            packed_dev = None
+            if (staged is not None
+                    and staged[0] == ("packed", s_pad, t_pad, c_pad)):
+                packed_dev = staged[1]  # upload already overlapped
+            if packed_dev is None:
+                t0 = time.perf_counter()
+                s_pad, t_pad, c_pad, packed = (
+                    self._fill_packed_prefill_pack(
+                        chunks, start_positions, block_tables,
+                        total_lens, sampling=sampling,
+                    )
+                )
+                t1 = time.perf_counter()
+                self._phase_add("prep", t1 - t0)
+                packed_dev = jnp.asarray(packed)
+                self._phase_add("h2d", time.perf_counter() - t1)
+            key = (s_pad, t_pad, c_pad)
+            if key not in self._prefill_batch_fns:
+                logger.info(
+                    "compiling packed prefill step s=%d t=%d ctx=%d",
+                    s_pad, t_pad, c_pad,
+                )
+                self._prefill_batch_fns[key] = self._build_prefill_batch(
+                    s_pad, t_pad, c_pad
+                )
+            lora_kw = self._packed_lora_kwargs(
+                lora_slots, n, s_pad, t_pad
+            )
+            t2 = time.perf_counter()
+            sampled, logits, self.k_cache, self.v_cache = (
+                self._prefill_batch_fns[key](
+                    self.params, self.k_cache, self.v_cache,
+                    packed_dev, **lora_kw,
+                )
+            )
+            self._phase_add("dispatch", time.perf_counter() - t2)
+            return sampled, logits
+        t0 = time.perf_counter()
         (s_pad, t_pad, c_pad, tokens, positions_dev, write_slots,
          q_starts, tl_full, tables) = self._packed_host_prep(
             chunks, start_positions, block_tables, total_lens
@@ -1235,10 +1618,9 @@ class ModelRunner:
         temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
             s_pad, sampling
         )
-        sampled, logits, self.k_cache, self.v_cache = fn(
-            self.params,
-            self.k_cache,
-            self.v_cache,
+        t1 = time.perf_counter()
+        self._phase_add("prep", t1 - t0)
+        args = (
             jnp.asarray(tokens.reshape(-1)),
             jnp.asarray(positions_dev.reshape(-1)),
             jnp.asarray(write_slots.reshape(-1)),
@@ -1251,8 +1633,17 @@ class ModelRunner:
             jnp.asarray(top_ks),
             jnp.asarray(min_ps),
             jnp.asarray(keys),
+        )
+        t2 = time.perf_counter()
+        self._phase_add("h2d", t2 - t1)
+        sampled, logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            *args,
             **lora_kw,
         )
+        self._phase_add("dispatch", time.perf_counter() - t2)
         return sampled, logits
 
     def precompile_prefill(

@@ -45,6 +45,18 @@ class EngineStatsSnapshot:
     # speculative decoding acceptance (vllm:spec_decode_* role)
     spec_draft_tokens_total: int = 0
     spec_accepted_tokens_total: int = 0
+    # pipelined-prefill attribution: wall seconds per phase of the
+    # prefill dispatch path (prep = host array build, h2d = upload,
+    # dispatch = jitted-call enqueue, fetch = device->host token reads)
+    # plus staging effectiveness — tpu:prefill_* in /metrics and the
+    # bench.py prefill_phase_s detail slot
+    prefill_prep_seconds_total: float = 0.0
+    prefill_h2d_seconds_total: float = 0.0
+    prefill_dispatch_seconds_total: float = 0.0
+    prefill_fetch_seconds_total: float = 0.0
+    prefill_staged_hits_total: int = 0
+    prefill_staged_misses_total: int = 0
+    prefill_chained_chunks_total: int = 0
 
     @property
     def prefix_cache_hit_rate(self) -> float:

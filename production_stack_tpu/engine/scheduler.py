@@ -103,6 +103,14 @@ class SchedulerConfig:
     # multi-step dispatch (num_scheduler_steps - 1 lookahead) never runs
     # off the end of its block table mid-scan
     decode_lookahead: int = 0
+    # pipelined prefill: a chunk whose packed h2d buffer is already
+    # uploaded (engine sets `staged_prefill_ready`) is admitted as
+    # zero cost against the decode interleave — cold multi-chunk
+    # prefills then drain in consecutive rounds instead of one chunk
+    # per decode round. This caps how many consecutive staged
+    # dispatches may bypass starvation before decode gets its turn
+    # (bounds worst-case ITL for very long prompts).
+    max_staged_prefill_run: int = 8
 
 
 class Scheduler:
@@ -115,6 +123,12 @@ class Scheduler:
         # KV blocks back into HBM before prompt allocation
         self.kv_restore = None
         self._prefill_streak = 0  # consecutive prefill steps scheduled
+        # engine-maintained hint (pipelined prefill): the next prefill
+        # dispatch's packed buffer is already on device, so admitting it
+        # costs ~no link time; it bypasses the interleave's starvation
+        # gate, bounded by max_staged_prefill_run consecutive bypasses
+        self.staged_prefill_ready = False
+        self._staged_run = 0
 
     # -- queue introspection (feeds the vllm:num_requests_* gauges) -------
     @property
@@ -250,9 +264,14 @@ class Scheduler:
         has_decode_ready = any(
             s.prefill_done and not s.finished for s in self.running
         )
+        staged_bypass = (
+            self.staged_prefill_ready
+            and self._staged_run < self.config.max_staged_prefill_run
+        )
         decode_starved = (
             self.config.decode_interleave > 0
             and has_decode_ready
+            and not staged_bypass
             and self._prefill_streak >= self.config.decode_interleave
         )
         if not decode_starved:
@@ -287,9 +306,20 @@ class Scheduler:
                 # round-1 p50 TTFT 15.6s in the 10-round workload while
                 # packed admission holds it in the low seconds for the
                 # same ITL bound.
-                self._prefill_streak += 1
+                if (staged_bypass and has_decode_ready
+                        and self._prefill_streak
+                        >= self.config.decode_interleave):
+                    # zero-cost admission: this dispatch's h2d already
+                    # overlapped earlier compute (pipelined prefill);
+                    # decode's extra wait is bounded by the staged-run
+                    # cap, and a stale stage is converted back into a
+                    # charged dispatch via note_staged_prefill_miss
+                    self._staged_run += 1
+                else:
+                    self._prefill_streak += 1
                 return out
         self._prefill_streak = 0
+        self._staged_run = 0
 
         # 3) otherwise decode every decode-ready running sequence (mid-
         # prefill sequences sit out the interleaved decode steps)
@@ -329,6 +359,15 @@ class Scheduler:
         if decode_seqs:
             out.decode = DecodeWork(seqs=decode_seqs)
         return out
+
+    def note_staged_prefill_miss(self) -> None:
+        """The engine found the staged prefill buffer stale at dispatch
+        time (fingerprint mismatch): the dispatch paid the full serial
+        h2d after all, so convert the zero-cost admission back into a
+        normally charged one."""
+        if self._staged_run > 0:
+            self._staged_run -= 1
+            self._prefill_streak += 1
 
     def schedule_admit_retry(self, out: SchedulerOutput) -> SchedulerOutput:
         """Re-run schedule() after a priority claim, merging the

@@ -212,7 +212,8 @@ class EngineServer:
         body, err = await self._json_body(request)
         if err is not None:
             return err
-        if err := self._check_model(body):
+        err = self._check_model(body)
+        if err is not None:
             return err
         prompt = body.get("prompt")
         if prompt is None:
@@ -287,7 +288,8 @@ class EngineServer:
                 else self.engine.tokenizer.encode(p)
             )
             ids = self._apply_truncation(ids, sp)
-            if err := self._check_context_len(ids):
+            err = self._check_context_len(ids)
+            if err is not None:
                 return err
             prompt_ids_list.append(ids)
         lora_name = body.get("model") if (
@@ -332,7 +334,8 @@ class EngineServer:
         body, err = await self._json_body(request)
         if err is not None:
             return err
-        if err := self._check_model(body):
+        err = self._check_model(body)
+        if err is not None:
             return err
         messages = body.get("messages")
         if not messages:
@@ -380,7 +383,8 @@ class EngineServer:
         request_id = proto.make_id("chatcmpl")
         prompt_ids = self.engine.tokenizer.encode(prompt)
         prompt_ids = self._apply_truncation(prompt_ids, sp)
-        if err := self._check_context_len(prompt_ids):
+        err = self._check_context_len(prompt_ids)
+        if err is not None:
             return err
         req_priority, perr = self._parse_priority(body)
         if perr is not None:
@@ -457,10 +461,17 @@ class EngineServer:
             s = self._tok_str(e["token_id"])
             tokens.append(s)
             lps.append(e["logprob"])
-            tops.append({
-                self._tok_str(t["token_id"]): t["logprob"]
-                for t in e["top_logprobs"]
-            })
+            top: dict = {}
+            for t in e["top_logprobs"]:
+                key = self._tok_str(t["token_id"])
+                if key in top:
+                    # distinct ids can decode to the same string (byte
+                    # fallbacks, partial UTF-8): the OpenAI dict shape
+                    # would silently drop one — disambiguate with
+                    # vLLM's return_tokens_as_token_ids spelling
+                    key = f"token_id:{t['token_id']}"
+                top[key] = t["logprob"]
+            tops.append(top)
             offsets.append(pos)
             pos += len(s)
         return {"tokens": tokens, "token_logprobs": lps,
@@ -882,7 +893,8 @@ class EngineServer:
         body, err = await self._json_body(request)
         if err is not None:
             return err
-        if err := self._check_model(body):
+        err = self._check_model(body)
+        if err is not None:
             return err
         model = body.get("model", self.model_name)
         lora_name = model if model in self.lora_adapters else None
@@ -945,7 +957,8 @@ class EngineServer:
         body, err = await self._json_body(request)
         if err is not None:
             return err
-        if err := self._check_model(body):
+        err = self._check_model(body)
+        if err is not None:
             return err
         query = body.get("query")
         docs = body.get("documents")
@@ -995,7 +1008,8 @@ class EngineServer:
         body, err = await self._json_body(request)
         if err is not None:
             return err
-        if err := self._check_model(body):
+        err = self._check_model(body)
+        if err is not None:
             return err
         t1 = body.get("text_1")
         t2 = body.get("text_2")

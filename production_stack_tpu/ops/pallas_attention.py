@@ -38,6 +38,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from production_stack_tpu.parallel.compat import shard_map
+
+# jax-generation compat (same contract as parallel/compat.py), module-
+# local so the shared pltpu module is never mutated: jax 0.4.x spells
+# the HBM memory space `ANY` and the Mosaic params `TPUCompilerParams`;
+# the newer public names are HBM / CompilerParams.
+_HBM = getattr(pltpu, "HBM", None) or pltpu.ANY
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or (
+    pltpu.TPUCompilerParams
+)
+
 MASK_VALUE = -1e30
 
 
@@ -326,8 +337,8 @@ def paged_prefill_attention(
                 (tq, nq, d), lambda i, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
+            pl.BlockSpec(memory_space=_HBM),
         ],
         out_specs=pl.BlockSpec(
             (tq, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
@@ -353,7 +364,7 @@ def paged_prefill_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, nq, d), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
             # large f32 q/accumulator tiles exceed the default 16 MiB
             # scoped-vmem stack; v5e has 128 MiB — allow half of it
@@ -392,7 +403,7 @@ def paged_prefill_attention_tp(
         block_size=block_size, scale=scale, interpret=interpret,
         window=window,
     )
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -454,7 +465,7 @@ def paged_decode_attention_tp(
         block_size=block_size, scale=scale, interpret=interpret,
         window=window,
     )
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -500,8 +511,8 @@ def paged_decode_attention(
                 (1, nq, d), lambda i, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
+            pl.BlockSpec(memory_space=_HBM),
         ],
         out_specs=pl.BlockSpec(
             (1, nq, d), lambda i, *_: (i, 0, 0), memory_space=pltpu.VMEM
@@ -524,7 +535,7 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, nq, d), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
             # large f32 q/accumulator tiles exceed the default 16 MiB
             # scoped-vmem stack; v5e has 128 MiB — allow half of it

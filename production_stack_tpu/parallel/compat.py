@@ -1,0 +1,52 @@
+"""`jax.shard_map` compatibility shim.
+
+The repo targets the public `jax.shard_map` API (jax >= 0.5: top-level
+export, `axis_names=` for partial-manual mode, `check_vma=`). jax 0.4.x
+only ships `jax.experimental.shard_map.shard_map`, whose partial-manual
+spelling is `auto=` (the COMPLEMENT of the manual axis set) and whose
+replication check is `check_rep=`. Every module shard_maps through this
+shim so both jax generations serve the same code.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import (  # type: ignore[import]
+        shard_map as _experimental_shard_map,
+    )
+
+    def shard_map(f, /, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kwargs):
+        if axis_names is not None:
+            # new API: axis_names = the MANUAL axes; old API: auto = the
+            # axes left to GSPMD — complement within the mesh
+            kwargs["auto"] = (
+                frozenset(mesh.axis_names) - frozenset(axis_names)
+            )
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **kwargs,
+        )
+
+
+def pvary(x, axis_names):
+    """Replicated -> device-varying cast inside a shard_map body.
+
+    The new-API spelling is `jax.lax.pcast(..., to="varying")` (vma type
+    system); jax 0.4.x has no vma types at all, so the cast is an
+    identity there (the old `check_rep` analysis tolerates replicated
+    loop carries)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
+__all__ = ["shard_map", "pvary"]

@@ -40,7 +40,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-shard_map = jax.shard_map
+
+from production_stack_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 SP_AXIS = "sp"

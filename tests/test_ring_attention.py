@@ -14,8 +14,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-shard_map = jax.shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from production_stack_tpu.parallel.compat import shard_map
 
 from production_stack_tpu.parallel.ring_attention import (
     attention_reference,
