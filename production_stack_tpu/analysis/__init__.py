@@ -1,0 +1,28 @@
+"""stackcheck: repo-native AST analysis for async/dispatch/lock hazards.
+
+Run ``python -m production_stack_tpu.analysis production_stack_tpu/``;
+exits 0 only when the tree has zero unsuppressed findings (enforced by
+tier-1 in tests/test_stackcheck.py and by the CI stackcheck job). See
+analysis/README.md for the rules, the suppression syntax, and how to add
+a rule. Stdlib-only by design.
+"""
+
+from production_stack_tpu.analysis.core import (
+    Finding,
+    Report,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    render_human,
+    render_json,
+)
+
+__all__ = [
+    "Finding",
+    "Report",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "render_human",
+    "render_json",
+]

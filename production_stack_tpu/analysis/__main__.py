@@ -1,0 +1,78 @@
+"""stackcheck CLI.
+
+Usage:
+    python -m production_stack_tpu.analysis [paths...] [--json]
+        [--select rule1,rule2] [--show-suppressed] [--list-rules]
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings,
+2 = usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from production_stack_tpu.analysis.core import (
+    all_rules,
+    analyze_paths,
+    render_human,
+    render_json,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m production_stack_tpu.analysis",
+        description=(
+            "stackcheck: repo-native AST analysis for async/dispatch/"
+            "lock hazards"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["production_stack_tpu"],
+        help="files or directories to scan (default: production_stack_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated subset of rules to run",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings with their justifications",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule registry and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name}: {rule.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        report = analyze_paths(args.paths, select=select)
+    except (OSError, ValueError) as e:
+        print(f"stackcheck: error: {e}", file=sys.stderr)
+        return 2
+    if report.files_scanned == 0:
+        print("stackcheck: error: no python files found", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(render_json(report))
+    else:
+        print(render_human(report, show_suppressed=args.show_suppressed))
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

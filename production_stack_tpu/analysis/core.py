@@ -1,0 +1,393 @@
+"""stackcheck core: rule framework, suppression handling, runner, output.
+
+stackcheck is the repo-native static analyzer for the hazard classes this
+serving stack actually ships: dead falsy-truthiness gates (PR 1 found every
+``if err := check(...)`` in the server dead because aiohttp responses are
+falsy), event-loop stalls from sync calls in ``async def`` bodies, hidden
+host<->device syncs in engine hot loops, fire-and-forget asyncio tasks that
+die silently, lock-guarded attributes touched without the lock, and silent
+``except Exception`` swallows on request paths.
+
+Design:
+
+- ``Rule`` subclasses register themselves via ``@register``; each yields
+  ``Finding`` objects from ``check(ctx)`` where ``ctx`` is a parsed
+  ``ModuleContext`` (AST + source lines + comment directives).
+- Suppression is per-line: ``# stackcheck: disable=<rule>[,<rule>...] --
+  justification`` on the flagged line, or on a pure-comment line directly
+  above it, downgrades matching findings to "suppressed" (reported with
+  ``--show-suppressed``, never fail the run). ``disable=all`` matches every
+  rule. A justification is strongly encouraged; the runner records it.
+- ``# stackcheck: hot-path`` on (or directly above) a ``def`` marks the
+  function as a device-dispatch hot path for the device-sync rule, as does a
+  ``@hot_path`` decorator.
+- No third-party imports anywhere in this package: it must run on a bare
+  CPython so CI / pre-push hooks need zero installs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+DISABLE_RE = re.compile(
+    r"#\s*stackcheck:\s*disable="
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*(?:[-–—]+)\s*(?P<why>.*))?"
+)
+HOT_RE = re.compile(r"#\s*stackcheck:\s*hot-path\b")
+GUARDED_RE = re.compile(r"#\s*guarded by:\s*(?P<lock>[A-Za-z0-9_.()\[\]]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str | None = None
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"[{self.rule}]{tag} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    rules: frozenset[str]  # rule names, or {"all"}
+    justification: str | None
+
+    def covers(self, rule: str) -> bool:
+        return "all" in self.rules or rule in self.rules
+
+
+class ModuleContext:
+    """Parsed view of one source file shared by every rule.
+
+    Holds the AST, raw lines, comment directives (suppressions, hot-path
+    marks, guarded-by annotations) and the module's import alias map so
+    rules can resolve ``np.asarray`` -> ``numpy.asarray`` etc.
+    """
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> Suppression
+        self.suppressions: dict[int, Suppression] = {}
+        # lines bearing a hot-path mark
+        self.hot_lines: set[int] = set()
+        # line -> lock expression string from "# guarded by: <lock>"
+        self.guarded_lines: dict[int, str] = {}
+        # pure-comment lines (a directive there applies to the next line)
+        self._comment_only: set[int] = set()
+        for i, raw in enumerate(self.lines, 1):
+            stripped = raw.lstrip()
+            if stripped.startswith("#"):
+                self._comment_only.add(i)
+            m = DISABLE_RE.search(raw)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                why = (m.group("why") or "").strip() or None
+                self.suppressions[i] = Suppression(rules, why)
+            if HOT_RE.search(raw):
+                self.hot_lines.add(i)
+            g = GUARDED_RE.search(raw)
+            if g:
+                self.guarded_lines[i] = g.group("lock").strip()
+        self._extend_justifications()
+        self.import_aliases = _collect_import_aliases(self.tree)
+
+    def _extend_justifications(self) -> None:
+        """A directive on a comment-only line may wrap its justification
+        onto following comment-only lines; fold those in so reports show
+        the full text."""
+        for line, sup in self.suppressions.items():
+            if line not in self._comment_only or sup.justification is None:
+                continue
+            parts = [sup.justification]
+            nxt = line + 1
+            while nxt in self._comment_only and \
+                    nxt not in self.suppressions:
+                parts.append(self.lines[nxt - 1].lstrip().lstrip("#")
+                             .strip())
+                nxt += 1
+            sup.justification = " ".join(p for p in parts if p)
+
+    # -- directives --------------------------------------------------------
+    def suppression_for(self, line: int, rule: str) -> Suppression | None:
+        """Suppression covering ``rule`` at ``line``: same line wins, else
+        a directive anywhere in the contiguous block of pure-comment lines
+        directly above (so justifications can wrap)."""
+        s = self.suppressions.get(line)
+        if s is not None and s.covers(rule):
+            return s
+        prev = line - 1
+        while prev in self._comment_only:
+            s = self.suppressions.get(prev)
+            if s is not None and s.covers(rule):
+                return s
+            prev -= 1
+        return None
+
+    def is_hot(self, func: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+        """True if marked ``# stackcheck: hot-path`` on the def line or
+        anywhere in the contiguous comment block directly above it (the
+        mark's rationale usually wraps), or decorated ``@hot_path``."""
+        if func.lineno in self.hot_lines:
+            return True
+        prev = func.lineno - 1
+        while prev in self._comment_only:
+            if prev in self.hot_lines:
+                return True
+            prev -= 1
+        for dec in func.decorator_list:
+            if attr_tail(dec) == "hot_path":
+                return True
+            if isinstance(dec, ast.Call) and attr_tail(dec.func) == \
+                    "hot_path":
+                return True
+        return False
+
+
+# -- shared AST helpers -----------------------------------------------------
+def _collect_import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Map local names to dotted origins: ``import numpy as np`` ->
+    {"np": "numpy"}; ``from time import sleep`` -> {"sleep": "time.sleep"}."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def resolve_dotted(node: ast.expr, aliases: dict[str, str]) -> str | None:
+    """Unparse an attribute chain to a dotted name, resolving the base
+    through the module's import aliases. Returns None when the base is not
+    a plain Name (e.g. a call result)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+    return None
+
+
+def attr_tail(node: ast.expr) -> str | None:
+    """Last segment of a call target: ``a.b.c(...)`` -> "c"; ``f(...)`` ->
+    "f"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_function_body(
+    func: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/class bodies
+    (a nested def has its own execution context — e.g. a closure shipped to
+    an executor or jit — so its hazards are judged separately)."""
+    stack: list[ast.AST] = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- rule framework ---------------------------------------------------------
+class Rule:
+    """Base class: subclasses set ``name``/``summary`` and yield Findings
+    from ``check``. Register with ``@register``."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    assert cls.name, "rule classes must set a name"
+    assert cls.name not in _REGISTRY, f"duplicate rule {cls.name!r}"
+    _REGISTRY[cls.name] = cls()
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # import for side effect: rule modules self-register
+    from production_stack_tpu.analysis import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# -- runner -----------------------------------------------------------------
+@dataclasses.dataclass
+class Report:
+    findings: list[Finding]
+    files_scanned: int
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_dict() for f in self.unsuppressed],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "summary": self.summary(),
+        }
+
+    def summary(self) -> dict:
+        by_rule: dict[str, int] = {}
+        for f in self.unsuppressed:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {
+            "unsuppressed": len(self.unsuppressed),
+            "suppressed": len(self.suppressed),
+            "by_rule": by_rule,
+        }
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    select: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Run rules over one source string; returns all findings with
+    suppression already applied (suppressed ones carry suppressed=True)."""
+    rules = all_rules()
+    if select is not None:
+        wanted = set(select)
+        unknown = wanted - rules.keys()
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in wanted}
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=path, line=e.lineno or 0,
+            col=e.offset or 0, message=f"cannot parse: {e.msg}",
+        )]
+    findings: list[Finding] = []
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            sup = ctx.suppression_for(f.line, f.rule)
+            if sup is not None:
+                f.suppressed = True
+                f.justification = sup.justification
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[Path]:
+    """Expand paths to .py files; a path that is neither an existing
+    directory nor an existing .py file raises instead of silently
+    shrinking the scan scope (a typo'd CI argument must not exit 0)."""
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            yield from sorted(
+                f for f in path.rglob("*.py")
+                if "__pycache__" not in f.parts
+            )
+        elif path.suffix == ".py" and path.is_file():
+            yield path
+        else:
+            raise ValueError(
+                f"not a python file or directory: {p!r}"
+            )
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    select: Iterable[str] | None = None,
+) -> Report:
+    findings: list[Finding] = []
+    n = 0
+    for f in iter_python_files(paths):
+        n += 1
+        source = f.read_text(encoding="utf-8")
+        findings.extend(analyze_source(source, str(f), select=select))
+    return Report(findings=findings, files_scanned=n)
+
+
+def render_human(report: Report, show_suppressed: bool = False) -> str:
+    out = []
+    for f in report.unsuppressed:
+        out.append(f.format())
+    if show_suppressed:
+        for f in report.suppressed:
+            line = f.format()
+            if f.justification:
+                line += f" [why: {f.justification}]"
+            out.append(line)
+    s = report.summary()
+    out.append(
+        f"stackcheck: {report.files_scanned} file(s), "
+        f"{s['unsuppressed']} finding(s), "
+        f"{s['suppressed']} suppressed"
+    )
+    return "\n".join(out)
+
+
+def render_json(report: Report) -> str:
+    return json.dumps(report.to_dict(), indent=2)

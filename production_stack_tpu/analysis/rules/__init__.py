@@ -1,0 +1,17 @@
+"""stackcheck rules: importing this package registers every rule.
+
+One module per hazard class; each module's rule class self-registers with
+``@register`` so ``core.all_rules()`` sees it. Adding a rule = adding a
+module here that defines a ``Rule`` subclass and importing it below (see
+analysis/README.md for the recipe and a worked example).
+"""
+
+from production_stack_tpu.analysis.rules import (  # noqa: F401
+    blocking_async,
+    device_sync,
+    falsy_gate,
+    fire_forget,
+    lock_guard,
+    mutable_state,
+    silent_except,
+)

@@ -1,0 +1,97 @@
+"""device-sync-hot: host<->device sync forcers inside marked hot paths.
+
+The engine's perf model (PERF.md, PR 1) is that dispatch-side code NEVER
+waits on the device: XLA dispatch returns before compute finishes, and the
+one intended fetch per round is explicit. A stray ``float(x)`` / ``.item()``
+/ ``np.asarray(device_array)`` / ``jax.device_get`` / ``.block_until_ready``
+inside a dispatch or staging function silently serializes host and device
+and shows up only as tail latency.
+
+A function is "hot" when marked ``# stackcheck: hot-path`` on (or directly
+above) its ``def`` line, or decorated ``@hot_path``. Mark the engine
+step/decode/prefill dispatch+staging loops; the intended fetch points get a
+per-line suppression with a justification.
+
+Heuristics to keep noise down: ``float``/``bool`` on literal constants are
+skipped (host-only by construction), as is ``np.asarray`` over a
+list/tuple/dict literal (host prep, not a device fetch). Nested defs are
+skipped — inside the engine they are the jit-compiled closures where these
+ops are traced, not executed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from production_stack_tpu.analysis.core import (
+    ModuleContext,
+    Rule,
+    attr_tail,
+    iter_functions,
+    register,
+    resolve_dotted,
+    walk_function_body,
+)
+
+#: attribute calls that force the host to wait on device values
+SYNC_ATTR_CALLS = {"item", "block_until_ready"}
+
+#: dotted calls that force a device fetch / barrier
+SYNC_DOTTED_CALLS = {
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.asanyarray",
+    "numpy.array",
+}
+
+#: builtins that synchronize when handed a device array
+SYNC_BUILTINS = {"float", "bool"}
+
+_LITERALS = (ast.Constant, ast.List, ast.Tuple, ast.Dict, ast.Set)
+
+
+@register
+class DeviceSyncInHotPath(Rule):
+    name = "device-sync-hot"
+    summary = (
+        "host-device sync forcer (float()/.item()/np.asarray/"
+        "device_get/block_until_ready) inside a marked hot path"
+    )
+
+    def check(self, ctx: ModuleContext):
+        for func in iter_functions(ctx.tree):
+            if not ctx.is_hot(func):
+                continue
+            for node in walk_function_body(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._classify(node, ctx)
+                if hit is not None:
+                    yield self.finding(
+                        ctx, node,
+                        f"'{hit}' forces a host-device sync inside hot "
+                        f"path '{func.name}'; move it off the dispatch "
+                        f"path or suppress with the justification for "
+                        f"this being an intended fetch point",
+                    )
+
+    @staticmethod
+    def _classify(call: ast.Call, ctx: ModuleContext) -> str | None:
+        func = call.func
+        tail = attr_tail(func)
+        if isinstance(func, ast.Attribute) and tail in SYNC_ATTR_CALLS:
+            return f".{tail}()"
+        dotted = resolve_dotted(func, ctx.import_aliases)
+        if dotted in SYNC_DOTTED_CALLS:
+            # asarray over a literal is host prep, not a device fetch
+            if dotted.startswith("numpy.") and call.args and \
+                    isinstance(call.args[0], _LITERALS):
+                return None
+            return dotted
+        if isinstance(func, ast.Name) and func.id in SYNC_BUILTINS and \
+                func.id not in ctx.import_aliases:
+            if len(call.args) == 1 and not isinstance(
+                    call.args[0], ast.Constant):
+                return f"{func.id}()"
+        return None

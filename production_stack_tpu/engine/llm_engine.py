@@ -534,6 +534,9 @@ class LLMEngine:
                 self._append_token(seq, int(toks[i, j]), entry)
 
     # -- the step loop ----------------------------------------------------
+    # stackcheck: hot-path — the async-decode round trip: dispatch the
+    # next round BEFORE fetching the in-flight one; the only sanctioned
+    # fetch lives in _resolve_pending
     def step(self) -> list[RequestOutput]:
         # async decode fast path: keep the device busy by dispatching the
         # next round on the in-flight round's on-device tokens, THEN

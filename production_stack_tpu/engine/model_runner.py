@@ -265,7 +265,8 @@ class ModelRunner:
         param_bytes = mc.num_params() * self.dtype.itemsize // tp
         try:
             stats = jax.devices()[0].memory_stats() or {}
-        except Exception:
+        except Exception as e:
+            logger.debug("memory_stats unavailable (%s); using estimate", e)
             stats = {}
         if "bytes_limit" in stats:
             limit = stats["bytes_limit"]
@@ -604,6 +605,8 @@ class ModelRunner:
         put("keys", keys)
         return s_pad, t_pad, c_pad, packed
 
+    # stackcheck: hot-path — staging must overlap the in-flight dispatch;
+    # any hidden host-device sync here serializes the prefill pipeline
     def stage_prefill(
         self, token_ids: list[int], start_pos: int,
         block_table: list[int], total_len: int, sampling=None,
@@ -625,6 +628,7 @@ class ModelRunner:
         self._phase_add("h2d", time.perf_counter() - t1)
         return handle
 
+    # stackcheck: hot-path
     def stage_prefill_batch(
         self,
         chunks: list[list[int]],
@@ -1832,6 +1836,8 @@ class ModelRunner:
                 n += 1
         return n
 
+    # stackcheck: hot-path — dispatch-only: returns device logits without
+    # waiting; the caller's sampler owns the one fetch per round
     def decode(
         self,
         token_ids: list[int],
@@ -1987,6 +1993,7 @@ class ModelRunner:
             put("g_lane", g_lane)
         return packed
 
+    # stackcheck: hot-path
     def stage_decode_multi(
         self, positions, block_tables, context_lens, steps,
         temps, top_ps, top_ks, keys, min_ps=None,
@@ -2008,6 +2015,8 @@ class ModelRunner:
         )
         return (c_pad, jax.device_put(packed))
 
+    # stackcheck: hot-path — one dispatch, one deferred fetch; a stray
+    # sync forcer here costs a full RTT per decode round
     def decode_multi(
         self,
         token_ids: list[int],
@@ -2137,8 +2146,8 @@ class ModelRunner:
         bias_cap = 0
         bias_kw = {}
         if logit_bias is not None:
-            lb_ids, lb_vals = logit_bias
-            bias_cap = int(np.asarray(lb_ids).shape[1])
+            lb_ids, lb_vals = logit_bias  # (b_actual, cap) ndarrays
+            bias_cap = int(lb_ids.shape[1])
             ids_full = np.zeros((b, bias_cap), np.int32)
             vals_full = np.zeros((b, bias_cap), np.float32)
             ids_full[:b_actual] = lb_ids

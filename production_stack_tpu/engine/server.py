@@ -37,6 +37,7 @@ from production_stack_tpu.engine import protocol as proto
 from production_stack_tpu.engine import tools
 from production_stack_tpu.engine.sampling_params import SamplingParams
 from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -121,7 +122,7 @@ class EngineServer:
 
     async def _on_startup(self, app: web.Application) -> None:
         self.engine.start(asyncio.get_running_loop())
-        self._stats_task = asyncio.create_task(self._stats_loop())
+        self._stats_task = spawn_watched(self._stats_loop(), "engine-stats")
         # disaggregated prefill producer: serve KV blocks to decode peers
         # (reference: NIXL sender role, LMCACHE_NIXL_ROLE=sender)
         listen = (self.config.kv_transfer_config or {}).get("listen")

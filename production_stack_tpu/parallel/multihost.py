@@ -47,8 +47,9 @@ def _distributed_active() -> bool:
         from jax._src import distributed
 
         return distributed.global_state.client is not None
-    except Exception:  # noqa: BLE001 — private API may move; worst case
-        # we attempt a redundant initialize and surface its error
+    except Exception as e:  # noqa: BLE001 — private API may move; worst
+        # case we attempt a redundant initialize and surface its error
+        logger.debug("distributed state probe failed: %s", e)
         return False
 
 
@@ -151,6 +152,8 @@ class StepBroadcaster:
         if old >= 0:
             try:
                 self._client.key_value_delete(f"{self.PREFIX}{old}")
+            # stackcheck: disable=silent-except — descriptor GC is
+            # best-effort; a leaked KV key is harmless and retried next turn
             except Exception:  # noqa: BLE001 — GC is best-effort
                 pass
 

@@ -50,6 +50,7 @@ from production_stack_tpu.router.stats.request_stats import (
     initialize_request_stats_monitor,
 )
 from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -254,8 +255,8 @@ class RouterApp:
         if watcher is not None:
             await watcher.start()
         if self.args.log_stats:
-            self._log_stats_task = asyncio.create_task(
-                self._log_stats_loop())
+            self._log_stats_task = spawn_watched(
+                self._log_stats_loop(), "router-log-stats")
 
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._log_stats_task:

@@ -22,6 +22,7 @@ from production_stack_tpu.router.utils import (
     parse_static_urls,
 )
 from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -76,7 +77,7 @@ class DynamicConfigWatcher:
             logger.exception(
                 "failed to load initial dynamic config %s", self.config_path
             )
-        self._task = asyncio.create_task(self._watch_loop())
+        self._task = spawn_watched(self._watch_loop(), "dynamic-config-watch")
 
     async def close(self) -> None:
         if self._task:

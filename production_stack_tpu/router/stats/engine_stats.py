@@ -18,6 +18,7 @@ from production_stack_tpu.router.service_discovery import (
     get_service_discovery,
 )
 from production_stack_tpu.utils import init_logger
+from production_stack_tpu.utils.tasks import spawn_watched
 
 logger = init_logger(__name__)
 
@@ -68,7 +69,7 @@ class EngineStatsScraper:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=self.scrape_interval_s)
         )
-        self._task = asyncio.create_task(self._scrape_loop())
+        self._task = spawn_watched(self._scrape_loop(), "engine-stats-scrape")
 
     async def close(self) -> None:
         if self._task:

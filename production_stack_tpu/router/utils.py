@@ -107,5 +107,6 @@ async def is_model_healthy(
         ) as session:
             async with session.post(f"{url}{endpoint}", json=payload) as r:
                 return r.status == 200
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — unreachable == unhealthy
+        logger.debug("health probe failed for %s %s: %s", url, model, e)
         return False

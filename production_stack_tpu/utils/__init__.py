@@ -2,5 +2,8 @@
 
 from production_stack_tpu.utils.log import init_logger
 from production_stack_tpu.utils.singleton import SingletonABCMeta, SingletonMeta
+from production_stack_tpu.utils.tasks import spawn_watched
 
-__all__ = ["init_logger", "SingletonMeta", "SingletonABCMeta"]
+__all__ = [
+    "init_logger", "SingletonMeta", "SingletonABCMeta", "spawn_watched",
+]

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# stackcheck: the repo-native AST analyzer for async/dispatch/lock hazards
+# (production_stack_tpu/analysis/). Mirrors run_tier1.sh: every PR runs
+# the same invocation CI and the tier-1 suite enforce — zero unsuppressed
+# findings over the package, or non-zero exit.
+#
+# Usage: scripts/run_stackcheck.sh [extra stackcheck args...]
+#   e.g. scripts/run_stackcheck.sh --show-suppressed
+#        scripts/run_stackcheck.sh --json
+#        scripts/run_stackcheck.sh --select silent-except,blocking-async
+#
+# Stdlib-only: needs no jax, aiohttp, or any install — safe as a
+# pre-push hook on a bare CPython.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m production_stack_tpu.analysis production_stack_tpu/ "$@"

@@ -1,0 +1,510 @@
+"""stackcheck analyzer tests: per-rule fixtures (positive + negative +
+suppression), CLI exit-code contract, and the tier-1 gate that the repo
+self-scan stays at zero unsuppressed findings.
+
+The fixtures double as executable documentation of each rule's semantics;
+keep them small and obvious.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from production_stack_tpu.analysis import (
+    all_rules,
+    analyze_paths,
+    analyze_source,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "production_stack_tpu"
+
+
+def findings_for(src: str, rule: str | None = None):
+    found = analyze_source(textwrap.dedent(src), path="fixture.py")
+    live = [f for f in found if not f.suppressed]
+    if rule is not None:
+        live = [f for f in live if f.rule == rule]
+    return live
+
+
+# -- fixtures: one (positive, negative, suppressed) triple per rule ---------
+# positive snippets MUST trip exactly their rule; negatives must be clean
+# for that rule; suppressed carries a stackcheck directive.
+FIXTURES = {
+    "falsy-walrus-gate": dict(
+        positive="""
+            from aiohttp import web
+
+            def check(body):
+                if "model" not in body:
+                    return web.json_response({"error": "x"}, status=400)
+                return None
+
+            def handler(body):
+                if err := check(body):
+                    return err
+                return "ok"
+        """,
+        negative="""
+            from aiohttp import web
+
+            def check(body):
+                if "model" not in body:
+                    return web.json_response({"error": "x"}, status=400)
+                return None
+
+            def handler(body):
+                if (err := check(body)) is not None:
+                    return err
+                return "ok"
+        """,
+        suppressed="""
+            def make():
+                return dict(a=1)
+
+            def handler(body):
+                # stackcheck: disable=falsy-walrus-gate — always non-empty
+                if cfg := make():
+                    return cfg
+        """,
+    ),
+    "blocking-async": dict(
+        positive="""
+            import time
+
+            async def handler():
+                time.sleep(0.5)
+                return 1
+        """,
+        negative="""
+            import asyncio
+            import time
+
+            def backoff():          # sync helper: fine
+                time.sleep(0.5)
+
+            async def handler():
+                await asyncio.sleep(0.5)
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, backoff)
+        """,
+        suppressed="""
+            import time
+
+            async def handler():
+                # stackcheck: disable=blocking-async — provably off-loop
+                time.sleep(0.5)
+        """,
+    ),
+    "device-sync-hot": dict(
+        positive="""
+            import jax
+
+            # stackcheck: hot-path
+            def dispatch(runner, tokens):
+                logits = runner.decode(tokens)
+                return float(logits[0])
+        """,
+        negative="""
+            import jax
+            import numpy as np
+
+            # stackcheck: hot-path
+            def dispatch(runner, tokens):
+                arr = np.asarray([1, 2, 3])   # literal: host prep
+                x = float("inf")              # constant: host-only
+                return runner.decode(tokens)
+
+            def cold(x):
+                return float(x)               # unmarked function: fine
+        """,
+        suppressed="""
+            import numpy as np
+
+            # stackcheck: hot-path
+            def fetch_round(pending):
+                # stackcheck: disable=device-sync-hot — THE intended fetch
+                return np.asarray(pending.tokens)
+        """,
+    ),
+    "fire-and-forget-task": dict(
+        positive="""
+            import asyncio
+
+            async def start(loop_fn):
+                asyncio.create_task(loop_fn())
+        """,
+        negative="""
+            import asyncio
+
+            async def start(self, loop_fn):
+                self.task = asyncio.create_task(loop_fn())
+                done = await asyncio.ensure_future(loop_fn())
+                return done
+        """,
+        suppressed="""
+            import asyncio
+
+            async def start(loop_fn):
+                # stackcheck: disable=fire-and-forget-task — daemon-like
+                asyncio.ensure_future(loop_fn())
+        """,
+    ),
+    "guarded-by-lock": dict(
+        positive="""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self.streams = {}  # guarded by: self.lock
+                    self.lock = threading.Lock()
+
+                def deliver(self, rid, out):
+                    self.streams[rid].put(out)
+        """,
+        negative="""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self.streams = {}  # guarded by: self.lock
+                    self.lock = threading.Lock()
+
+                def deliver(self, rid, out):
+                    with self.lock:
+                        self.streams[rid].put(out)
+
+                async def adeliver(self, rid, out):
+                    async with self.lock:
+                        self.streams[rid].put(out)
+        """,
+        suppressed="""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self.streams = {}  # guarded by: self.lock
+                    self.lock = threading.Lock()
+
+                def teardown(self):
+                    # stackcheck: disable=guarded-by-lock — post-join
+                    self.streams.clear()
+        """,
+    ),
+    "silent-except": dict(
+        positive="""
+            def probe(url):
+                try:
+                    return fetch(url)
+                except Exception:
+                    return None
+        """,
+        negative="""
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def probe(url):
+                try:
+                    return fetch(url)
+                except ValueError:      # narrow: fine
+                    return None
+                except Exception as e:
+                    logger.debug("probe failed: %s", e)
+                    return None
+
+            def surface(url):
+                try:
+                    return fetch(url)
+                except Exception as e:
+                    return {"error": str(e)}
+        """,
+        suppressed="""
+            def probe(url):
+                try:
+                    return fetch(url)
+                # stackcheck: disable=silent-except — best-effort probe
+                except Exception:
+                    return None
+        """,
+    ),
+    "mutable-shared-state": dict(
+        positive="""
+            CACHE = {}
+
+            def f(items=[]):
+                return items
+
+            async def handler(key, value):
+                CACHE[key] = value
+        """,
+        negative="""
+            CACHE = {}
+
+            def f(items=None):
+                return items or []
+
+            def initialize(key, value):   # sync initializer: fine
+                CACHE[key] = value
+
+            async def handler(key):
+                return CACHE.get(key)     # read-only access: fine
+        """,
+        suppressed="""
+            SEEN = set()
+
+            async def handler(key):
+                # stackcheck: disable=mutable-shared-state — single loop
+                SEEN.add(key)
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_positive(rule):
+    live = findings_for(FIXTURES[rule]["positive"], rule)
+    assert live, f"{rule}: positive fixture produced no finding"
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_negative(rule):
+    live = findings_for(FIXTURES[rule]["negative"], rule)
+    assert not live, f"{rule}: negative fixture flagged: {live}"
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_suppressed(rule):
+    src = textwrap.dedent(FIXTURES[rule]["suppressed"])
+    all_found = [f for f in analyze_source(src) if f.rule == rule]
+    assert all_found, f"{rule}: suppressed fixture produced no finding"
+    assert all(f.suppressed for f in all_found), (
+        f"{rule}: suppression directive did not apply"
+    )
+
+
+def test_fixture_rules_cover_registry():
+    assert set(FIXTURES) == set(all_rules()), (
+        "every registered rule needs a fixture triple (and vice versa)"
+    )
+
+
+# -- framework behaviors ----------------------------------------------------
+def test_disable_all_and_multi_rule():
+    src = textwrap.dedent("""
+        import asyncio
+        import time
+
+        async def go(loop_fn):
+            # stackcheck: disable=all — fixture
+            time.sleep(1)
+            asyncio.create_task(loop_fn())  # stackcheck: disable=blocking-async,fire-and-forget-task
+    """)
+    assert all(f.suppressed for f in analyze_source(src))
+
+
+def test_suppression_records_justification():
+    src = textwrap.dedent("""
+        import time
+
+        async def go():
+            # stackcheck: disable=blocking-async — calibrated warmup stall
+            time.sleep(1)
+    """)
+    (f,) = analyze_source(src)
+    assert f.suppressed and "calibrated warmup stall" in f.justification
+
+
+def test_falsy_gate_sees_awaited_and_boolop_walruses():
+    src = """
+        from aiohttp import web
+
+        async def check(req):
+            return web.json_response({}, status=400)
+
+        async def handler(req, ready):
+            if err := await check(req):
+                return err
+            if (e2 := await check(req)) and ready:
+                return e2
+    """
+    assert len(findings_for(src, "falsy-walrus-gate")) == 2
+    clean = """
+        from aiohttp import web
+
+        async def check(req):
+            return web.json_response({}, status=400)
+
+        async def handler(req):
+            if (err := await check(req)) is not None:
+                return err
+    """
+    assert not findings_for(clean, "falsy-walrus-gate")
+
+
+def test_comma_space_suppression_covers_later_rules():
+    """`disable=a, b` with the natural comma-space style must suppress
+    rule b too (regression: the rule list used to stop at the space and
+    swallow the rest into the justification)."""
+    src = textwrap.dedent("""
+        import time
+
+        async def go():
+            # stackcheck: disable=silent-except, blocking-async — x
+            time.sleep(1)
+    """)
+    (f,) = analyze_source(src)
+    assert f.suppressed and f.justification == "x"
+
+
+def test_nonexistent_scan_path_raises(tmp_path):
+    with pytest.raises(ValueError, match="not a python file"):
+        analyze_paths([str(tmp_path / "renamed_dir")])
+
+
+def test_multiline_justification_is_folded():
+    src = textwrap.dedent("""
+        import time
+
+        async def go():
+            # stackcheck: disable=blocking-async — calibrated warmup
+            # stall measured against the chip tunnel
+            time.sleep(1)
+    """)
+    (f,) = analyze_source(src)
+    assert f.suppressed
+    assert f.justification == (
+        "calibrated warmup stall measured against the chip tunnel"
+    )
+
+
+def test_wrong_rule_suppression_does_not_apply():
+    src = textwrap.dedent("""
+        import time
+
+        async def go():
+            # stackcheck: disable=silent-except — wrong rule
+            time.sleep(1)
+    """)
+    (f,) = analyze_source(src)
+    assert not f.suppressed
+
+
+def test_hot_path_mark_survives_multiline_comment():
+    """The mark's rationale usually wraps; the whole contiguous comment
+    block above the def must count (regression: only the line directly
+    above used to)."""
+    src = textwrap.dedent("""
+        # stackcheck: hot-path — dispatch-only; any hidden sync here
+        # serializes the whole pipeline (rationale wraps to this line)
+        def dispatch(x):
+            return float(x)
+    """)
+    assert findings_for(src, "device-sync-hot")
+
+
+def test_spawn_watched_handle_must_be_stored():
+    src = textwrap.dedent("""
+        from production_stack_tpu.utils.tasks import spawn_watched
+
+        async def start(loop_fn):
+            spawn_watched(loop_fn(), "bg")
+    """)
+    assert findings_for(src, "fire-and-forget-task")
+
+
+def test_hot_path_decorator_marks_function():
+    src = textwrap.dedent("""
+        def hot_path(fn):
+            return fn
+
+        @hot_path
+        def dispatch(x):
+            return float(x)
+    """)
+    assert findings_for(src, "device-sync-hot")
+
+
+def test_syntax_error_reported_not_raised():
+    found = analyze_source("def broken(:\n")
+    assert [f.rule for f in found] == ["syntax-error"]
+
+
+def test_select_unknown_rule_raises():
+    with pytest.raises(ValueError):
+        analyze_source("x = 1", select=["no-such-rule"])
+
+
+def test_analyze_paths_counts_files(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "b.py").write_text("import time\n\nasync def f():\n"
+                              "    time.sleep(1)\n")
+    report = analyze_paths([str(tmp_path)])
+    assert report.files_scanned == 2
+    assert [f.rule for f in report.unsuppressed] == ["blocking-async"]
+
+
+# -- CLI contract (acceptance criteria) -------------------------------------
+def run_cli(*args: str):
+    return subprocess.run(
+        [sys.executable, "-m", "production_stack_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_cli_exits_nonzero_on_each_rule_violation(rule, tmp_path):
+    f = tmp_path / f"{rule.replace('-', '_')}_violation.py"
+    f.write_text(textwrap.dedent(FIXTURES[rule]["positive"]))
+    proc = run_cli(str(f))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert rule in proc.stdout
+
+
+def test_cli_exits_zero_on_clean_file(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text("import asyncio\n\n\nasync def f():\n"
+                 "    await asyncio.sleep(0)\n")
+    proc = run_cli(str(f))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_output(tmp_path):
+    f = tmp_path / "v.py"
+    f.write_text(textwrap.dedent(FIXTURES["blocking-async"]["positive"]))
+    proc = run_cli(str(f), "--json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["summary"]["unsuppressed"] == 1
+    assert data["findings"][0]["rule"] == "blocking-async"
+    assert data["findings"][0]["line"] > 0
+
+
+def test_cli_usage_error_on_missing_path(tmp_path):
+    proc = run_cli(str(tmp_path / "does_not_exist_dir"))
+    assert proc.returncode == 2
+
+
+# -- tier-1 gate: the repo itself stays clean -------------------------------
+def test_repo_self_scan_is_clean_api():
+    report = analyze_paths([str(PACKAGE)])
+    assert report.files_scanned > 50
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_repo_self_scan_is_clean_cli():
+    """The exact acceptance-criteria invocation: `python -m
+    production_stack_tpu.analysis production_stack_tpu/` exits 0."""
+    proc = run_cli("production_stack_tpu/")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
