@@ -82,6 +82,11 @@ PREFETCH = os.environ.get("PST_BENCH_PREFETCH", "1") == "1"
 # Attribution slots: BENCH_SWEEP_pfpipe.json (on, default) vs
 # BENCH_SWEEP_nopfpipe.json (@nopfpipe label modifier)
 PREFILL_PIPELINE = os.environ.get("PST_BENCH_PREFILL_PIPELINE", "1") == "1"
+# request tracing (engine request_timeline + memory span exporter): the
+# overhead A/B pinning the zero-cost-when-disabled claim. Default OFF so
+# every existing sweep stays a tracing-free control; @trace enables.
+# Slots: BENCH_SWEEP_trace.json (on) vs the matching untraced config
+TRACE = os.environ.get("PST_BENCH_TRACE", "0") == "1"
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -198,10 +203,13 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_PREFETCH"] = "0"
             elif m == "nopfpipe":
                 overrides["PST_BENCH_PREFILL_PIPELINE"] = "0"
+            elif m == "trace":
+                overrides["PST_BENCH_TRACE"] = "1"
             else:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
-                    "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe"
+                    "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
+                    "| trace"
                 )
         kpart, mode, pack = base.split("-")
         # fail fast on typos: a scarce chip window must not silently run
@@ -211,7 +219,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
             raise ValueError(
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
-                "|@chunk<N>|@nopfx|@nopfpipe]"
+                "|@chunk<N>|@nopfx|@nopfpipe|@trace]"
             )
         configs.append((
             label,
@@ -414,6 +422,11 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         async_decode=async_decode,
         prefetch_decode=PREFETCH,
         prefill_pipeline=PREFILL_PIPELINE,
+        # tracing A/B: @trace turns the full recording path on (timeline
+        # + memory span exporter); the default control has every hook
+        # compiled down to one boolean check
+        request_timeline=TRACE,
+        tracing_exporter="memory" if TRACE else "none",
         seed=0,
     )
     engine = LLMEngine(config)
@@ -648,6 +661,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "async_decode": async_decode,
             "prefetch_decode": PREFETCH,
             "prefill_pipeline": PREFILL_PIPELINE,
+            "trace": TRACE,
             "config_label": label,
             "rounds": ROUNDS,
             "decode_tokens_per_s_aggregate": round(decode_tps, 1),

@@ -116,6 +116,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "or a path to a template file")
     p.add_argument("--attention-impl", default="auto",
                    choices=["auto", "xla", "pallas"])
+    # observability: per-request lifecycle timelines + span export
+    p.add_argument("--request-timeline", action="store_true",
+                   default=True,
+                   help="record per-request lifecycle timelines "
+                        "(enqueue/admit/prefill-chunks/first-token/"
+                        "decode-rounds/preempt/finish) served by "
+                        "/debug/requests")
+    p.add_argument("--no-request-timeline", dest="request_timeline",
+                   action="store_false",
+                   help="disable timeline recording (every hook "
+                        "degrades to one boolean check)")
+    p.add_argument("--timeline-ring-size", type=int, default=256,
+                   help="finished timelines kept for /debug/requests")
+    p.add_argument("--tracing-exporter", default="none",
+                   choices=["none", "log", "memory", "otlp"],
+                   help="engine-side span export: one engine_request "
+                        "span per request (child of the router span "
+                        "via the propagated traceparent header)")
     # disaggregated prefill / KV transfer
     p.add_argument("--kv-role", default=None,
                    choices=[None, "kv_producer", "kv_consumer"],
@@ -186,6 +204,9 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         tool_call_parser=args.tool_call_parser,
         api_key=args.api_key,
         attention_impl=args.attention_impl,
+        request_timeline=args.request_timeline,
+        timeline_ring_size=args.timeline_ring_size,
+        tracing_exporter=args.tracing_exporter,
         kv_role=role,
         kv_transfer_config={
             "listen": args.kv_transfer_listen,

@@ -143,6 +143,7 @@ class AsyncLLMEngine:
         sampling_params: SamplingParams | None = None,
         lora_name: str | None = None,
         priority: int = 0,
+        traceparent: str | None = None,
     ) -> AsyncIterator[RequestOutput]:
         if self.sleeping:
             raise EngineSleepingError("engine is sleeping")
@@ -159,6 +160,7 @@ class AsyncLLMEngine:
                     arrival_time=time.time(),
                     lora_name=lora_name,
                     priority=priority,
+                    traceparent=traceparent,
                 )
             self._wake.set()
             while True:
@@ -181,6 +183,12 @@ class AsyncLLMEngine:
         with self._lock:
             return self.engine.abort_request(request_id)
 
+    def has_request(self, request_id: str) -> bool:
+        return self.engine.has_request(request_id)
+
+    def has_request_prefix(self, request_id: str) -> bool:
+        return self.engine.has_request_prefix(request_id)
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> EngineStatsSnapshot:
         with self._lock:
@@ -189,6 +197,16 @@ class AsyncLLMEngine:
     @property
     def tokenizer(self):
         return self.engine.tokenizer
+
+    @property
+    def timeline(self):
+        """Per-request lifecycle recorder (tracing.TimelineRecorder)."""
+        return self.engine.timeline
+
+    @property
+    def tracer(self):
+        """Engine-side span tracer (tracing.RequestTracer)."""
+        return self.engine.tracer
 
     # -- sleep / wake ------------------------------------------------------
     def sleep(self, level: int = 1) -> None:

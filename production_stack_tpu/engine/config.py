@@ -134,6 +134,20 @@ class EngineConfig:
     kv_role: str | None = None
     kv_transfer_config: dict = field(default_factory=dict)
 
+    # -- observability ------------------------------------------------
+    # per-request lifecycle timeline (tracing/timeline.py): enqueue ->
+    # admit -> prefill chunks -> first token -> sampled decode rounds ->
+    # preempt/resume -> finish, served by /debug/requests and exported
+    # as `engine_request` spans. Recording is append-only host work off
+    # the device-dispatch path; False makes every hook a single boolean
+    # check (the bench `@trace` A/B measures the difference, PERF.md).
+    request_timeline: bool = True
+    # finished timelines kept for /debug/requests (bounded ring)
+    timeline_ring_size: int = 256
+    # engine-side span export: "none" | "log" | "memory" | "otlp"
+    # (OTLP/JSON-shaped payloads drained by a watched flush task)
+    tracing_exporter: str = "none"
+
     # KV offload (LMCache-equivalent) tiers
     cpu_offload_bytes: int = 0
     disk_offload_dir: str | None = None

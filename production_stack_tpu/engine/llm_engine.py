@@ -82,6 +82,44 @@ class LLMEngine:
         )
         self._seqs: dict[str, Sequence] = {}
         self.last_step_kind = "idle"  # "prefill" | "decode" | "idle"
+        # -- request-lifecycle timeline (tracing/timeline.py) -------------
+        # one recorder per engine: scheduler admission/preemption events,
+        # per-chunk prefill attribution, first token, sampled decode
+        # rounds, finish. Appends only — no locks or device syncs on the
+        # step path; disabled = a single boolean check per hook (the
+        # per-step call sites additionally guard on _tl_enabled so the
+        # calls themselves vanish).
+        from production_stack_tpu.tracing import (
+            NULL_RECORDER,
+            RequestTracer,
+            TimelineRecorder,
+        )
+
+        exporter = config.tracing_exporter
+        if not config.request_timeline and exporter != "none":
+            # engine spans are DERIVED from timelines (_export_span):
+            # with recording off the exporter would sit silently dead —
+            # degrade loudly instead (same contract as init_sentry) and
+            # drop to "none" so no pointless flush loop spawns either
+            logger.warning(
+                "engine span export DISABLED: tracing_exporter=%r "
+                "requires request timelines (drop "
+                "--no-request-timeline to export engine_request spans)",
+                exporter,
+            )
+            exporter = "none"
+        self.tracer = RequestTracer(
+            exporter,
+            service_name=config.served_model_name or config.model,
+        )
+        if config.request_timeline:
+            self.timeline = TimelineRecorder(
+                maxlen=config.timeline_ring_size, tracer=self.tracer
+            )
+        else:
+            self.timeline = NULL_RECORDER
+        self._tl_enabled = self.timeline.enabled
+        self.scheduler.timeline = self.timeline
         # async decode pipeline (double-buffered dispatch): the in-flight
         # decode round whose sampled tokens are still ON DEVICE
         self._pending_decode: dict | None = None
@@ -251,6 +289,7 @@ class LLMEngine:
         arrival_time: float | None = None,
         lora_name: str | None = None,
         priority: int = 0,
+        traceparent: str | None = None,
     ) -> None:
         if request_id in self._seqs:
             raise ValueError(f"duplicate request_id {request_id!r}")
@@ -366,12 +405,34 @@ class LLMEngine:
             seq._guided_state = machine.initial()  # type: ignore[attr-defined]
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
+        self.timeline.start(
+            request_id,
+            arrival_time=seq.metrics.arrival_time,
+            traceparent=traceparent,
+            prompt_tokens=seq.num_prompt_tokens,
+            priority=seq.priority,
+        )
 
     def abort_request(self, request_id: str) -> bool:
         seq = self._seqs.pop(request_id, None)
         if seq is None:
             return False
-        return self.scheduler.abort(request_id)
+        aborted = self.scheduler.abort(request_id)
+        self.timeline.finish(request_id, "abort")
+        return aborted
+
+    def has_request(self, request_id: str) -> bool:
+        """True while `request_id` is in flight (GIL-atomic dict probe;
+        the server uses it to de-conflict router-supplied ids)."""
+        return request_id in self._seqs
+
+    def has_request_prefix(self, request_id: str) -> bool:
+        """True while any `<request_id>-c<i>` multi-choice sub-request
+        is in flight. list() snapshots the key view atomically so the
+        scan never races the step thread's pops; the dict is bounded by
+        max_num_seqs + waiting, so the scan is tiny."""
+        pref = f"{request_id}-c"
+        return any(k.startswith(pref) for k in list(self._seqs))
 
     def has_unfinished(self) -> bool:
         # an in-flight async decode round counts as unfinished work even
@@ -532,6 +593,12 @@ class LLMEngine:
                         ],
                     }
                 self._append_token(seq, int(toks[i, j]), entry)
+        if self._tl_enabled:
+            # one SAMPLED timeline tick per request per fused round
+            # (tracing.DECODE_EVENT_EVERY), not per token
+            for seq in seqs:
+                if not seq.finished:
+                    self.timeline.decode_round(seq.request_id, k)
 
     # -- the step loop ----------------------------------------------------
     # stackcheck: hot-path — the async-decode round trip: dispatch the
@@ -613,6 +680,7 @@ class LLMEngine:
             self._finished_total += 1
             outputs.append(self._make_output(seq))
             self._seqs.pop(seq.request_id, None)
+            self.timeline.finish(seq.request_id, seq.finish_reason)
 
         stepped: list[Sequence] = []
         if sched_out.prefills:
@@ -632,8 +700,11 @@ class LLMEngine:
             # entire prefill. Bounded, the remaining chunks keep
             # draining via staged zero-cost admission on later rounds.
             chain_budget = self.scheduler.config.max_staged_prefill_run
+            chained = False
             while True:
-                stepped.extend(self._run_prefill_works(works, staged))
+                stepped.extend(
+                    self._run_prefill_works(works, staged, chained=chained)
+                )
                 staged = None
                 if chain_budget <= 0:
                     break
@@ -642,6 +713,7 @@ class LLMEngine:
                     break
                 chain_budget -= 1
                 self._pf_chained_chunks_total += len(nxt)
+                chained = True
                 works = nxt
             self._maybe_stage_prefill(works)
         elif sched_out.decode is not None:
@@ -790,6 +862,10 @@ class LLMEngine:
                         )
                     self._append_token(seq, int(token), entry)
                     stepped.append(seq)
+                if self._tl_enabled:
+                    for seq in seqs:
+                        if not seq.finished:
+                            self.timeline.decode_round(seq.request_id, 1)
 
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
@@ -918,18 +994,25 @@ class LLMEngine:
 
     def _run_prefill_works(
         self, works: list[PrefillWork], staged: dict | None = None,
+        chained: bool = False,
     ) -> list[Sequence]:
         """Dispatch one scheduled prefill chunk group (the body of the
         prefill step): prompt_logprobs sequences on the single-sequence
         program variant, everything else in one packed dispatch, first
         tokens appended for final chunks. Returns the stepped sequences.
         `staged` = a _maybe_stage_prefill record; used when its
-        fingerprint matches this exact group."""
+        fingerprint matches this exact group. `chained` marks groups
+        dispatched by cold-prompt chaining (no host round-trip since the
+        previous group) for the timeline."""
         stepped: list[Sequence] = []
         now = time.time()
         for w in works:
             if w.seq.metrics.first_scheduled_time is None:
                 w.seq.metrics.first_scheduled_time = now
+        staged_hit = False
+        phase_snap = (
+            self.runner.phase_snapshot() if self._tl_enabled else None
+        )
         staged_kw = {}
         if staged is not None:
             if staged["fp"] == self._prefill_fingerprint(works):
@@ -937,6 +1020,7 @@ class LLMEngine:
                 # device — zero serial h2d for this dispatch
                 staged_kw = {"staged": staged["handle"]}
                 self._pf_staged_hits_total += 1
+                staged_hit = True
             else:
                 self._pf_staged_misses_total += 1
                 self.scheduler.note_staged_prefill_miss()
@@ -1045,6 +1129,27 @@ class LLMEngine:
         for i, w in enumerate(works):
             w.seq.num_computed_tokens += w.chunk_len
             self._prompt_tokens_total += w.chunk_len
+        if self._tl_enabled:
+            # one event per chunk, attributed with the dispatch group's
+            # per-phase wall time (delta over the runner's tpu:prefill_*
+            # counters — the group shares one dispatch, so the phases
+            # are group-level, tagged with the group size)
+            phases = self.runner.phase_delta(phase_snap)
+            for w in works:
+                self.timeline.event(
+                    w.seq.request_id, "prefill_chunk",
+                    {
+                        "chunk_start": w.chunk_start,
+                        "chunk_len": w.chunk_len,
+                        "last": w.is_last_chunk,
+                        "staged_hit": staged_hit,
+                        "chained": chained,
+                        "group_size": len(works),
+                        **(
+                            {"group_phase_s": phases} if phases else {}
+                        ),
+                    },
+                )
         finals = [
             (i, w) for i, w in enumerate(works) if w.is_last_chunk
         ]
@@ -1220,6 +1325,10 @@ class LLMEngine:
                     break  # EOS/stop fired mid-acceptance; drop the rest
                 seq.num_computed_tokens = seq.num_tokens
                 self._append_token(seq, int(t))
+            if self._tl_enabled and not seq.finished:
+                self.timeline.decode_round(
+                    seq.request_id, len(new_tokens)
+                )
             stepped.append(seq)
         self.last_step_kind = "decode"
         return stepped
@@ -1237,6 +1346,13 @@ class LLMEngine:
                 self._finished_total += 1
                 self.scheduler.free_finished(seq)
                 self._seqs.pop(seq.request_id, None)
+                self.timeline.finish(
+                    seq.request_id, seq.finish_reason,
+                    {
+                        "generated_tokens": len(seq.generated_token_ids),
+                        "preemptions": seq.metrics.num_preemptions,
+                    } if self._tl_enabled else None,
+                )
         return outputs
 
     # -- internals ---------------------------------------------------------
@@ -1729,6 +1845,14 @@ class LLMEngine:
                       logprob_entry: dict | None = None) -> None:
         if seq.metrics.first_token_time is None:
             seq.metrics.first_token_time = time.time()
+            if self._tl_enabled:
+                self.timeline.event(
+                    seq.request_id, "first_token",
+                    {"ttft_s": round(
+                        seq.metrics.first_token_time
+                        - seq.metrics.arrival_time, 6,
+                    )},
+                )
         seq.append_token(int(token))
         self._generation_tokens_total += 1
         machine = getattr(seq, "_guided_machine", None)

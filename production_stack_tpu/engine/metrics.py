@@ -136,6 +136,26 @@ class EngineMetrics:
             "vllm:e2e_request_latency_seconds", "End-to-end request latency",
             label, buckets=_LATENCY_BUCKETS, registry=reg,
         )
+        # request-lifecycle attribution (fed from RequestMetrics at
+        # finish): TTFT = queue-wait + scheduling delay + prefill, and
+        # these split the first two out so a TTFT regression is
+        # attributable without reading per-request timelines
+        self.queue_time = Histogram(
+            "tpu:request_queue_seconds",
+            "Enqueue -> scheduler admission (waiting-queue wait)",
+            label, buckets=_LATENCY_BUCKETS, registry=reg,
+        )
+        self.sched_delay = Histogram(
+            "tpu:scheduling_delay_seconds",
+            "Scheduler admission -> first prefill dispatch",
+            label, buckets=_LATENCY_BUCKETS, registry=reg,
+        )
+        self.preempt_stall = Histogram(
+            "tpu:preemption_stall_seconds",
+            "Wall time spent preempted (preempt -> re-admission), "
+            "summed per request; observed only for preempted requests",
+            label, buckets=_LATENCY_BUCKETS, registry=reg,
+        )
         self._counter_state = EngineStatsSnapshot()
 
     def update_from_snapshot(self, s: EngineStatsSnapshot) -> None:
@@ -194,6 +214,9 @@ class EngineMetrics:
         ttft_s: float | None,
         e2e_s: float | None,
         n_output_tokens: int,
+        queue_s: float | None = None,
+        sched_delay_s: float | None = None,
+        preempt_stall_s: float | None = None,
     ) -> None:
         m = self.model_name
         self.request_success.labels(m, finish_reason).inc()
@@ -205,3 +228,11 @@ class EngineMetrics:
                 self.tpot.labels(m).observe(
                     (e2e_s - ttft_s) / (n_output_tokens - 1)
                 )
+        if queue_s is not None:
+            self.queue_time.labels(m).observe(max(0.0, queue_s))
+        if sched_delay_s is not None:
+            self.sched_delay.labels(m).observe(max(0.0, sched_delay_s))
+        if preempt_stall_s is not None:
+            # only preempted requests observe (a zero-flood would bury
+            # the signal); panels rate() over preemption events
+            self.preempt_stall.labels(m).observe(max(0.0, preempt_stall_s))

@@ -451,6 +451,21 @@ class ModelRunner:
         self.prefill_phase_s[name] += dt
         self.prefill_phase_n[name] += 1
 
+    # -- per-dispatch phase attribution (request timelines) -----------------
+    # A snapshot/delta pair around one dispatch attributes its prep/h2d/
+    # dispatch/fetch wall time to the requests it served (the engine's
+    # prefill_chunk timeline events). Pure host dict copies: no device
+    # handle is touched, so the marked hot paths stay sync-free.
+    def phase_snapshot(self) -> dict[str, float]:
+        return dict(self.prefill_phase_s)
+
+    def phase_delta(self, snapshot: dict[str, float]) -> dict[str, float]:
+        return {
+            k: round(v - snapshot.get(k, 0.0), 6)
+            for k, v in self.prefill_phase_s.items()
+            if v - snapshot.get(k, 0.0) > 0.0
+        }
+
     @staticmethod
     def _layout_of(fields: list[tuple[str, tuple[int, ...]]]):
         layout: dict[str, tuple[int, tuple[int, ...]]] = {}

@@ -28,6 +28,7 @@ of how many new users are admitted.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -122,6 +123,10 @@ class Scheduler:
         # optional hook (LLMEngine._restore_from_offload): pull offloaded
         # KV blocks back into HBM before prompt allocation
         self.kv_restore = None
+        # optional request-lifecycle recorder (tracing.TimelineRecorder,
+        # set by LLMEngine): admit/resume/preempt events for the
+        # per-request timeline; None/disabled costs one check
+        self.timeline = None
         self._prefill_streak = 0  # consecutive prefill steps scheduled
         # engine-maintained hint (pipelined prefill): the next prefill
         # dispatch's packed buffer is already on device, so admitting it
@@ -230,6 +235,7 @@ class Scheduler:
             seq.status = SequenceStatus.RUNNING
             self.waiting.popleft()
             self.running.append(seq)
+            self._note_admitted(seq)
         # priority policy: a waiting higher-priority request CLAIMS a
         # lane from a running lower-priority one (vLLM preempts for
         # priority, not just for block exhaustion) — without this,
@@ -360,6 +366,33 @@ class Scheduler:
             out.decode = DecodeWork(seqs=decode_seqs)
         return out
 
+    def _note_admitted(self, seq: Sequence) -> None:
+        """Queue-wait/stall bookkeeping + timeline event on each
+        WAITING/PREEMPTED -> RUNNING transition. Admission is off the
+        device-dispatch path, so the time.time() stamps here are free."""
+        now = time.time()
+        m = seq.metrics
+        resumed = m.last_preempt_time is not None
+        if resumed:
+            m.preempt_stall_s += now - m.last_preempt_time
+            m.last_preempt_time = None
+        if m.admitted_time is None:
+            m.admitted_time = now
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            tl.event(
+                seq.request_id,
+                "resume" if resumed else "admit",
+                {
+                    "queue_wait_s": round(now - m.arrival_time, 6),
+                    "cached_prompt_tokens": m.num_cached_prompt_tokens,
+                    **(
+                        {"stall_s": round(m.preempt_stall_s, 6)}
+                        if resumed else {}
+                    ),
+                },
+            )
+
     def note_staged_prefill_miss(self) -> None:
         """The engine found the staged prefill buffer stale at dispatch
         time (fingerprint mismatch): the dispatch paid the full serial
@@ -459,3 +492,9 @@ class Scheduler:
         seq.reset_for_recompute()
         self.waiting.appendleft(seq)
         out.preempted.append(seq)
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            tl.event(
+                seq.request_id, "preempt",
+                {"num_preemptions": seq.metrics.num_preemptions},
+            )

@@ -31,11 +31,17 @@ class SequenceStatus(enum.Enum):
 @dataclass
 class RequestMetrics:
     arrival_time: float = field(default_factory=time.time)
+    # first admission WAITING -> RUNNING (queue-wait = admitted - arrival)
+    admitted_time: float | None = None
     first_scheduled_time: float | None = None
     first_token_time: float | None = None
     finished_time: float | None = None
     num_cached_prompt_tokens: int = 0
     num_preemptions: int = 0
+    # wall seconds spent preempted (preempt -> re-admission), summed over
+    # every preemption; feeds tpu:preemption_stall_seconds
+    preempt_stall_s: float = 0.0
+    last_preempt_time: float | None = None
 
 
 class Sequence:
@@ -189,3 +195,4 @@ class Sequence:
         self.block_hashes = []
         self.status = SequenceStatus.PREEMPTED
         self.metrics.num_preemptions += 1
+        self.metrics.last_preempt_time = time.time()

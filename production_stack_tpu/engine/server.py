@@ -36,6 +36,13 @@ from production_stack_tpu.engine.metrics import EngineMetrics
 from production_stack_tpu.engine import protocol as proto
 from production_stack_tpu.engine import tools
 from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.tracing import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    log_otlp_payload,
+    otlp_flush_loop,
+    valid_request_id,
+)
 from production_stack_tpu.utils import init_logger
 from production_stack_tpu.utils.tasks import spawn_watched
 
@@ -86,6 +93,7 @@ class EngineServer:
         r.add_get("/health", self.handle_health)
         r.add_get("/version", self.handle_version)
         r.add_get("/metrics", self.handle_metrics)
+        r.add_get("/debug/requests", self.handle_debug_requests)
         r.add_post("/sleep", self.handle_sleep)
         r.add_post("/wake_up", self.handle_wake)
         r.add_get("/is_sleeping", self.handle_is_sleeping)
@@ -123,6 +131,10 @@ class EngineServer:
     async def _on_startup(self, app: web.Application) -> None:
         self.engine.start(asyncio.get_running_loop())
         self._stats_task = spawn_watched(self._stats_loop(), "engine-stats")
+        if self.engine.tracer.exporter == "otlp":
+            self._trace_flush_task = spawn_watched(
+                otlp_flush_loop(self.engine.tracer), "engine-trace-flush"
+            )
         # disaggregated prefill producer: serve KV blocks to decode peers
         # (reference: NIXL sender role, LMCACHE_NIXL_ROLE=sender)
         listen = (self.config.kv_transfer_config or {}).get("listen")
@@ -137,6 +149,11 @@ class EngineServer:
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._stats_task:
             self._stats_task.cancel()
+        if getattr(self, "_trace_flush_task", None) is not None:
+            self._trace_flush_task.cancel()
+            # final drain: up to a flush interval of spans is still
+            # buffered — a graceful stop must not drop them
+            log_otlp_payload(self.engine.tracer)
         if getattr(self, "_kv_transfer_server", None) is not None:
             await self._kv_transfer_server.stop()
         self.engine.shutdown()
@@ -205,8 +222,48 @@ class EngineServer:
         )
         e2e = time.time() - arrival
         self.metrics.observe_request(
-            out.finish_reason or "stop", ttft, e2e, len(out.token_ids)
+            out.finish_reason or "stop", ttft, e2e, len(out.token_ids),
+            queue_s=(
+                m.admitted_time - m.arrival_time
+                if m.admitted_time is not None else None
+            ),
+            sched_delay_s=(
+                m.first_scheduled_time - m.admitted_time
+                if (m.first_scheduled_time is not None
+                    and m.admitted_time is not None) else None
+            ),
+            preempt_stall_s=(
+                m.preempt_stall_s if m.num_preemptions > 0 else None
+            ),
         )
+
+    # -- request identity + trace context ----------------------------------
+    def _request_identity(
+        self, request: web.Request, prefix: str
+    ) -> tuple[str, str | None]:
+        """(request_id, traceparent) for one inbound HTTP request.
+
+        A router-supplied `x-request-id` becomes the ENGINE-side request
+        id (and is echoed on the response) so logs, spans, and timelines
+        join on one id end-to-end; ids failing the charset/length gate
+        fall back to a fresh one. A supplied id that is still IN FLIGHT
+        (client timeout-retry with a stable id, or two clients
+        colliding) also falls back — correlation degrades for that
+        retry, but the request is served instead of 400ing the way a
+        hard duplicate would. The `traceparent` passes through verbatim
+        — the timeline recorder validates it (malformed -> fresh
+        trace)."""
+        rid = request.headers.get(REQUEST_ID_HEADER)
+        if (
+            not valid_request_id(rid)
+            or self.engine.has_request(rid)
+            # multi-choice requests register per-choice `<rid>-c<i>`
+            # sub-ids (any of which may still be running after others
+            # finished), so a retried n>1 request collides on those
+            or self.engine.has_request_prefix(rid)
+        ):
+            rid = proto.make_id(prefix)
+        return rid, request.headers.get(TRACEPARENT_HEADER)
 
     # -- completions -------------------------------------------------------
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
@@ -281,7 +338,7 @@ class EngineServer:
                 status=400,
             )
 
-        request_id = proto.make_id("cmpl")
+        request_id, traceparent = self._request_identity(request, "cmpl")
         prompt_ids_list: list[list[int]] = []
         for p in raw_prompts:
             ids = (
@@ -315,9 +372,11 @@ class EngineServer:
                 include_usage=self._wants_usage(body),
                 echo_prefixes=echo_prefixes,
                 priority=req_priority,
+                traceparent=traceparent,
             )
         kwargs = {"prompt_token_ids": prompt_ids_list[0],
-                  "priority": req_priority}
+                  "priority": req_priority,
+                  "traceparent": traceparent}
         if body.get("stream"):
             return await self._stream_completion(
                 request, request_id, sp, kwargs, lora_name, chat=False,
@@ -381,7 +440,9 @@ class EngineServer:
                 proto.error_json(f"chat template error: {e}"), status=400
             )
 
-        request_id = proto.make_id("chatcmpl")
+        request_id, traceparent = self._request_identity(
+            request, "chatcmpl"
+        )
         prompt_ids = self.engine.tokenizer.encode(prompt)
         prompt_ids = self._apply_truncation(prompt_ids, sp)
         err = self._check_context_len(prompt_ids)
@@ -400,6 +461,7 @@ class EngineServer:
                 include_usage=self._wants_usage(body),
                 parse_tools=use_tools,
                 priority=req_priority,
+                traceparent=traceparent,
             )
         if body.get("stream"):
             # streamed responses pass tool-call text through verbatim
@@ -407,13 +469,15 @@ class EngineServer:
             return await self._stream_completion(
                 request, request_id, sp,
                 {"prompt_token_ids": prompt_ids,
-                 "priority": req_priority},
+                 "priority": req_priority,
+                 "traceparent": traceparent},
                 lora_name, chat=True,
                 include_usage=self._wants_usage(body),
             )
         return await self._blocking_completion(
             request_id, sp,
-            {"prompt_token_ids": prompt_ids, "priority": req_priority},
+            {"prompt_token_ids": prompt_ids, "priority": req_priority,
+             "traceparent": traceparent},
             lora_name,
             chat=True,
             model=body.get("model") or self.model_name,
@@ -537,6 +601,10 @@ class EngineServer:
         parse_tools: bool = False, echo_prefix: str | None = None,
     ) -> web.Response:
         arrival = time.time()
+        # correlation echo: the response carries the (possibly
+        # router-supplied) engine request id so clients/routers join
+        # logs, spans, and timelines on one id
+        rid_hdr = {REQUEST_ID_HEADER: request_id}
         final = None
         try:
             async for out in self.engine.generate(
@@ -547,10 +615,11 @@ class EngineServer:
             return web.json_response(
                 proto.error_json("engine is sleeping", "service_unavailable",
                                  503),
-                status=503,
+                status=503, headers=rid_hdr,
             )
         except ValueError as e:
-            return web.json_response(proto.error_json(str(e)), status=400)
+            return web.json_response(proto.error_json(str(e)), status=400,
+                                     headers=rid_hdr)
         assert final is not None
         self._observe_finish(final, arrival)
         if chat:
@@ -569,7 +638,7 @@ class EngineServer:
                 resp["choices"][0]["prompt_logprobs"] = (
                     final.prompt_logprobs
                 )
-            return web.json_response(resp)
+            return web.json_response(resp, headers=rid_hdr)
         resp = proto.completion_response(
             request_id, model,
             (echo_prefix or "") + final.text, final.finish_reason,
@@ -581,7 +650,7 @@ class EngineServer:
         resp["choices"][0]["logprobs"] = self._fmt_completion_logprobs(
             final.logprobs
         )
-        return web.json_response(resp)
+        return web.json_response(resp, headers=rid_hdr)
 
     async def _multi_completion(
         self, request: web.Request, request_id: str, sp: SamplingParams,
@@ -590,6 +659,7 @@ class EngineServer:
         include_usage: bool = False, parse_tools: bool = False,
         echo_prefixes: list[str] | None = None,
         priority: int = 0,
+        traceparent: str | None = None,
     ) -> web.StreamResponse:
         """Batch prompts and/or n>1 sampling: fan the choices out as
         engine sub-requests (continuous batching coalesces them on
@@ -599,6 +669,7 @@ class EngineServer:
         import dataclasses
 
         arrival = time.time()
+        rid_hdr = {REQUEST_ID_HEADER: request_id}
         n = sp.n
         plan: list[tuple[int, SamplingParams, list[int]]] = []
         for pi, ids in enumerate(prompt_ids_list):
@@ -614,7 +685,7 @@ class EngineServer:
             async for out in self.engine.generate(
                 f"{request_id}-c{idx}", sampling_params=sp_i,
                 lora_name=lora_name, prompt_token_ids=ids,
-                priority=priority,
+                priority=priority, traceparent=traceparent,
             ):
                 final = out
             return final
@@ -637,11 +708,12 @@ class EngineServer:
                     return web.json_response(
                         proto.error_json("engine is sleeping",
                                          "service_unavailable", 503),
-                        status=503,
+                        status=503, headers=rid_hdr,
                     )
                 if isinstance(e, ValueError):
                     return web.json_response(
-                        proto.error_json(str(e)), status=400
+                        proto.error_json(str(e)), status=400,
+                        headers=rid_hdr,
                     )
                 if isinstance(e, (asyncio.CancelledError, KeyboardInterrupt,
                                   SystemExit)):
@@ -650,7 +722,7 @@ class EngineServer:
                 return web.json_response(
                     proto.error_json(f"internal error: {e}",
                                      "internal_error", 500),
-                    status=500,
+                    status=500, headers=rid_hdr,
                 )
             choices = []
             for (idx, _, _), final in zip(plan, finals):
@@ -688,7 +760,7 @@ class EngineServer:
                 request_id, model, chat, choices,
                 sum(len(ids) for ids in prompt_ids_list),
                 sum(len(f.token_ids) for f in finals),
-            ))
+            ), headers=rid_hdr)
 
         # streamed: interleave per-choice chunks tagged with their index
         resp = web.StreamResponse(
@@ -697,6 +769,7 @@ class EngineServer:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                REQUEST_ID_HEADER: request_id,
             },
         )
         await resp.prepare(request)
@@ -722,7 +795,7 @@ class EngineServer:
                 async for out in self.engine.generate(
                     f"{request_id}-c{idx}", sampling_params=sp_i,
                     lora_name=lora_name, prompt_token_ids=ids,
-                    priority=priority,
+                    priority=priority, traceparent=traceparent,
                 ):
                     final = out
                     if out.delta_text or out.new_logprobs:
@@ -810,6 +883,7 @@ class EngineServer:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                REQUEST_ID_HEADER: request_id,
             },
         )
         await resp.prepare(request)
@@ -882,6 +956,14 @@ class EngineServer:
             await resp.write(
                 b"data: "
                 + json.dumps(proto.error_json("engine is sleeping")).encode()
+                + b"\n\n"
+            )
+        except ValueError as e:
+            # e.g. duplicate router-supplied x-request-id: the stream is
+            # already prepared, so the error rides an SSE chunk
+            await resp.write(
+                b"data: "
+                + json.dumps(proto.error_json(str(e))).encode()
                 + b"\n\n"
             )
         except (ConnectionResetError, asyncio.CancelledError):
@@ -1115,6 +1197,25 @@ class EngineServer:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    async def handle_debug_requests(
+        self, request: web.Request
+    ) -> web.Response:
+        """Recent request lifecycle timelines (bounded ring) + in-flight
+        ones: enqueue -> admit -> prefill chunks (staged/chained flags)
+        -> first token -> sampled decode rounds -> preempt/resume ->
+        finish. ?limit=N caps the finished-timeline count."""
+        from production_stack_tpu.tracing import debug_requests_payload
+
+        recorder = self.engine.timeline
+        return web.json_response(debug_requests_payload(
+            request.query.get("limit"),
+            enabled=recorder.enabled,
+            snapshot=lambda n: recorder.snapshot(limit=n),
+            hint="start the engine with request_timeline=True (drop "
+                 "--no-request-timeline) to record per-request "
+                 "lifecycle timelines",
+        ))
 
     # -- sleep/wake (reference: service_discovery.py:414-441 probes these) -
     async def handle_sleep(self, request: web.Request) -> web.Response:

@@ -67,6 +67,7 @@ class RouterApp:
         self.pii_middleware = None
         self.app = web.Application(middlewares=[self._error_middleware])
         self._log_stats_task: asyncio.Task | None = None
+        self._trace_flush_task: asyncio.Task | None = None
         self._initialize_all()
         self._add_routes()
 
@@ -210,6 +211,7 @@ class RouterApp:
         r.add_get("/health", self.handle_health)
         r.add_get("/metrics", self.handle_metrics)
         r.add_get("/engines", self.handle_engines)
+        r.add_get("/debug/requests", self.handle_debug_requests)
         r.add_post("/sleep", self._sleep_wake_handler)
         r.add_post("/wake_up", self._sleep_wake_handler)
         r.add_get("/is_sleeping", self._sleep_wake_handler)
@@ -257,10 +259,22 @@ class RouterApp:
         if self.args.log_stats:
             self._log_stats_task = spawn_watched(
                 self._log_stats_loop(), "router-log-stats")
+        if self.tracer.exporter == "otlp":
+            from production_stack_tpu.tracing import otlp_flush_loop
+
+            self._trace_flush_task = spawn_watched(
+                otlp_flush_loop(self.tracer), "router-trace-flush")
 
     async def _on_cleanup(self, app: web.Application) -> None:
         if self._log_stats_task:
             self._log_stats_task.cancel()
+        if self._trace_flush_task is not None:
+            self._trace_flush_task.cancel()
+            # final drain so the last partial interval's spans aren't
+            # dropped with the cancellation
+            from production_stack_tpu.tracing import log_otlp_payload
+
+            log_otlp_payload(self.tracer)
         if self.batch_processor is not None:
             await self.batch_processor.close()
         router = get_routing_logic()
@@ -361,6 +375,23 @@ class RouterApp:
                 "request_stats": dataclasses.asdict(rs) if rs else None,
             })
         return web.json_response({"engines": out})
+
+    async def handle_debug_requests(
+        self, request: web.Request
+    ) -> web.Response:
+        """Recent proxied-request spans (route decision, backend, TTFT
+        event, status) from the tracer's bounded ring. The engine-side
+        counterpart (/debug/requests on each engine) holds the matching
+        request timelines — join on trace_id / x-request-id."""
+        from production_stack_tpu.tracing import debug_requests_payload
+
+        return web.json_response(debug_requests_payload(
+            request.query.get("limit"),
+            enabled=self.tracer.enabled,
+            snapshot=lambda n: self.tracer.recent(limit=n),
+            hint="start the router with --tracing-exporter "
+                 "log|memory|otlp to record request spans",
+        ))
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus exposition: router gauges + psutil host stats

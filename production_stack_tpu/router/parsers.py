@@ -153,9 +153,14 @@ def build_parser() -> argparse.ArgumentParser:
     misc.add_argument("--sentry-profile-session-sample-rate", type=float,
                       default=0.1)
     misc.add_argument("--tracing-exporter", type=str, default="none",
-                      choices=["none", "log", "memory"],
+                      choices=["none", "log", "memory", "otlp"],
                       help="per-request span export: structured JSON log "
-                           "lines, in-memory buffer, or off")
+                           "lines, in-memory buffer, OTLP/JSON-shaped "
+                           "payloads (flushed by a watched background "
+                           "task), or off. Spans also feed "
+                           "/debug/requests; the traceparent header "
+                           "injected on proxied requests links engine "
+                           "spans/timelines to the router span")
 
     sem = p.add_argument_group("semantic cache")
     sem.add_argument("--semantic-cache-model", type=str,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import time
 import uuid
 
@@ -35,6 +36,11 @@ from production_stack_tpu.router.stats.engine_stats import (
 from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
 )
+from production_stack_tpu.tracing import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    parse_traceparent,
+)
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -52,6 +58,17 @@ def _forward_headers(request: web.Request) -> dict[str, str]:
         for k, v in request.headers.items()
         if k.lower() not in _HOP_HEADERS
     }
+
+
+def _set_header(headers: dict[str, str], name: str, value: str) -> None:
+    """Replace a header CASE-INSENSITIVELY in a plain forwarded-header
+    dict. A bare `headers[name] = value` would leave a client-sent
+    'Traceparent'/'X-Request-Id' casing as a SECOND entry — aiohttp
+    sends both and the engine reads the first (the client's), silently
+    replacing the router's injected context."""
+    for k in [k for k in headers if k.lower() == name.lower()]:
+        del headers[k]
+    headers[name] = value
 
 
 class RequestService:
@@ -202,20 +219,48 @@ class RequestService:
         monitor.on_new_request(
             stats_url, request_id, time.time(), prompt_tokens
         )
+        # correlation: the engine adopts this id as ITS request id (and
+        # echoes it back), so router logs/spans and engine logs/spans/
+        # timelines join end-to-end — previously the generated id was
+        # dropped on the engine floor
+        headers = _forward_headers(request)
+        _set_header(headers, REQUEST_ID_HEADER, request_id)
         span = None
         if self.tracer.enabled:
+            # continue the CLIENT's trace when it sent a valid
+            # traceparent; the legacy x-trace-id override applies only
+            # WITHOUT one (combining them would parent the span into a
+            # different trace than its trace_id names) and only when it
+            # is a spec-valid 32-hex trace id — an opaque legacy value
+            # would make the injected traceparent unparseable (silently
+            # detaching the engine) and its OTLP traceId invalid, so it
+            # rides as an attribute instead
+            parent = parse_traceparent(
+                request.headers.get(TRACEPARENT_HEADER)
+            )
+            legacy = request.headers.get("x-trace-id")
+            trace_id = None
+            attrs = {
+                "request_id": request_id,
+                "backend": backend_url,
+                "endpoint": endpoint_path,
+                "model": body.get("model"),
+                "prompt_tokens_est": prompt_tokens,
+                "stream": bool(body.get("stream")),
+            }
+            if legacy is not None and parent is None:
+                if re.fullmatch(r"[0-9a-f]{32}", legacy):
+                    trace_id = legacy
+                else:
+                    attrs["legacy_trace_id"] = legacy
             span = self.tracer.start_span(
                 "proxy_request",
-                trace_id=request.headers.get("x-trace-id"),
-                attributes={
-                    "request_id": request_id,
-                    "backend": backend_url,
-                    "endpoint": endpoint_path,
-                    "model": body.get("model"),
-                    "prompt_tokens_est": prompt_tokens,
-                    "stream": bool(body.get("stream")),
-                },
+                trace_id=trace_id,
+                parent=parent,
+                attributes=attrs,
             )
+            # engine spans/timelines become children of this span
+            _set_header(headers, TRACEPARENT_HEADER, span.traceparent)
         self.in_flight += 1
         first_chunk_seen = False
         # store-after-response for the semantic cache (reference:
@@ -231,7 +276,7 @@ class RequestService:
             async with self.session.post(
                 f"{backend_url}{endpoint_path}",
                 json=body,
-                headers=_forward_headers(request),
+                headers=headers,
             ) as upstream:
                 resp = web.StreamResponse(
                     status=upstream.status,
@@ -329,7 +374,8 @@ class RequestService:
         self.in_flight += 1
         try:
             async with self.session.post(
-                f"{url}{endpoint_path}", json=body
+                f"{url}{endpoint_path}", json=body,
+                headers={REQUEST_ID_HEADER: request_id},
             ) as upstream:
                 monitor.on_request_response(url, request_id, time.time())
                 payload = await upstream.json(content_type=None)
@@ -368,7 +414,7 @@ class RequestService:
 
         monitor = get_request_stats_monitor()
         headers = _forward_headers(request)
-        headers["x-request-id"] = request_id
+        _set_header(headers, REQUEST_ID_HEADER, request_id)
 
         # Phase 1: prefill with max_tokens=1, KV lands in the transfer tier
         prefill_body = dict(body)

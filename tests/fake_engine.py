@@ -42,6 +42,10 @@ class FakeEngine:
         self.num_tokens = num_tokens
         self.model_label = model_label
         self.requests_seen: list[dict] = []
+        # request headers as received (trace-propagation tests assert
+        # the router injected x-request-id + traceparent)
+        self.headers_seen: list[dict] = []
+        self.raw_headers_seen: list[list] = []
         self.running = 0
         self.sleeping = False
         self.app = web.Application()
@@ -85,10 +89,17 @@ class FakeEngine:
     async def _generate(self, request: web.Request, chat: bool):
         body = await request.json()
         self.requests_seen.append(body)
+        self.headers_seen.append(dict(request.headers))
+        # raw (key, value) pairs preserve duplicate headers that the
+        # dict() above collapses (trace-header replacement tests)
+        self.raw_headers_seen.append(list(request.headers.items()))
         self.running += 1
         try:
             n = int(body.get("max_tokens", self.num_tokens))
-            rid = f"cmpl-{uuid.uuid4().hex}"
+            # honor a router-supplied correlation id (real-engine parity)
+            rid = request.headers.get(
+                "x-request-id"
+            ) or f"cmpl-{uuid.uuid4().hex}"
             if self.ttft_s:
                 await asyncio.sleep(self.ttft_s)
             interval = 1.0 / self.tokens_per_sec

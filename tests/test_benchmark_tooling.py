@@ -224,6 +224,10 @@ def test_sweep_label_modifiers_parse():
     assert ov2 == {"PST_BENCH_PREFILL_CHUNK": "1024"}
     assert cfgs[2][4] == {"PST_BENCH_PREFETCH": "0"}
 
+    # @trace: the tracing-overhead A/B config (PERF.md zero-cost claim)
+    (tcfg,) = bench._parse_sweep_labels("k8-sync-packed@trace")
+    assert tcfg[4] == {"PST_BENCH_TRACE": "1"}
+
     import pytest
     with pytest.raises(ValueError, match="modifier"):
         bench._parse_sweep_labels("k8-sync-packed@bogus7")

@@ -508,3 +508,21 @@ def test_repo_self_scan_is_clean_cli():
     production_stack_tpu.analysis production_stack_tpu/` exits 0."""
     proc = run_cli("production_stack_tpu/")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_timeline_recording_stays_off_hot_paths():
+    """Request-timeline recording (tracing/ + its engine call sites)
+    must not introduce device syncs or event-loop stalls on the marked
+    hot paths: zero unsuppressed device-sync-hot / blocking-async
+    findings over the engine pipeline and the tracing package."""
+    report = analyze_paths(
+        [
+            str(PACKAGE / "tracing"),
+            str(PACKAGE / "engine"),
+        ],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    assert report.files_scanned >= 25
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )

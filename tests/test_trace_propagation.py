@@ -1,0 +1,376 @@
+"""traceparent encode/parse/propagation + router->engine correlation.
+
+Unit coverage for the W3C trace-context helpers (malformed headers fall
+back to a fresh trace, never fail the request), the monotonic-duration
+span clock, and the OTLP-shape exporter; e2e coverage that a proxied
+request arrives at the engine carrying the router span's trace id and
+the router-generated x-request-id."""
+
+from __future__ import annotations
+
+import pytest
+
+from production_stack_tpu import tracing as T
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.routing_logic import (
+    _reset_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (
+    _reset_service_discovery,
+)
+
+
+# -- context: encode / parse -------------------------------------------------
+def test_traceparent_roundtrip():
+    tid, sid = "a" * 32, "b" * 16
+    hdr = T.format_traceparent(tid, sid)
+    assert hdr == f"00-{tid}-{sid}-01"
+    ctx = T.parse_traceparent(hdr)
+    assert ctx is not None
+    assert ctx.trace_id == tid and ctx.span_id == sid and ctx.sampled
+
+
+def test_traceparent_not_sampled_flag():
+    hdr = T.format_traceparent("a" * 32, "b" * 16, sampled=False)
+    ctx = T.parse_traceparent(hdr)
+    assert ctx is not None and not ctx.sampled
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    "",
+    "garbage",
+    "00-short-b0b0b0b0b0b0b0b0-01",                      # short trace id
+    "00-" + "a" * 32 + "-short-01",                      # short span id
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",           # all-zero trace
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",           # all-zero span
+    "00-" + "G" * 32 + "-" + "b" * 16 + "-01",           # non-hex
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",           # forbidden ver
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",     # v00 extra field
+    "00-" + "a" * 32 + "-" + "b" * 16,                   # missing flags
+])
+def test_malformed_traceparent_falls_back_to_fresh_trace(bad):
+    assert T.parse_traceparent(bad) is None
+    # the timeline recorder starts a FRESH trace instead of failing
+    rec = T.TimelineRecorder(enabled=True, maxlen=4)
+    rec.start("r1", traceparent=bad)
+    rec.finish("r1", "stop")
+    (tl,) = rec.snapshot()
+    assert len(tl["trace_id"]) == 32
+    assert tl["parent_span_id"] is None
+
+
+def test_future_version_traceparent_accepted():
+    # spec: unknown (non-ff) versions parse if the known fields are valid
+    ctx = T.parse_traceparent(
+        "cc-" + "a" * 32 + "-" + "b" * 16 + "-01-future"
+    )
+    assert ctx is not None and ctx.trace_id == "a" * 32
+
+
+def test_valid_request_id_gate():
+    assert T.valid_request_id("cmpl-abc.DEF:123-x")
+    assert not T.valid_request_id(None)
+    assert not T.valid_request_id("")
+    assert not T.valid_request_id("has space")
+    assert not T.valid_request_id("x" * 129)
+    assert not T.valid_request_id("evil\r\nheader: injected")
+
+
+# -- spans: monotonic clock + parenting + otlp shape -------------------------
+def test_span_duration_survives_wall_clock_step(monkeypatch):
+    import time as time_mod
+
+    from production_stack_tpu.tracing import spans as S
+
+    t = T.RequestTracer("memory")
+    span = t.start_span("proxy_request")
+    # wall clock steps BACKWARD mid-span (NTP slew): duration must come
+    # from the monotonic clock and stay >= 0
+    real_time = time_mod.time
+    monkeypatch.setattr(
+        S.time, "time", lambda: real_time() - 3600.0
+    )
+    t.finish(span)
+    assert span.duration_s is not None and 0 <= span.duration_s < 60
+
+
+def test_child_span_inherits_trace_and_parent():
+    t = T.RequestTracer("memory")
+    parent = t.start_span("proxy_request")
+    ctx = T.parse_traceparent(parent.traceparent)
+    child = t.start_span("engine_request", parent=ctx)
+    assert child.trace_id == parent.trace_id
+    assert child.parent_span_id == parent.span_id
+    assert child.span_id != parent.span_id
+
+
+def test_sampled_out_flag_propagates_and_suppresses_engine_span():
+    t = T.RequestTracer("memory")
+    # origin sampled the trace OUT (flags 00): the hop's re-injected
+    # traceparent must carry 00, not force 01
+    ctx = T.parse_traceparent(
+        T.format_traceparent("a" * 32, "b" * 16, sampled=False)
+    )
+    span = t.start_span("proxy_request", parent=ctx)
+    assert span.traceparent.endswith("-00")
+    # the ROUTER side honors the decision too: local /debug ring entry
+    # only, nothing exported
+    t.finish(span)
+    assert t.spans == []
+    assert t.recent()[-1]["sampled"] is False
+    # the engine keeps the LOCAL timeline but exports no span
+    rec = T.TimelineRecorder(enabled=True, maxlen=4, tracer=t)
+    rec.start("r1", traceparent=span.traceparent)
+    rec.finish("r1", "stop")
+    (tl,) = rec.snapshot()
+    assert tl["trace_id"] == "a" * 32
+    assert t.spans == []  # sampling decision honored
+    # a sampled-in trace exports as before
+    rec.start("r2", traceparent=T.format_traceparent("9" * 32, "8" * 16))
+    rec.finish("r2", "stop")
+    assert [s.trace_id for s in t.spans] == ["9" * 32]
+
+
+def test_engine_exporter_without_timeline_degrades_loudly():
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+
+    engine = LLMEngine(EngineConfig(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=32,
+        request_timeline=False, tracing_exporter="otlp", seed=0,
+    ))
+    # spans derive from timelines: the exporter is dropped to "none"
+    # (with a warning) instead of sitting silently dead — no flush
+    # loop gets spawned off a dead buffer either
+    assert engine.tracer.enabled is False
+    assert engine.timeline.enabled is False
+
+
+def test_otlp_exporter_payload_shape():
+    t = T.RequestTracer("otlp", service_name="engine-under-test")
+    span = t.start_span("engine_request", attributes={"request_id": "r9"})
+    span.add_event("first_token", {"ttft_s": 0.25})
+    t.finish(span)
+    payload = t.drain_otlp()
+    assert payload is not None
+    (rs,) = payload["resourceSpans"]
+    res_attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "engine-under-test"}
+    (ss,) = rs["scopeSpans"]
+    (s,) = ss["spans"]
+    assert s["name"] == "engine_request"
+    assert len(s["traceId"]) == 32 and len(s["spanId"]) == 16
+    assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    assert s["events"][0]["name"] == "first_token"
+    # drained: second drain is empty
+    assert t.drain_otlp() is None
+
+
+def test_tracer_recent_ring_feeds_debug_endpoint():
+    t = T.RequestTracer("log", max_recent_spans=2)
+    for i in range(3):
+        t.finish(t.start_span(f"s{i}"))
+    names = [d["name"] for d in t.recent()]
+    assert names == ["s1", "s2"]  # bounded, newest last
+    assert t.recent(limit=0) == []  # -0 slice must not mean "all"
+    assert len(t.recent(limit=1)) == 1
+
+
+def test_timeline_snapshot_limit_zero_is_empty():
+    rec = T.TimelineRecorder(enabled=True, maxlen=8)
+    for i in range(3):
+        rec.start(f"r{i}")
+        rec.finish(f"r{i}", "stop")
+    assert len(rec.snapshot(limit=2)) == 2
+    assert rec.snapshot(limit=0) == []
+
+
+def test_otlp_shutdown_drain_helper():
+    t = T.RequestTracer("otlp")
+    t.finish(t.start_span("s"))
+    assert T.log_otlp_payload(t) is True  # drained + logged
+    assert T.log_otlp_payload(t) is False  # buffer now empty
+
+
+def test_otlp_overflow_counted_not_silent():
+    t = T.RequestTracer("otlp", max_memory_spans=2)
+    for i in range(5):
+        t.finish(t.start_span(f"s{i}"))
+    assert t.dropped_spans == 3  # loss is visible, not silent
+    payload = t.drain_otlp()  # warns + resets the counter
+    assert t.dropped_spans == 0
+    names = [s["name"] for s in
+             payload["resourceSpans"][0]["scopeSpans"][0]["spans"]]
+    assert names == ["s3", "s4"]  # newest survive
+
+
+def test_debug_requests_payload_shared_shape():
+    got = T.debug_requests_payload(
+        "bogus", enabled=True, snapshot=lambda n: [f"x{n}"], hint="h"
+    )
+    assert got == {"enabled": True, "requests": ["x64"]}  # fallback 64
+    got = T.debug_requests_payload(
+        "0", enabled=True, snapshot=lambda n: ["y"] if n else [],
+        hint="h",
+    )
+    assert got["requests"] == []
+    got = T.debug_requests_payload(None, enabled=False,
+                                   snapshot=lambda n: 1 / 0, hint="off")
+    assert got == {"enabled": False, "hint": "off", "requests": []}
+
+
+def test_router_tracing_shim_reexports():
+    # legacy import path keeps working after the move to tracing/
+    from production_stack_tpu.router import tracing as shim
+
+    assert shim.RequestTracer is T.RequestTracer
+    assert shim.parse_traceparent is T.parse_traceparent
+
+
+# -- e2e: router injects correlation + trace headers -------------------------
+@pytest.fixture()
+def reset_singletons():
+    yield
+    _reset_routing_logic()
+    _reset_service_discovery()
+
+
+def test_router_injects_request_id_and_traceparent(reset_singletons):
+    import asyncio
+
+    from tests.test_router import _start_stack, _stop_stack
+
+    async def run():
+        client, engines = await _start_stack(
+            n_engines=1, extra_args=("--tracing-exporter", "memory"),
+        )
+        try:
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 2},
+            )
+            assert resp.status == 200
+            headers = engines[0].headers_seen[-1]
+            assert T.valid_request_id(headers.get("x-request-id"))
+            ctx = T.parse_traceparent(headers.get("traceparent"))
+            assert ctx is not None
+            # the injected context IS the router span: spans recorded
+            # under it share the router's trace id
+            dbg = await client.get("/debug/requests")
+            assert dbg.status == 200
+            data = await dbg.json()
+            assert data["enabled"] is True
+            spans = data["requests"]
+            assert spans, "router span missing from /debug/requests"
+            span = spans[-1]
+            assert span["name"] == "proxy_request"
+            assert span["trace_id"] == ctx.trace_id
+            assert span["span_id"] == ctx.span_id
+            assert (span["attributes"]["request_id"]
+                    == headers["x-request-id"])
+            assert span["duration_s"] >= 0
+
+            # legacy x-trace-id: a spec-valid 32-hex value is adopted
+            # as the trace id; an opaque one must NOT poison the
+            # injected traceparent (it rides as a span attribute)
+            await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 1},
+                headers={"x-trace-id": "e" * 32},
+            )
+            fwd = T.parse_traceparent(
+                engines[0].headers_seen[-1].get("traceparent")
+            )
+            assert fwd is not None and fwd.trace_id == "e" * 32
+            await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 1},
+                headers={"x-trace-id": "opaque-legacy-id"},
+            )
+            fwd = T.parse_traceparent(
+                engines[0].headers_seen[-1].get("traceparent")
+            )
+            assert fwd is not None  # fresh valid trace, not poisoned
+            data = await (await client.get("/debug/requests")).json()
+            span = data["requests"][-1]
+            assert (span["attributes"]["legacy_trace_id"]
+                    == "opaque-legacy-id")
+        finally:
+            await _stop_stack(client, engines)
+
+    asyncio.run(run())
+
+
+def test_router_continues_client_trace(reset_singletons):
+    import asyncio
+
+    from tests.test_router import _start_stack, _stop_stack
+
+    async def run():
+        client, engines = await _start_stack(
+            n_engines=1, extra_args=("--tracing-exporter", "memory"),
+        )
+        try:
+            client_trace = "c" * 32
+            resp = await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "hi",
+                      "max_tokens": 2},
+                # non-lowercase casing + a conflicting legacy
+                # x-trace-id: the router must REPLACE the header
+                # case-insensitively (no duplicate traceparent reaching
+                # the engine) and let the W3C parent win over the
+                # legacy override
+                headers={
+                    "Traceparent": T.format_traceparent(
+                        client_trace, "d" * 16
+                    ),
+                    "X-Trace-Id": "legacy-override",
+                },
+            )
+            assert resp.status == 200
+            raw = engines[0].raw_headers_seen[-1]
+            tp_values = [v for k, v in raw
+                         if str(k).lower() == "traceparent"]
+            assert len(tp_values) == 1, tp_values
+            fwd = T.parse_traceparent(tp_values[0])
+            assert fwd is not None
+            assert fwd.trace_id == client_trace  # client trace continued
+            assert fwd.span_id != "d" * 16  # ...through the ROUTER span
+        finally:
+            await _stop_stack(client, engines)
+
+    asyncio.run(run())
+
+
+def test_router_debug_requests_disabled_hint(reset_singletons):
+    import asyncio
+
+    from tests.test_router import _start_stack, _stop_stack
+
+    async def run():
+        client, engines = await _start_stack(n_engines=1)
+        try:
+            dbg = await client.get("/debug/requests")
+            data = await dbg.json()
+            assert data["enabled"] is False and data["requests"] == []
+        finally:
+            await _stop_stack(client, engines)
+
+    asyncio.run(run())
+
+
+def test_parser_accepts_otlp_exporter():
+    args = parsers.parse_args([
+        "--service-discovery", "static",
+        "--static-backends", "http://e:1",
+        "--static-models", "m",
+        "--routing-logic", "roundrobin",
+        "--tracing-exporter", "otlp",
+    ])
+    assert args.tracing_exporter == "otlp"

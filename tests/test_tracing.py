@@ -59,7 +59,8 @@ def test_log_tracer_emits_json():
             records.append(record.getMessage())
 
     # the project logger sets propagate=False, so attach directly
-    lg = logging.getLogger("production_stack_tpu.router.tracing")
+    # (the span model lives in the shared tracing package now)
+    lg = logging.getLogger("production_stack_tpu.tracing.spans")
     h = Capture()
     lg.addHandler(h)
     try:
