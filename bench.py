@@ -87,6 +87,20 @@ PREFILL_PIPELINE = os.environ.get("PST_BENCH_PREFILL_PIPELINE", "1") == "1"
 # every existing sweep stays a tracing-free control; @trace enables.
 # Slots: BENCH_SWEEP_trace.json (on) vs the matching untraced config
 TRACE = os.environ.get("PST_BENCH_TRACE", "0") == "1"
+# KV tiering workload (@kvoff): cap the HBM pool so the multi-round
+# working set churns through the cpu/disk offload tiers — the zero-stall
+# async export/staged-restore measurement. PST_BENCH_KV_BLOCKS overrides
+# the cap (default: ~1.15x the peak ACTIVE working set, so finished
+# sessions' prefixes spill between rounds while running lanes always
+# fit). Slots: BENCH_SWEEP_kvoff.json (async tiering, default) vs
+# BENCH_SWEEP_kvoff_sync.json (@synckv -> --sync-kv-offload control)
+KV_OFFLOAD = os.environ.get("PST_BENCH_KV_OFFLOAD", "0") == "1"
+KV_BLOCKS = int(os.environ.get("PST_BENCH_KV_BLOCKS", "0"))
+SYNC_KV = os.environ.get("PST_BENCH_SYNC_KV", "0") == "1"
+CPU_OFFLOAD_MB = int(os.environ.get("PST_BENCH_CPU_OFFLOAD_MB", "2048"))
+DISK_OFFLOAD_DIR = os.environ.get(
+    "PST_BENCH_DISK_DIR", "/tmp/pst-bench-kv"
+)
 # pre-compile the packed-prefill buckets the timed run will hit so no
 # XLA compile lands inside a TTFT measurement (each tunnel compile is
 # tens of seconds)
@@ -205,12 +219,25 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_PREFILL_PIPELINE"] = "0"
             elif m == "trace":
                 overrides["PST_BENCH_TRACE"] = "1"
+            elif m == "kvoff":
+                overrides["PST_BENCH_KV_OFFLOAD"] = "1"
+            elif m == "synckv":
+                overrides["PST_BENCH_SYNC_KV"] = "1"
             else:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
-                    "| trace"
+                    "| trace | kvoff | synckv"
                 )
+        if ("PST_BENCH_SYNC_KV" in overrides
+                and "PST_BENCH_KV_OFFLOAD" not in overrides):
+            # fail fast: @synckv without @kvoff would silently measure a
+            # NO-tiering config as the "sync control" — a scarce chip
+            # window must not burn on a corrupted A/B
+            raise ValueError(
+                f"{label!r}: @synckv requires @kvoff (the sync path "
+                "only differs once the KV tiers are enabled)"
+            )
         kpart, mode, pack = base.split("-")
         # fail fast on typos: a scarce chip window must not silently run
         # the sync path under an "asynch" label
@@ -219,7 +246,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
             raise ValueError(
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
-                "|@chunk<N>|@nopfx|@nopfpipe|@trace]"
+                "|@chunk<N>|@nopfx|@nopfpipe|@trace|@kvoff|@synckv]"
             )
         configs.append((
             label,
@@ -406,6 +433,25 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         SYSTEM_PROMPT_TOK + HISTORY_TOK
         + (ROUNDS - 1) * (ANSWER_TOK + QUESTION_TOK) + ANSWER_TOK
     )
+    # @kvoff: cap the KV pool so finished sessions' prefixes spill into
+    # the cpu/disk tiers between rounds while every ACTIVE lane still
+    # fits (peak active = NUM_USERS x final_len; 1.15x slack covers the
+    # +1 generation block and pinned-export transients)
+    kv_blocks = None
+    kv_kwargs: dict = {}
+    if KV_OFFLOAD:
+        kv_blocks = KV_BLOCKS or int(
+            1.15 * NUM_USERS * -(-final_len // 32)
+        )
+        import shutil
+
+        shutil.rmtree(DISK_OFFLOAD_DIR, ignore_errors=True)
+        kv_kwargs = dict(
+            num_kv_blocks=kv_blocks,
+            cpu_offload_bytes=CPU_OFFLOAD_MB * 2**20,
+            disk_offload_dir=DISK_OFFLOAD_DIR,
+            sync_kv_offload=SYNC_KV,
+        )
     config = EngineConfig(
         model=MODEL,
         tokenizer="byte",
@@ -413,6 +459,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         cache_dtype="bfloat16",
         block_size=32,
         hbm_utilization=0.85,
+        **kv_kwargs,
         max_model_len=max(4096, 32 * (-(-(final_len + 64) // 32))),
         max_num_seqs=NUM_USERS,
         max_prefill_chunk=PREFILL_CHUNK,
@@ -692,6 +739,28 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "prefill_staged_hits": engine._pf_staged_hits_total,
             "prefill_staged_misses": engine._pf_staged_misses_total,
             "prefill_chained_chunks": engine._pf_chained_chunks_total,
+            # zero-stall KV tiering attribution (@kvoff): export time is
+            # offload-worker wall (overlapped), restore time is
+            # enqueue->landed (overlaps queue wait); tier counters show
+            # which tier actually served the resumes
+            **({
+                "kv_offload": {
+                    "kv_blocks": kv_blocks,
+                    "sync_kv_offload": SYNC_KV,
+                    "export_blocks": engine._kv_export_blocks_total,
+                    "export_s": round(
+                        engine._kv_export_seconds_total, 3),
+                    "restore_blocks": engine._kv_restore_blocks_total,
+                    "restore_s": round(
+                        engine._kv_restore_seconds_total, 3),
+                    "restore_fallbacks":
+                        engine._kv_restore_fallbacks_total,
+                    "export_sync_fallbacks":
+                        engine._kv_export_sync_fallbacks_total,
+                    "tiers": engine.offload.counters()
+                    if engine.offload is not None else {},
+                },
+            } if KV_OFFLOAD else {}),
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)
             else -1,

@@ -149,6 +149,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remote-cache-url", default=None)
     p.add_argument("--kv-controller-url", default=None)
     p.add_argument("--kv-instance-id", default="default-instance")
+    p.add_argument("--sync-kv-offload", action="store_true",
+                   default=False,
+                   help="pre-PR-4 synchronous KV tier traffic: d2h "
+                        "export inside scheduling and blocking tier "
+                        "reads + whole-cache-copy import on the step "
+                        "loop (bench attribution control; the default "
+                        "is the zero-stall async export/staged-restore "
+                        "path)")
+    p.add_argument("--kv-restore-wait-s", type=float, default=2.0,
+                   help="staged-restore admission budget: max seconds a "
+                        "waiting request may hold its admission slot "
+                        "while its KV tier fetch + h2d staging are in "
+                        "flight before recomputing from scratch")
     p.add_argument("--multihost", action="store_true",
                    help="one engine spanning a multi-host slice: host 0 "
                         "schedules + serves HTTP, other hosts replay its "
@@ -217,6 +230,8 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         remote_cache_url=args.remote_cache_url,
         kv_controller_url=args.kv_controller_url,
         kv_instance_id=args.kv_instance_id,
+        sync_kv_offload=args.sync_kv_offload,
+        kv_restore_wait_s=args.kv_restore_wait_s,
     )
 
 

@@ -194,6 +194,12 @@ class AsyncLLMEngine:
         with self._lock:
             return self.engine.stats()
 
+    def drain_kv_observations(self) -> tuple[list[float], list[float]]:
+        """KV export/restore histogram observations since the last
+        drain. Lock-free: the underlying deque pops are GIL-atomic vs
+        the step/worker threads' appends."""
+        return self.engine.drain_kv_observations()
+
     @property
     def tokenizer(self):
         return self.engine.tokenizer

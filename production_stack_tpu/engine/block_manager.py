@@ -82,6 +82,9 @@ class BlockManager:
         self.on_admit = None
         self.on_evict = None
         self.on_freed_cached = None
+        # deferred-export pins: freed-but-cached blocks whose device-side
+        # snapshot has not been enqueued yet (see pin_for_export)
+        self._export_pins: set[int] = set()
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -253,6 +256,65 @@ class BlockManager:
             self.on_admit([h])
         return bid
 
+    def can_adopt_another(self, n_adopted: int) -> bool:
+        """True while one more adopt_cached_block cannot cannibalize the
+        caller's own freshly-adopted blocks. Adopted blocks enter the
+        evictable pool (newest end), so _pop_free_block only reaches
+        them once free_blocks is empty AND every OLDER evictable entry
+        is consumed — i.e. when the caller's n_adopted blocks are all
+        that remains. Evicting one would hand its block id out twice in
+        the same restore: a donated scatter with duplicate destination
+        indices has undefined write order, leaving a live cache hash
+        holding another hash's KV."""
+        return len(self.free_blocks) + len(self.evictable) > n_adopted
+
+    def drop_cached_block(self, h: int) -> None:
+        """Remove an UNREFERENCED cached block from the cache and return
+        it to the free pool (a restore landing failed AFTER adoption —
+        leaving the entry would serve never-written garbage KV to every
+        later prefix hit on this hash)."""
+        bid = self.cached_blocks.pop(h, None)
+        if bid is None:
+            return
+        blk = self.blocks[bid]
+        assert blk.ref_count == 0, "drop_cached_block on a live block"
+        blk.block_hash = None
+        if bid in self.evictable:
+            del self.evictable[bid]
+        self.free_blocks.append(bid)
+        if self.on_evict is not None:
+            self.on_evict([h])
+
+    # -- deferred-export pinning -------------------------------------------
+    def pin_for_export(self, block_ids: list[int]) -> None:
+        """Take freed-but-cached blocks out of the reusable pools until
+        their deferred d2h export snapshot is enqueued (unpin_exported).
+
+        A pinned block keeps its cache entry — prefix hits may still
+        re-take it (contents are immutable for a registered hash) — it
+        just stops being allocatable, so no later dispatch can overwrite
+        it before the export's device-side copy is ordered. Idempotent:
+        re-pinning an already-pinned or re-taken block is a no-op."""
+        for bid in block_ids:
+            blk = self.blocks[bid]
+            if blk.ref_count == 0 and bid in self.evictable:
+                del self.evictable[bid]
+                self._export_pins.add(bid)
+
+    def unpin_exported(self, block_ids: list[int]) -> None:
+        """The export snapshot is enqueued (device-ordered before any
+        later write): return still-free pinned blocks to their pools."""
+        for bid in block_ids:
+            if bid not in self._export_pins:
+                continue
+            self._export_pins.discard(bid)
+            blk = self.blocks[bid]
+            if blk.ref_count == 0 and bid not in self.evictable:
+                if blk.block_hash is not None:
+                    self.evictable[bid] = None
+                else:
+                    self.free_blocks.append(bid)
+
     def free(self, block_table: list[int]) -> None:
         """Release a sequence's references; cached blocks become evictable."""
         # table-identity epoch: freed block ids may be handed to another
@@ -268,7 +330,11 @@ class BlockManager:
             assert blk.ref_count >= 0, f"double free of block {bid}"
             if blk.ref_count == 0:
                 if blk.block_hash is not None:
-                    self.evictable[bid] = None  # keep contents, LRU-evictable
+                    if bid not in self._export_pins:
+                        # keep contents, LRU-evictable; a still-pinned
+                        # block stays out of the pool until its export
+                        # snapshot is enqueued (unpin_exported)
+                        self.evictable[bid] = None
                     freed_cached.append((bid, blk.block_hash))
                 else:
                     self.free_blocks.append(bid)

@@ -154,6 +154,23 @@ class EngineConfig:
     remote_cache_url: str | None = None
     kv_controller_url: str | None = None
     kv_instance_id: str = "default-instance"
+    # zero-stall KV tiering (PR 4): exports are deferred (freed blocks
+    # pinned, d2h snapshot enqueued after the step's dispatch, tier IO
+    # on the offload worker) and restores are staged (tier fetch + h2d
+    # start while the request WAITS; admission lands once the restore
+    # does, in-place donated cache update). True restores the pre-PR-4
+    # synchronous path — device-sync export inside scheduling, blocking
+    # tier reads + whole-cache-copy import on the step loop — as the
+    # bench attribution control (--sync-kv-offload / @synckv). Multihost
+    # engines always take the synchronous path (the broadcast wire ships
+    # host arrays, not device buffers).
+    sync_kv_offload: bool = False
+    # staged-restore admission budget: how long an admission slot may be
+    # held back while the request's tier fetch + h2d staging are in
+    # flight, before falling back to recompute-from-scratch. Bounds the
+    # damage of a wedged tier (dead remote, slow disk) to one budget per
+    # request; the fetch itself typically lands in one tunnel RTT.
+    kv_restore_wait_s: float = 2.0
 
     def __post_init__(self) -> None:
         if self.scheduling_policy not in ("fcfs", "priority"):

@@ -190,8 +190,49 @@ class LLMEngine:
             bm = self.block_manager
             bm.on_admit = lambda hs: self.kv_reporter.admit("hbm", hs)
             bm.on_evict = lambda hs: self.kv_reporter.evict("hbm", hs)
+        # zero-stall KV tiering: deferred export (freed blocks pinned,
+        # d2h snapshot enqueued after the step's dispatch, tier IO on
+        # the offload worker) + staged restore (tier fetch + h2d start
+        # while the request WAITS; admission lands once the restore
+        # does). sync_kv_offload keeps the pre-PR-4 synchronous path as
+        # the bench attribution control; multihost always takes it (the
+        # broadcast wire ships host arrays, not device buffers).
+        self._kv_async = (
+            self.offload is not None
+            and not config.sync_kv_offload
+            and not config.multihost
+        )
+        # deferred-export queue: (block_id, hash) pairs pinned against
+        # reuse until _flush_kv_exports enqueues their device snapshot
+        self._kv_export_pending: list[tuple[int, int]] = []
+        self._kv_export_queued: set[int] = set()
+        # staged restores by request_id (see _begin_kv_restore)
+        self._kv_restores: dict[str, dict] = {}
+        # histogram observations drained by the server's stats loop
+        # (deque appends/pops are GIL-atomic: the export side appends
+        # from the offload worker thread)
+        from collections import deque as _deque
+
+        self._kv_export_obs: _deque = _deque(maxlen=1024)
+        self._kv_restore_obs: _deque = _deque(maxlen=1024)
+        self._kv_export_seconds_total = 0.0
+        self._kv_export_blocks_total = 0
+        self._kv_export_bytes_total = 0
+        self._kv_restore_seconds_total = 0.0
+        self._kv_restore_blocks_total = 0
+        self._kv_restore_bytes_total = 0
+        self._kv_restore_fallbacks_total = 0
+        self._kv_export_sync_fallbacks_total = 0
         if self.offload is not None:
-            self.block_manager.on_freed_cached = self._offload_freed_blocks
+            if self._kv_async:
+                self.block_manager.on_freed_cached = (
+                    self._queue_freed_exports
+                )
+                self.scheduler.kv_flush = self._flush_kv_exports
+            else:
+                self.block_manager.on_freed_cached = (
+                    self._offload_freed_blocks
+                )
 
         # -- disaggregated-prefill consumer side (reference capability:
         # decode pod pulls KV produced by the prefill pod via NIXL; ours
@@ -218,8 +259,17 @@ class LLMEngine:
         return out
 
     def _offload_freed_blocks(self, pairs: list[tuple[int, int]]) -> None:
-        """Cached blocks just became evictable: batched d2h export -> tiers."""
+        """SYNCHRONOUS export path (--sync-kv-offload / multihost):
+        cached blocks just became evictable -> batched d2h export inside
+        scheduling -> tiers."""
         pairs = [(bid, h) for bid, h in pairs if not self.offload.contains(h)]
+        self._export_sync(pairs)
+
+    def _export_sync(self, pairs: list[tuple[int, int]]) -> None:
+        """Blocking export of (block_id, hash) pairs on the CALLING
+        thread: the --sync-kv-offload path and the async path's
+        backlog-cap degradation share this one copy of the wire-layout
+        slicing."""
         if not pairs:
             return
         data = self.runner.export_blocks([bid for bid, _ in pairs])
@@ -233,17 +283,424 @@ class LLMEngine:
             ]
         )
 
-    def _restore_from_offload(self, seq: Sequence) -> None:
-        """Before admission: pull chain-continuation blocks back into HBM
-        so allocate_prompt sees a longer cached prefix. Source order:
-        local offload tiers (LMCache-retrieve role), then the remote
-        prefill peer in one batched round-trip (NIXL-receive role)."""
+    def _queue_freed_exports(self, pairs: list[tuple[int, int]]) -> None:
+        """Deferred export (the zero-stall path): freed-but-cached
+        blocks are PINNED against reuse and queued; _flush_kv_exports
+        enqueues their device snapshot at the end of the step (after
+        the dispatch, so the d2h overlaps compute) and the blocking
+        materialization + tier IO run on the offload worker."""
+        fresh: list[tuple[int, int]] = []
+        pin: list[int] = []
+        for bid, h in pairs:
+            if h in self._kv_export_queued:
+                pin.append(bid)  # re-freed before the snapshot: re-pin
+                continue
+            if self.offload.contains(h):
+                continue
+            fresh.append((bid, h))
+            pin.append(bid)
+            self._kv_export_queued.add(h)
+        if pin:
+            self.block_manager.pin_for_export(pin)
+        self._kv_export_pending.extend(fresh)
+
+    # in-flight deferred-export batches before the flush degrades to a
+    # synchronous (stalling, counted) export: device gather buffers
+    # queued behind a slow tier must not OOM HBM
+    KV_EXPORT_BACKLOG_CAP = 4
+
+    # stackcheck: hot-path — runs on the step thread between/after
+    # device dispatches: may only ENQUEUE the device-side snapshot; the
+    # blocking d2h + tier IO happen on the offload worker (the
+    # backlog-cap branch is the deliberate, counted exception)
+    def _flush_kv_exports(self) -> bool:
+        """Enqueue the deferred-export snapshot and release the pins.
+        Device ops execute in enqueue order, so later dispatches cannot
+        overwrite the snapshot — unpinning here is safe. Returns True
+        when anything was flushed (scheduler retry contract)."""
+        pending = self._kv_export_pending
+        if not pending:
+            return False
+        self._kv_export_pending = []
+        self._kv_export_queued.clear()
+        bids = [bid for bid, _ in pending]
+        try:
+            if self.offload.export_backlog() >= self.KV_EXPORT_BACKLOG_CAP:
+                # backpressure: each queued batch pins DEVICE gather
+                # buffers until the worker materializes it — under
+                # eviction churn faster than tier IO, HBM must not
+                # become the overflow buffer. Materialize THIS batch on
+                # the step thread (a bounded, counted stall — the old
+                # synchronous behavior) instead of growing the queue.
+                self._kv_export_sync_fallbacks_total += 1
+                self._export_sync(pending)
+                return True
+            handle = self.runner.stage_export_blocks(bids)
+            self.offload.put_batch_async(
+                [h for _, h in pending], handle,
+                self.runner.materialize_export, self._note_kv_export,
+            )
+        except Exception:  # noqa: BLE001 — export is best-effort: a
+            # failed gather (e.g. device OOM sizing the snapshot) drops
+            # the batch, it must not kill the step or leak the pins
+            logger.exception("kv export staging failed; batch dropped")
+        finally:
+            # pins release even on failure — a leaked pin would shrink
+            # the KV pool permanently (the snapshot, when it succeeded,
+            # is already enqueued, so release stays ordering-safe)
+            self.block_manager.unpin_exported(bids)
+        return True
+
+    def _note_kv_export(
+        self, seconds: float, blocks: int, nbytes: int
+    ) -> None:
+        """Offload-worker callback when a deferred export batch lands
+        (GIL-atomic appends/adds only; no locks shared with the step
+        thread)."""
+        self._kv_export_obs.append(seconds)
+        self._kv_export_seconds_total += seconds
+        self._kv_export_blocks_total += blocks
+        self._kv_export_bytes_total += nbytes
+
+    # -- staged restore ----------------------------------------------------
+    # outstanding restore records (fetching or staged) before new
+    # enqueue-time restores stop being started: each record's completed
+    # reads park host arrays in the offload manager until consumed, so
+    # a deep waiting queue must not buffer every request's chain in
+    # host RAM at once. The admission head bypasses the cap (force) —
+    # it consumes its record next.
+    KV_RESTORE_FETCH_CAP = 8
+
+    def _begin_kv_restore(
+        self, seq: Sequence, force: bool = False
+    ) -> tuple[dict | None, list[int] | None]:
+        """Start the async restore for a request: find the offload-tier
+        chain continuation past the resident HBM prefix (cheap host-map
+        probes only) and queue its tier reads on the offload worker.
+        Called when the request enters the waiting queue, so the fetch
+        (and then the h2d staging) overlaps the queue wait. Returns
+        (record, hashes) — hashes also on a no-restore miss, so the PD
+        pull never re-hashes the prompt."""
+        if not force and len(self._kv_restores) >= \
+                self.KV_RESTORE_FETCH_CAP:
+            # the admission hook re-begins with force=True
+            return None, None
+        bm = self.block_manager
+        if seq.sampling_params.prompt_logprobs is not None:
+            # the scheduler allocates these with reuse_cache=False
+            # (every position must COMPUTE) — a restored prefix would
+            # be ignored, so fetching + deferring for it is pure waste
+            return None, None
+        # ONE hashing pass per admission: the chain is computed here and
+        # reused by staging, finalize, and the PD pull (match_prefix
+        # would re-hash the whole prompt on every call)
+        hashes = bm.block_hashes_for(seq.prompt_token_ids, seq.hash_seed)
+        if not hashes:
+            return None, hashes
+        # cap the fetch at what could ever be adopted: the pool's usable
+        # blocks (minus the null block) and the model-length ceiling.
+        # Beyond that the blocks cannot land in HBM anyway, and the cap
+        # keeps the staged width inside precompile_kv_import's warmed
+        # pow2 diagonal (no XLA compile inside a live admission)
+        cap = min(
+            bm.num_blocks - 1,
+            self.scheduler.config.max_model_len // bm.block_size,
+        )
+        i = 0
+        want: list[int] = []
+        while i < len(hashes) and len(want) < cap:
+            h = hashes[i]
+            if bm.contains_hash(h):
+                i += 1  # already resident: nothing to fetch
+                continue
+            if not self.offload.contains(h):
+                break  # chain continuation ends here
+            want.append(h)
+            i += 1
+        if not want:
+            return None, hashes
+        self.offload.request_reads(want)
+        rec = {
+            "rid": seq.request_id,
+            "hashes": hashes,
+            "want": want,
+            "state": "fetching",
+            "t0": time.monotonic(),
+            "handle": None,
+            "cols": {},
+            "col_bytes": [],
+            "col_tiers": [],
+        }
+        self._kv_restores[seq.request_id] = rec
+        return rec, hashes
+
+    # staged (device-buffer-holding) restores allowed at once: the
+    # restore mirror of KV_EXPORT_BACKLOG_CAP — a burst of waiting
+    # requests must not land every chain's wire-format KV in HBM at
+    # once. Dict order is insertion order (enqueue ≈ FIFO), so the
+    # oldest records stage first; the admission head bypasses the cap
+    # via _restore_from_offload (it lands and frees its buffer next).
+    KV_RESTORE_STAGED_CAP = 4
+
+    def _poll_kv_restores(self) -> None:
+        """Advance in-flight restores (start the h2d for completed
+        fetches) so uploads overlap whatever the engine is doing — not
+        just the owning request's admission attempts."""
+        staged = sum(
+            1 for r in self._kv_restores.values()
+            if r["state"] == "staged"
+        )
+        for rec in list(self._kv_restores.values()):
+            if rec["state"] != "fetching":
+                continue  # already staged/failed: not a cap candidate
+                # (counting it again would halve the effective cap)
+            if staged >= self.KV_RESTORE_STAGED_CAP:
+                break
+            try:
+                self._advance_kv_restore(rec)
+                if rec["state"] == "staged":
+                    staged += 1
+            except Exception:  # noqa: BLE001 — same contract as the
+                # scheduler's kv_restore guard: a staging failure
+                # (device_put OOM, corrupt tier read shape) must never
+                # kill the step loop — this request simply recomputes
+                logger.exception(
+                    "kv restore staging failed for %s; recomputing",
+                    rec["rid"],
+                )
+                self._mark_restore_failed(rec)
+
+    # stackcheck: hot-path — restore staging on the step thread:
+    # assemble the host batch and START its h2d (device_put enqueue);
+    # no device fetch, no tier IO (reads completed on the worker)
+    def _advance_kv_restore(self, rec: dict) -> None:
+        if rec["state"] != "fetching":
+            return
+        done = self.offload.poll_reads(rec["want"])
+        if len(done) < len(rec["want"]):
+            return  # worker still fetching
+        usable: list[tuple[int, np.ndarray, str]] = []
+        for h in rec["want"]:
+            arr, tier = done[h]
+            if arr is None:
+                break  # mid-restore failure: the tail recomputes
+            usable.append((h, arr, tier))
+        self.offload.discard_reads(rec["want"])
+        # references are released: leave "fetching" NOW so a staging
+        # exception below cannot make _drop_kv_restore discard a second
+        # time (which would strip a concurrent shared-prefix restore's
+        # references and starve it)
+        rec["state"] = "failed"
+        if not usable:
+            return
+        data = np.stack([a for _, a, _ in usable], axis=2)
+        rec["handle"] = self.runner.stage_import_blocks(data)
+        rec["cols"] = {h: j for j, (h, _, _) in enumerate(usable)}
+        # per-column attribution so finalize can report what was
+        # ADOPTED, not what was staged (partial adoption must not
+        # inflate bytes-per-block)
+        rec["col_bytes"] = [int(a.nbytes) for _, a, _ in usable]
+        rec["col_tiers"] = [tier for _, _, tier in usable]
+        rec["state"] = "staged"
+
+    def _finalize_kv_restore(self, seq: Sequence, rec: dict) -> None:
+        """Admission-time landing: re-validate the staged window against
+        the CURRENT cache (the chain must still connect from the
+        resident prefix — content-addressed hashes ARE the fingerprint;
+        any break falls back to recompute from the break) and scatter
+        the adopted blocks in place via the donated import."""
+        self._kv_restores.pop(rec["rid"], None)
+        if rec["state"] != "staged":
+            self._kv_restore_fallbacks_total += 1
+            return
+        bm = self.block_manager
+        if self._kv_export_pending:
+            # release export pins so adoption can claim free blocks
+            self._flush_kv_exports()
+        cols = rec["cols"]
+        hashes = rec["hashes"]  # computed once at _begin_kv_restore
+        bids: list[int] = []
+        src: list[int] = []
+        adopted: list[int] = []
+        i = 0
+        while i < len(hashes):
+            h = hashes[i]
+            if bm.contains_hash(h):
+                i += 1
+                continue
+            j = cols.get(h)
+            if j is None:
+                break  # staged window over (or chain moved): recompute
+            if not bm.can_adopt_another(len(bids)):
+                rec["hbm_full"] = True  # only OUR adoptions left to
+                break  # evict: adopting more would cannibalize them
+            bid = bm.adopt_cached_block(h)
+            if bid is None:
+                rec["hbm_full"] = True  # pool exhausted: partial
+                break
+            bids.append(bid)
+            src.append(j)
+            adopted.append(h)
+            i += 1
+        if bids and not self._import_restored(bids, adopted,
+                                              rec["handle"], src):
+            bids = []
+            src = []  # nothing landed: no tier-served attribution
+            rec["import_failed"] = True
+        seconds = time.monotonic() - rec["t0"]
+        tiers: dict[str, int] = {}
+        for j in src:
+            t = rec["col_tiers"][j]
+            tiers[t] = tiers.get(t, 0) + 1
+        if bids:
+            self._kv_restore_obs.append(seconds)
+            self._kv_restore_seconds_total += seconds
+            self._kv_restore_blocks_total += len(bids)
+            self._kv_restore_bytes_total += sum(
+                rec["col_bytes"][j] for j in src
+            )
+        elif i < len(hashes) or rec.get("import_failed"):
+            # adoption was CUT SHORT (chain break / full HBM) or the
+            # import failed — a walk that reached the end restoring
+            # nothing means everything was already resident (e.g. a
+            # shared prefix another request landed first): best case,
+            # not a fallback
+            self._kv_restore_fallbacks_total += 1
+        if self._tl_enabled:
+            self.timeline.event(
+                seq.request_id, "kv_restore",
+                {
+                    "tiers": tiers,
+                    "blocks": len(bids),
+                    "seconds": round(seconds, 6),
+                },
+            )
+
+    def _import_restored(
+        self, bids: list[int], adopted: list[int], handle: tuple,
+        src: list[int],
+    ) -> bool:
+        """Land adopted blocks via the donated scatter; on failure
+        UN-ADOPT them — a cache entry whose KV contents were never
+        written would silently serve garbage to every later prefix hit
+        on its hash. Returns True when the import landed."""
+        try:
+            self.runner.import_staged_blocks(bids, handle, src)
+            return True
+        except Exception:  # noqa: BLE001 — e.g. stale wrong-shape tier
+            # data after a model swap; the request just recomputes
+            logger.exception(
+                "kv import failed; dropping %d adopted blocks", len(bids)
+            )
+            for h in adopted:
+                self.block_manager.drop_cached_block(h)
+            return False
+
+    def _drop_kv_restore(self, request_id: str) -> None:
+        """Forget a request's staged restore (abort / admission abort)."""
+        rec = self._kv_restores.pop(request_id, None)
+        if rec is not None and rec["state"] == "fetching":
+            self.offload.discard_reads(rec["want"])
+
+    def _mark_restore_failed(self, rec: dict) -> None:
+        """Park a failed restore as state='failed' but KEEP the record:
+        the owning request's next admission attempt consumes it (one
+        fallback, recompute, proceed). Dropping the record instead
+        would let _begin_kv_restore re-create it fresh each step — a
+        deterministically failing restore (e.g. stale wrong-shape tier
+        files after a model swap) would then defer the FIFO head
+        forever on a renewed wait budget."""
+        if rec["state"] == "fetching":
+            self.offload.discard_reads(rec["want"])
+        rec["state"] = "failed"
+
+    def _restore_from_offload(self, seq: Sequence):
+        """Scheduler admission hook. Async mode: poll/stage/land the
+        request's staged restore — returns False to keep the request
+        WAITING while its tier fetch + h2d are in flight (bounded by
+        kv_restore_wait_s, then recompute). Sync mode: the original
+        blocking restore. Always returns truthy once admission may
+        proceed."""
+        if not self._kv_async:
+            self._restore_sync(seq)
+            return True
+        bm = self.block_manager
+        if not bm.enable_prefix_caching:
+            return True
+        rec = self._kv_restores.get(seq.request_id)
+        if rec is None:
+            # no record (preempted requeue, fetch-cap skip, or blocks
+            # offloaded after enqueue): begin the ASYNC fetch now —
+            # still no tier IO on this thread (satellite: fallback
+            # paths go through the worker's pending-read map too).
+            # _kv_async guarantees self.offload is set here.
+            rec, hashes = self._begin_kv_restore(seq, force=True)
+            if rec is None:
+                self._pd_transfer_restore(seq, hashes)
+                return True
+        hashes = rec["hashes"]
+        try:
+            self._advance_kv_restore(rec)
+        except Exception:  # noqa: BLE001 — staging failure (device_put
+            # OOM, corrupt tier shape): recompute, never kill the step.
+            # The record parks as 'failed' and finalize consumes it
+            # below — recreating it would retry a deterministic failure
+            # forever (see _mark_restore_failed)
+            logger.exception(
+                "kv restore staging failed for %s; recomputing",
+                seq.request_id,
+            )
+            self._mark_restore_failed(rec)
+        if rec["state"] == "fetching":
+            # the wait budget covers how long the request HOLDS its
+            # admission slot, not its whole queue life — a fetch that
+            # ran concurrently with a long queue wait (or a priority
+            # displacement from the head) must not arrive back with
+            # its budget already spent. Consecutive deferrals of the
+            # SAME request are one scheduling round apart; gaps beyond
+            # that mean the request was not blocking anyone, so they
+            # don't bill the budget.
+            now = time.monotonic()
+            last = rec.get("last_defer")
+            if last is not None:
+                # bill at most ~one engine round per deferral: a long
+                # gap means the request was displaced from the head
+                # (not holding anyone up) — but it must still accrue
+                # SOMETHING, or rounds slower than the cap would let a
+                # wedged tier defer the FIFO head forever
+                rec["held_s"] = (
+                    rec.get("held_s", 0.0) + min(now - last, 1.0)
+                )
+            rec["last_defer"] = now
+            if rec.get("held_s", 0.0) < self.config.kv_restore_wait_s:
+                return False
+            # wedged/slow tier: recompute rather than stall admission
+            logger.warning(
+                "kv restore for %s held admission %.1fs; recomputing",
+                seq.request_id, self.config.kv_restore_wait_s,
+            )
+            self._drop_kv_restore(seq.request_id)
+            self._kv_restore_fallbacks_total += 1
+            self._pd_transfer_restore(seq, hashes)
+            return True
+        self._finalize_kv_restore(seq, rec)
+        if not rec.get("hbm_full"):
+            # with the pool exhausted a peer pull is pointless (the old
+            # sync path's hbm_full gate): nothing could be adopted
+            self._pd_transfer_restore(seq, hashes)
+        return True
+
+    def _restore_sync(self, seq: Sequence) -> None:
+        """Pre-PR-4 synchronous restore: blocking tier reads on the
+        scheduler thread (--sync-kv-offload attribution control and
+        multihost engines)."""
         bm = self.block_manager
         if not bm.enable_prefix_caching:
             return
         hashes = bm.block_hashes_for(seq.prompt_token_ids, seq.hash_seed)
         matched, _ = bm.match_prefix(seq.prompt_token_ids, seq.hash_seed)
         restore: list[tuple[int, np.ndarray]] = []  # (block_id, data)
+        adopted: list[int] = []
         i = len(matched)
         hbm_full = False
         if self.offload is not None:
@@ -254,30 +711,95 @@ class LLMEngine:
                 arr = self.offload.get(h)
                 if arr is None:
                     break  # local chain broken; try the PD peer below
+                if not bm.can_adopt_another(len(restore)):
+                    hbm_full = True  # see can_adopt_another
+                    break
                 bid = bm.adopt_cached_block(h)
                 if bid is None:
                     hbm_full = True  # no room: a network pull is pointless
                     break
                 restore.append((bid, arr))
+                adopted.append(h)
                 i += 1
-        if (
-            self.kv_transfer_client is not None
-            and not hbm_full
-            and i < len(hashes)
-            and not bm.contains_hash(hashes[i])
-        ):
-            data = self.kv_transfer_client.get_chain(hashes[i:])
-            if data is not None:
-                for j in range(data.shape[2]):
-                    bid = bm.adopt_cached_block(hashes[i + j])
-                    if bid is None:
-                        break
-                    restore.append((bid, data[:, :, j]))
-        if restore:
+        self._import_restored_host(restore, adopted)
+        if not hbm_full:
+            self._pd_transfer_restore(seq, hashes)
+
+    def _pd_transfer_restore(
+        self, seq: Sequence, hashes: list[int] | None = None
+    ) -> None:
+        """Disaggregated-prefill consumer pull (NIXL-receive role): one
+        batched TCP round-trip from the prefill peer for whatever the
+        local tiers could not supply. Stays synchronous — it is the PD
+        handoff path, not the tier path (the decode pod has nothing to
+        run before its prefill peer's KV arrives anyway). `hashes` is
+        the precomputed chain when the caller already has it (one
+        hashing pass per admission)."""
+        if self.kv_transfer_client is None:
+            return
+        bm = self.block_manager
+        if hashes is None:
+            hashes = bm.block_hashes_for(
+                seq.prompt_token_ids, seq.hash_seed
+            )
+        i = 0
+        while i < len(hashes) and bm.contains_hash(hashes[i]):
+            i += 1
+        if i >= len(hashes):
+            return
+        data = self.kv_transfer_client.get_chain(hashes[i:])
+        if data is None:
+            return
+        restore: list[tuple[int, np.ndarray]] = []
+        adopted: list[int] = []
+        for j in range(data.shape[2]):
+            if not bm.can_adopt_another(len(restore)):
+                break  # see can_adopt_another
+            bid = bm.adopt_cached_block(hashes[i + j])
+            if bid is None:
+                break
+            restore.append((bid, data[:, :, j]))
+            adopted.append(hashes[i + j])
+        self._import_restored_host(restore, adopted)
+
+    def _import_restored_host(
+        self, restore: list[tuple[int, np.ndarray]], adopted: list[int]
+    ) -> None:
+        """import_blocks with the same un-adopt-on-failure contract as
+        _import_restored (sync restore + PD pull paths)."""
+        if not restore:
+            return
+        try:
             self.runner.import_blocks(
                 [bid for bid, _ in restore],
                 np.stack([a for _, a in restore], axis=2),
             )
+        except Exception:  # noqa: BLE001 — see _import_restored
+            logger.exception(
+                "kv import failed; dropping %d adopted blocks",
+                len(restore),
+            )
+            for h in adopted:
+                self.block_manager.drop_cached_block(h)
+
+    def drain_kv_observations(self) -> tuple[list[float], list[float]]:
+        """(export_seconds, restore_seconds) observations accumulated
+        since the last drain — feeds the server's tpu:kv_export_seconds
+        / tpu:kv_restore_seconds histograms. Deque pops are GIL-atomic
+        vs the worker's appends."""
+        exp: list[float] = []
+        rst: list[float] = []
+        while True:
+            try:
+                exp.append(self._kv_export_obs.popleft())
+            except IndexError:
+                break
+        while True:
+            try:
+                rst.append(self._kv_restore_obs.popleft())
+            except IndexError:
+                break
+        return exp, rst
 
     # -- request lifecycle ------------------------------------------------
     def add_request(
@@ -405,6 +927,17 @@ class LLMEngine:
             seq._guided_state = machine.initial()  # type: ignore[attr-defined]
         self._seqs[request_id] = seq
         self.scheduler.add_seq(seq)
+        if self._kv_async and self.block_manager.enable_prefix_caching:
+            # staged restore starts the moment the request enters the
+            # waiting queue: the tier fetch (offload worker) and then
+            # the h2d upload (_poll_kv_restores) overlap the queue wait
+            try:
+                self._begin_kv_restore(seq)
+            except Exception:  # noqa: BLE001 — restore is best-effort;
+                # a failure here must not reject the request (admission
+                # simply recomputes the prefix)
+                logger.exception("kv restore staging failed for %s",
+                                 request_id)
         self.timeline.start(
             request_id,
             arrival_time=seq.metrics.arrival_time,
@@ -417,6 +950,8 @@ class LLMEngine:
         seq = self._seqs.pop(request_id, None)
         if seq is None:
             return False
+        if self._kv_restores:
+            self._drop_kv_restore(request_id)
         aborted = self.scheduler.abort(request_id)
         self.timeline.finish(request_id, "abort")
         return aborted
@@ -601,10 +1136,23 @@ class LLMEngine:
                     self.timeline.decode_round(seq.request_id, k)
 
     # -- the step loop ----------------------------------------------------
+    # stackcheck: hot-path — may only enqueue (flush = device-snapshot
+    # enqueue; the d2h runs on the offload worker)
+    def step(self) -> list[RequestOutput]:
+        try:
+            return self._step_impl()
+        finally:
+            # deferred KV exports flush at the END of every step — after
+            # the dispatch, so the d2h snapshot overlaps device compute;
+            # on idle/final steps this is the draining path that keeps
+            # freed blocks from staying pinned forever
+            if self._kv_export_pending:
+                self._flush_kv_exports()
+
     # stackcheck: hot-path — the async-decode round trip: dispatch the
     # next round BEFORE fetching the in-flight one; the only sanctioned
     # fetch lives in _resolve_pending
-    def step(self) -> list[RequestOutput]:
+    def _step_impl(self) -> list[RequestOutput]:
         # async decode fast path: keep the device busy by dispatching the
         # next round on the in-flight round's on-device tokens, THEN
         # fetching the in-flight round (the fetch overlaps the new
@@ -643,6 +1191,11 @@ class LLMEngine:
         return self._step_scheduled()
 
     def _step_scheduled(self) -> list[RequestOutput]:
+        if self._kv_restores:
+            # start h2d uploads for restores whose tier fetch landed
+            # while their requests sit in the waiting queue (the upload
+            # then overlaps this step's compute)
+            self._poll_kv_restores()
         sched_out = self.scheduler.schedule()
         if sched_out.preempted or sched_out.prefills or sched_out.aborted:
             # any table free/reassignment or lane-set change invalidates
@@ -672,6 +1225,12 @@ class LLMEngine:
             else "idle"
         )
         if sched_out.is_empty:
+            if self._kv_restores and not self.scheduler.running:
+                # every waiting request is restore-deferred and nothing
+                # is dispatchable: yield briefly instead of pegging the
+                # step thread (and the async-engine lock) at 100%
+                # against the offload worker doing the actual fetch
+                time.sleep(0.001)
             return []
 
         outputs: list[RequestOutput] = []
@@ -680,6 +1239,8 @@ class LLMEngine:
             self._finished_total += 1
             outputs.append(self._make_output(seq))
             self._seqs.pop(seq.request_id, None)
+            if self._kv_restores:
+                self._drop_kv_restore(seq.request_id)
             self.timeline.finish(seq.request_id, seq.finish_reason)
 
         stepped: list[Sequence] = []
@@ -2101,6 +2662,20 @@ class LLMEngine:
             prefill_staged_hits_total=self._pf_staged_hits_total,
             prefill_staged_misses_total=self._pf_staged_misses_total,
             prefill_chained_chunks_total=self._pf_chained_chunks_total,
+            kv_export_seconds_total=self._kv_export_seconds_total,
+            kv_export_blocks_total=self._kv_export_blocks_total,
+            kv_export_bytes_total=self._kv_export_bytes_total,
+            kv_restore_seconds_total=self._kv_restore_seconds_total,
+            kv_restore_blocks_total=self._kv_restore_blocks_total,
+            kv_restore_bytes_total=self._kv_restore_bytes_total,
+            kv_restore_fallbacks_total=self._kv_restore_fallbacks_total,
+            kv_export_sync_fallbacks_total=(
+                self._kv_export_sync_fallbacks_total
+            ),
+            kv_tier_counters=(
+                self.offload.counters()
+                if self.offload is not None else {}
+            ),
         )
 
     # -- offline convenience (tests, benchmarks) ---------------------------
@@ -2200,4 +2775,9 @@ class LLMEngine:
             n += rnr.precompile_verify(
                 ctxs, cfg.num_speculative_tokens + 1, cfg.max_num_seqs
             )
+        if self.offload is not None or self.kv_transfer_client is not None:
+            # staged restores dispatch the donated import scatter; warm
+            # its pow2 buckets so no XLA compile lands inside a live
+            # admission (a restore chain is at most max_model_len blocks)
+            n += rnr.precompile_kv_import(cap // bs)
         return n

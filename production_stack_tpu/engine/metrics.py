@@ -119,6 +119,61 @@ class EngineMetrics:
             "Prefill chunks dispatched via cold-prompt chaining "
             "(no host round-trip between chunks)", label, registry=reg,
         )
+        # zero-stall KV tiering (PR 4): deferred-export batch wall time
+        # (measured ON THE OFFLOAD WORKER — overlapped activity, never a
+        # step-loop stall), staged-restore enqueue->landed time, and
+        # per-tier traffic so a dashboard can see WHICH tier serves and
+        # whether eviction cascades are healthy
+        _kv_buckets = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0)
+        self.kv_export_s = Histogram(
+            "tpu:kv_export_seconds",
+            "Deferred KV export batch wall time (d2h materialization + "
+            "tier store, on the offload worker)",
+            label, buckets=_kv_buckets, registry=reg,
+        )
+        self.kv_restore_s = Histogram(
+            "tpu:kv_restore_seconds",
+            "Staged KV restore wall time (enqueue -> blocks landed in "
+            "HBM; overlaps the request's queue wait)",
+            label, buckets=_kv_buckets, registry=reg,
+        )
+        tier_label = ["model_name", "tier"]
+        self.kv_tier_hits = Counter(
+            "tpu:kv_tier_hits", "KV tier read hits",
+            tier_label, registry=reg,
+        )
+        self.kv_tier_misses = Counter(
+            "tpu:kv_tier_misses", "KV tier read misses (consulted tier "
+            "did not hold the block)", tier_label, registry=reg,
+        )
+        self.kv_tier_read_bytes = Counter(
+            "tpu:kv_tier_read_bytes", "Bytes served from a KV tier",
+            tier_label, registry=reg,
+        )
+        self.kv_tier_write_bytes = Counter(
+            "tpu:kv_tier_write_bytes", "Bytes admitted into a KV tier",
+            tier_label, registry=reg,
+        )
+        self.kv_export_blocks = Counter(
+            "tpu:kv_export_blocks", "KV blocks exported to the offload "
+            "tiers", label, registry=reg,
+        )
+        self.kv_restore_blocks = Counter(
+            "tpu:kv_restore_blocks", "KV blocks restored from the "
+            "offload tiers into HBM", label, registry=reg,
+        )
+        self.kv_restore_fallbacks = Counter(
+            "tpu:kv_restore_fallbacks", "Staged restores that fell back "
+            "to recompute (broken chain, timeout, or full HBM)",
+            label, registry=reg,
+        )
+        self.kv_export_sync_fallbacks = Counter(
+            "tpu:kv_export_sync_fallbacks",
+            "Deferred exports forced synchronous by the device-buffer "
+            "backlog cap (tier IO slower than eviction churn)",
+            label, registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -206,7 +261,41 @@ class EngineMetrics:
         self.prefill_chained_chunks.labels(m).inc(max(
             0, s.prefill_chained_chunks_total
             - prev.prefill_chained_chunks_total))
+        self.kv_export_blocks.labels(m).inc(max(
+            0, s.kv_export_blocks_total - prev.kv_export_blocks_total))
+        self.kv_restore_blocks.labels(m).inc(max(
+            0, s.kv_restore_blocks_total - prev.kv_restore_blocks_total))
+        self.kv_restore_fallbacks.labels(m).inc(max(
+            0, s.kv_restore_fallbacks_total
+            - prev.kv_restore_fallbacks_total))
+        self.kv_export_sync_fallbacks.labels(m).inc(max(
+            0, s.kv_export_sync_fallbacks_total
+            - prev.kv_export_sync_fallbacks_total))
+        for tier, c in (s.kv_tier_counters or {}).items():
+            pc = (prev.kv_tier_counters or {}).get(tier, {})
+            self.kv_tier_hits.labels(m, tier).inc(
+                max(0, c.get("hits", 0) - pc.get("hits", 0)))
+            self.kv_tier_misses.labels(m, tier).inc(
+                max(0, c.get("misses", 0) - pc.get("misses", 0)))
+            self.kv_tier_read_bytes.labels(m, tier).inc(
+                max(0, c.get("read_bytes", 0) - pc.get("read_bytes", 0)))
+            self.kv_tier_write_bytes.labels(m, tier).inc(
+                max(0, c.get("write_bytes", 0)
+                    - pc.get("write_bytes", 0)))
         self._counter_state = s
+
+    def observe_kv(
+        self,
+        export_seconds: list[float],
+        restore_seconds: list[float],
+    ) -> None:
+        """Feed drained engine observations (LLMEngine.
+        drain_kv_observations) into the tpu:kv_*_seconds histograms."""
+        m = self.model_name
+        for s in export_seconds:
+            self.kv_export_s.labels(m).observe(max(0.0, s))
+        for s in restore_seconds:
+            self.kv_restore_s.labels(m).observe(max(0.0, s))
 
     def observe_request(
         self,

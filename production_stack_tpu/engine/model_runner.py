@@ -244,6 +244,9 @@ class ModelRunner:
         self._decode_fns: dict[tuple[int, int], object] = {}
         self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
         self._embed_fns: dict[tuple[int, int], object] = {}
+        # donated in-place KV block scatter (offload restore / PD
+        # import), keyed by (n_src_pad, n_dst_pad) pow2 buckets
+        self._import_fns: dict[tuple[int, int], object] = {}
 
         self.max_ctx_bucket = self._ctx_bucket(self.max_model_len)
 
@@ -2298,22 +2301,35 @@ class ModelRunner:
         return (pooled / max(norm, 1e-12)).astype(np.float32)
 
     # -- cache import/export (KV offload + PD transfer tiers) -------------
-    def export_blocks(self, block_ids: list[int]) -> np.ndarray:
-        """Device->host copy of whole KV blocks.
+    # stackcheck: hot-path — the deferred-export snapshot is enqueued on
+    # the engine step thread right after (or between) device dispatches:
+    # it may only ENQUEUE the gather; the blocking d2h materialization
+    # belongs to the offload worker (materialize_export)
+    def stage_export_blocks(self, block_ids: list[int]) -> tuple:
+        """Enqueue the device-side snapshot of whole KV blocks.
 
-        Returns (2, num_layers, len(block_ids), nkv, block_size, d) —
-        block count stays at dim 2, so offload/transfer consumers that
-        slice or count blocks (`data[:, :, i]`, `data.shape[2]`) are
-        layout-agnostic."""
+        Returns a handle of on-device arrays. Because device ops execute
+        in enqueue order, any LATER dispatch that overwrites these slots
+        cannot corrupt the snapshot — the caller may release the blocks
+        for reuse the moment this returns."""
         idx = jnp.asarray(
             xla_attn.block_table_slots(
                 jnp.asarray(block_ids, jnp.int32), self.block_size
             )
         )
-        k = self.k_cache[:, :, idx]  # (L, nkv, n*bs, d)
-        v = self.v_cache[:, :, idx]
+        # (L, nkv, n*bs, d) gathers; async dispatch, no host sync
+        return (len(block_ids), self.k_cache[:, :, idx],
+                self.v_cache[:, :, idx])
+
+    def materialize_export(self, handle: tuple) -> np.ndarray:
+        """Blocking half of the deferred export (runs on the offload
+        worker thread): fetch the staged gathers and relayout to the
+        wire format (2, num_layers, n, nkv, block_size, d) — block count
+        stays at dim 2, so offload/transfer consumers that slice or
+        count blocks (`data[:, :, i]`, `data.shape[2]`) are
+        layout-agnostic."""
+        n, k, v = handle
         mc = self.model_config
-        n = len(block_ids)
         shape = (mc.num_layers, mc.num_kv_heads, n, self.block_size,
                  mc.head_dim)
         return np.stack([
@@ -2321,20 +2337,127 @@ class ModelRunner:
             np.asarray(v).reshape(shape).swapaxes(1, 2),
         ])
 
-    def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
-        """Host->device restore of whole KV blocks (inverse of export)."""
-        idx = jnp.asarray(
-            xla_attn.block_table_slots(
-                jnp.asarray(block_ids, jnp.int32), self.block_size
+    def export_blocks(self, block_ids: list[int]) -> np.ndarray:
+        """Synchronous device->host copy of whole KV blocks (PD transfer
+        server + --sync-kv-offload path)."""
+        return self.materialize_export(self.stage_export_blocks(block_ids))
+
+    def _build_import(self, n_src_pad: int, n_dst_pad: int):
+        """Donated in-place scatter of staged wire-format blocks into
+        the KV caches: replaces the whole-cache-reallocating eager
+        `.at[].set` (which copied both cache arrays per restore)."""
+        mc = self.model_config
+        bs = self.block_size
+
+        def step(kc, vc, bids, cols, staged):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            # staged: (2, L, n_src_pad, nkv, bs, d) wire layout
+            sel = staged[:, :, cols]  # (2, L, n_dst_pad, nkv, bs, d)
+            hm = jnp.swapaxes(sel, 2, 3)  # head-major
+            flat = hm.reshape(
+                2, mc.num_layers, mc.num_kv_heads, n_dst_pad * bs,
+                mc.head_dim,
+            ).astype(self.cache_dtype)
+            idx = xla_attn.block_table_slots(bids, bs)
+            kc = kc.at[:, :, idx].set(flat[0])
+            vc = vc.at[:, :, idx].set(flat[1])
+            return kc, vc
+
+        return jax.jit(step, donate_argnums=(0, 1),
+                       **self._step_jit_kwargs(0))
+
+    def _import_args(
+        self, block_ids: list[int], src_cols: list[int], n_pad: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host args for the donated scatter, padded to `n_pad`.
+        Padding rows target the null block (their writes are trash by
+        design) and read staged column 0 (always present)."""
+        n = len(block_ids)
+        bids = np.zeros((n_pad,), np.int32)
+        bids[:n] = block_ids
+        cols = np.zeros((n_pad,), np.int32)
+        cols[:n] = src_cols
+        return bids, cols
+
+    # stackcheck: hot-path — restore staging: pad + START the h2d
+    # upload; enqueue-only (no device fetch, no tier IO)
+    def stage_import_blocks(self, data: np.ndarray) -> tuple:
+        """Begin the restore's host->device upload. `data` is the wire
+        layout (2, L, n, nkv, bs, d); the block axis pads to pow2 so the
+        donated scatter compiles one variant per bucket. Returns a
+        handle for import_staged_blocks. Under a mesh the handle stays
+        host-side (a committed single-device put would be resharded —
+        same rule as the decode/prefill staging)."""
+        n = data.shape[2]
+        n_pad = next_pow2(max(n, 1))
+        if n_pad != n:
+            pad = np.zeros(
+                data.shape[:2] + (n_pad - n,) + data.shape[3:],
+                dtype=data.dtype,
             )
+            data = np.concatenate([data, pad], axis=2)
+        if self.mesh is not None:
+            return (n, data)
+        return (n, jax.device_put(data))
+
+    # stackcheck: hot-path — the restore's device-side write on the
+    # admission path: one donated-jit dispatch, no host sync
+    def import_staged_blocks(
+        self, block_ids: list[int], handle: tuple, src_cols: list[int],
+    ) -> None:
+        """In-place donated scatter of staged (already uploaded/
+        uploading) blocks into the KV cache. `src_cols[i]` names the
+        staged block-axis column holding block_ids[i]'s contents."""
+        if not block_ids:
+            return
+        _, staged = handle
+        # pad the DST list to the staged width: partial adoptions (full
+        # HBM, broken chain) reuse the SAME compiled (n, n) variant as
+        # the full restore instead of compiling an off-diagonal shape
+        # inside a live admission — precompile_kv_import's diagonal is
+        # then the complete variant space
+        n_pad = staged.shape[2]  # already pow2 (stage_import_blocks)
+        bids, cols = self._import_args(block_ids, src_cols, n_pad)
+        key = (n_pad, n_pad)
+        fn = self._import_fns.get(key)
+        if fn is None:
+            logger.info("compiling kv import n_src=%d n_dst=%d", *key)
+            fn = self._import_fns[key] = self._build_import(*key)
+        self.k_cache, self.v_cache = fn(
+            self.k_cache, self.v_cache, jnp.asarray(bids),
+            jnp.asarray(cols), staged,
         )
-        L = self.model_config.num_layers
-        # (2, L, n, nkv, bs, d) -> head-major rows (L, nkv, n*bs, d)
-        hm = data.swapaxes(2, 3)
-        flat = hm.reshape(2, L, hm.shape[2], -1, data.shape[-1])
-        self.k_cache = self.k_cache.at[:, :, idx].set(
-            jnp.asarray(flat[0], self.cache_dtype)
-        )
-        self.v_cache = self.v_cache.at[:, :, idx].set(
-            jnp.asarray(flat[1], self.cache_dtype)
+
+    def precompile_kv_import(self, max_blocks: int) -> int:
+        """Warm the donated import scatter's (n, n) pow2 diagonal up to
+        max_blocks so no XLA compile lands inside a live restore. The
+        diagonal IS the complete variant space: import_staged_blocks
+        pads the dst list to the staged width, so partial adoptions
+        never dispatch an off-diagonal shape. Writes target the null
+        block (trash by design). Returns dispatches."""
+        mc = self.model_config
+        # the wire dtype is whatever materialize_export's np.asarray
+        # yields for the cache dtype (ml_dtypes bf16 on bf16 caches) —
+        # warming float32 would compile a variant live traffic never hits
+        wire_dt = np.asarray(jnp.zeros((), self.cache_dtype)).dtype
+        n = 0
+        p = 1
+        while p <= next_pow2(max(1, max_blocks)):
+            data = np.zeros(
+                (2, mc.num_layers, p, mc.num_kv_heads, self.block_size,
+                 mc.head_dim), wire_dt,
+            )
+            handle = self.stage_import_blocks(data)
+            self.import_staged_blocks([0] * p, handle, list(range(p)))
+            n += 1
+            p *= 2
+        return n
+
+    def import_blocks(self, block_ids: list[int], data: np.ndarray) -> None:
+        """Host->device restore of whole KV blocks (inverse of export).
+        Routed through the staged in-place scatter — a donated update
+        instead of a whole-cache-reallocating eager `.at[].set`."""
+        handle = self.stage_import_blocks(np.asarray(data))
+        self.import_staged_blocks(
+            block_ids, handle, list(range(len(block_ids)))
         )

@@ -57,6 +57,23 @@ class EngineStatsSnapshot:
     prefill_staged_hits_total: int = 0
     prefill_staged_misses_total: int = 0
     prefill_chained_chunks_total: int = 0
+    # zero-stall KV tiering attribution: deferred-export batches (wall
+    # seconds measured ON THE OFFLOAD WORKER — overlapped activity, not
+    # step-loop stalls) and staged restores (enqueue -> landed), plus
+    # per-tier hit/miss/byte counters — tpu:kv_* in /metrics and the
+    # bench `kv_offload` detail slot
+    kv_export_seconds_total: float = 0.0
+    kv_export_blocks_total: int = 0
+    kv_export_bytes_total: int = 0
+    kv_restore_seconds_total: float = 0.0
+    kv_restore_blocks_total: int = 0
+    kv_restore_bytes_total: int = 0
+    kv_restore_fallbacks_total: int = 0
+    # deferred exports forced synchronous by the device-buffer backlog
+    # cap (slow tier backpressure — see LLMEngine.KV_EXPORT_BACKLOG_CAP)
+    kv_export_sync_fallbacks_total: int = 0
+    # tier name -> {hits, misses, read_bytes, write_bytes}
+    kv_tier_counters: dict = field(default_factory=dict)
 
     @property
     def prefix_cache_hit_rate(self) -> float:

@@ -121,8 +121,20 @@ class Scheduler:
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         # optional hook (LLMEngine._restore_from_offload): pull offloaded
-        # KV blocks back into HBM before prompt allocation
+        # KV blocks back into HBM before prompt allocation. Returns
+        # truthy to proceed with admission; falsy to DEFER this request
+        # (its staged restore — tier fetch + h2d upload — is still in
+        # flight; admission order is preserved, so the loop breaks and
+        # retries next step while decode keeps running)
         self.kv_restore = None
+        # optional hook (LLMEngine._flush_kv_exports): enqueue the
+        # deferred-export device snapshot NOW, releasing export-pinned
+        # blocks back to the pool. Returns True when anything was
+        # flushed — callers retry the failed allocation once before
+        # falling back to preemption. The flush is enqueue-only (the
+        # snapshot is device-ordered before any later dispatch's
+        # writes), so calling it mid-schedule costs no stall.
+        self.kv_flush = None
         # optional request-lifecycle recorder (tracing.TimelineRecorder,
         # set by LLMEngine): admit/resume/preempt events for the
         # per-request timeline; None/disabled costs one check
@@ -174,6 +186,7 @@ class Scheduler:
     # -- scheduling -------------------------------------------------------
     def schedule(self) -> SchedulerOutput:
         out = SchedulerOutput()
+        restore_deferred = False
 
         # 1) admit waiting sequences while there is room
         while self.waiting and len(self.running) < self.config.max_num_seqs:
@@ -210,20 +223,35 @@ class Scheduler:
                 continue
             if self.kv_restore is not None:
                 try:
-                    self.kv_restore(seq)
+                    proceed = self.kv_restore(seq)
                 except Exception:  # noqa: BLE001 — restore is best-effort;
                     # a failure must never kill the step loop (the prompt
                     # is simply recomputed from scratch)
                     logger.exception("kv restore failed; recomputing prefix")
-            alloc = self.block_manager.allocate_prompt(
-                seq.prompt_token_ids, seed=seq.hash_seed,
-                # prompt_logprobs must COMPUTE every position; a prefix
-                # hit would skip its rows (vLLM disables reuse the same
-                # way for these requests)
-                reuse_cache=(
-                    seq.sampling_params.prompt_logprobs is None
-                ),
-            )
+                    proceed = True
+                if not proceed:
+                    # staged restore in flight: hold this admission slot
+                    # (FIFO preserved) and let decode run; the engine's
+                    # wait budget bounds how long a wedged tier can
+                    # defer (then the hook returns True = recompute)
+                    restore_deferred = True
+                    break
+            alloc = None
+            for _ in range(2):
+                alloc = self.block_manager.allocate_prompt(
+                    seq.prompt_token_ids, seed=seq.hash_seed,
+                    # prompt_logprobs must COMPUTE every position; a
+                    # prefix hit would skip its rows (vLLM disables
+                    # reuse the same way for these requests)
+                    reuse_cache=(
+                        seq.sampling_params.prompt_logprobs is None
+                    ),
+                )
+                if alloc is not None or self.kv_flush is None or \
+                        not self.kv_flush():
+                    break
+                # export-pinned blocks just returned to the pool: retry
+                # once before escalating to preemption
             if alloc is None:
                 if self._priority_preempt_for(seq, out):
                     continue  # blocks freed; retry this admission
@@ -244,6 +272,9 @@ class Scheduler:
         if (
             self.config.scheduling_policy == "priority"
             and self.waiting
+            and not restore_deferred  # a deferral is not a capacity
+            # shortage: evicting a runner for a request that cannot
+            # admit yet would recompute the victim for nothing
             and len(self.running) >= self.config.max_num_seqs
         ):
             cand = min(
@@ -340,6 +371,10 @@ class Scheduler:
                 seq.num_tokens + self.config.decode_lookahead,
                 seq.block_table,
             ):
+                if self.kv_flush is not None and self.kv_flush():
+                    continue  # export pins released; retry before
+                    # preempting anyone (flush empties the queue, so
+                    # the second pass cannot loop here)
                 victim = self._pick_preemption_victim(exclude=seq)
                 if victim is None:
                     if len(self.running) == 1:

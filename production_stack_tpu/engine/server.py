@@ -162,6 +162,9 @@ class EngineServer:
         while True:
             try:
                 self.metrics.update_from_snapshot(self.engine.stats())
+                self.metrics.observe_kv(
+                    *self.engine.drain_kv_observations()
+                )
             except Exception:  # pragma: no cover
                 logger.exception("stats update failed")
             await asyncio.sleep(STATS_UPDATE_INTERVAL_S)
@@ -1192,6 +1195,7 @@ class EngineServer:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self.metrics.update_from_snapshot(self.engine.stats())
+        self.metrics.observe_kv(*self.engine.drain_kv_observations())
         return web.Response(
             body=generate_latest(self.registry),
             content_type="text/plain",

@@ -10,9 +10,16 @@ is done in one batched copy per freed sequence, not per block.
 
 Each tier is an LRU keyed by the chained block hash (same content address
 the BlockManager and KV controller use). Evictions cascade to the next
-tier. Disk/remote writes happen on a worker thread so the engine step loop
-never blocks on IO; lookups consult the pending-write map first so a block
-is visible the moment it is enqueued.
+tier. ALL tier IO runs on the worker thread so the engine step loop never
+blocks on it:
+
+- writes: lookups consult the pending-write map first so a block is
+  visible the moment it is enqueued. `put_batch_async` additionally
+  defers the d2h materialization itself to the worker — the engine only
+  enqueues the device-side snapshot (zero-stall export).
+- reads: `request_reads`/`poll_reads`/`take_reads` mirror the
+  pending-write map with a pending-READ map, so disk/remote `get`s never
+  run on the scheduler thread (staged restore).
 """
 
 from __future__ import annotations
@@ -21,6 +28,7 @@ import io
 import os
 import queue
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -28,6 +36,11 @@ import numpy as np
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
+
+#: pending-write placeholder for a deferred export whose d2h
+#: materialization has not landed yet: contains() sees the block (no
+#: duplicate export is queued), get() treats it as not-yet-readable
+_EXPORT_PENDING = object()
 
 
 def _nbytes(arr: np.ndarray) -> int:
@@ -130,7 +143,14 @@ class DiskTier(KVTier):
         self.capacity = capacity_bytes
         self.used = 0
         self._sizes: OrderedDict[int, int] = OrderedDict()
+        # hashes reserved in the index whose file has not landed yet
+        # (put runs its IO outside the lock): get() WAITS for them
+        # (matching the old locked-put behavior for sync-mode readers
+        # racing a cascade demotion) while contains()/hashes() stay
+        # non-blocking
+        self._writing: set[int] = set()
         self._lock = threading.RLock()
+        self._landed = threading.Condition(self._lock)
         # adopt pre-existing blocks (restart resume)
         for fn in os.listdir(directory):
             if fn.endswith(".kvblk"):
@@ -146,32 +166,67 @@ class DiskTier(KVTier):
         return os.path.join(self.dir, f"{h}.kvblk")
 
     def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
+        """File IO runs OUTSIDE the lock: the engine step thread's
+        contains()/hashes() probes must never wait on a multi-MB write
+        or the eviction cascade's victim reads (tier writes come from
+        the single offload worker, so put/put races don't exist — the
+        lock only guards the index against the probe threads)."""
         data = serialize_block(arr)  # serialize outside the lock
+        victims: list[tuple[int, int]] = []
         with self._lock:
             if h in self._sizes:
                 self._sizes.move_to_end(h)
                 return []
-            evicted = []
             if self.capacity is not None:
                 if len(data) > self.capacity:
                     return [(h, arr)]
                 while self.used + len(data) > self.capacity and self._sizes:
                     eh, esz = self._sizes.popitem(last=False)
-                    earr = self._read(eh)
-                    try:
-                        os.remove(self._path(eh))
-                    except OSError:
-                        pass
                     self.used -= esz
-                    if earr is not None:
-                        evicted.append((eh, earr))
-            tmp = self._path(h) + ".tmp"
+                    victims.append((eh, esz))
+            # reserve the space under the lock; the file lands below.
+            # _writing marks the gap so a concurrent get() reports
+            # not-ready instead of popping the index and orphaning the
+            # about-to-land file
+            self._sizes[h] = len(data)
+            self.used += len(data)
+            self._writing.add(h)
+        # read victims for the cascade but DELETE NOTHING until the new
+        # block's write succeeds: an ENOSPC after removing victim files
+        # would destroy blocks the tier durably held a moment ago
+        victim_data = [(eh, esz, self._read(eh)) for eh, esz in victims]
+        tmp = self._path(h) + ".tmp"
+        try:
             with open(tmp, "wb") as f:
                 f.write(data)
             os.replace(tmp, self._path(h))
-            self._sizes[h] = len(data)
-            self.used += len(data)
-            return evicted
+        except OSError:
+            try:  # a partial .tmp on a FULL disk must not leak
+                os.remove(tmp)
+            except OSError:
+                pass
+            with self._lock:  # disk full/unwritable: roll back the
+                # index and re-admit the victims (their files are
+                # untouched — nothing was lost)
+                if self._sizes.pop(h, None) is not None:
+                    self.used -= len(data)
+                for eh, esz, _ in victim_data:
+                    self._sizes[eh] = esz
+                    self.used += esz
+            raise
+        finally:
+            with self._landed:
+                self._writing.discard(h)
+                self._landed.notify_all()
+        evicted = []
+        for eh, _, earr in victim_data:
+            try:
+                os.remove(self._path(eh))
+            except OSError:
+                pass
+            if earr is not None:
+                evicted.append((eh, earr))
+        return evicted
 
     def _read(self, h: int) -> np.ndarray | None:
         try:
@@ -181,15 +236,27 @@ class DiskTier(KVTier):
             return None
 
     def get(self, h: int) -> np.ndarray | None:
-        with self._lock:
+        with self._landed:
             if h not in self._sizes:
                 return None
-            arr = self._read(h)
-            if arr is None:
-                self._sizes.pop(h, None)
-                return None
+            # mid-landing (cascade demotion in flight on the worker):
+            # wait for the file like the old locked put would have made
+            # us — the worker never waits here (its own put completed
+            # before any of its reads run), only sync-mode readers do
+            while h in self._writing:
+                self._landed.wait(timeout=0.25)
+                if h not in self._sizes:
+                    return None  # write failed and rolled back
             self._sizes.move_to_end(h)
-            return arr
+        arr = self._read(h)  # file IO outside the lock (see put)
+        if arr is None:
+            with self._lock:  # vanished/corrupt file: drop the index
+                if h not in self._writing:
+                    sz = self._sizes.pop(h, None)
+                    if sz is not None:
+                        self.used -= sz
+            return None
+        return arr
 
     def contains(self, h: int) -> bool:
         with self._lock:
@@ -251,30 +318,69 @@ class RemoteTier(KVTier):
 
 
 class KVOffloadManager:
-    """Tier cascade + async writer + controller reporting.
+    """Tier cascade + async worker + controller reporting.
 
-    put_batch() is called from the engine loop when cached blocks leave HBM
-    (BlockManager free/evict hooks); get()/contains() serve prefix restore
-    on the admission path (Scheduler kv_restore hook).
+    put_batch()/put_batch_async() are called from the engine loop when
+    cached blocks leave HBM (BlockManager free/evict hooks);
+    contains()/request_reads()/poll_reads() serve prefix restore on the
+    admission path (Scheduler kv_restore hook) without ever running tier
+    IO on the scheduler thread. get() is the synchronous fallback
+    (--sync-kv-offload and unit tests).
     """
 
     def __init__(self, tiers: list[KVTier], reporter=None):
         self.tiers = tiers
         self.reporter = reporter
-        # guards only the pending-write map; tiers are internally locked so
-        # the writer thread's disk/remote IO never blocks the engine loop
+        # guards the pending-write/pending-read maps and the per-tier
+        # counters; tiers are internally locked so the worker thread's
+        # disk/remote IO never blocks the engine loop
         self._lock = threading.Lock()
         self._pending: dict[int, np.ndarray] = {}
+        # hash -> (arr | None, serving tier name | None): completed reads
+        # awaiting pickup by the engine (mirror of the pending-write map)
+        self._pending_reads: dict[int, tuple] = {}
+        self._requested_reads: set[int] = set()
+        # hash -> number of live restore records wanting it: concurrent
+        # restores of a SHARED prefix (e.g. a common system prompt) each
+        # hold a reference, so one record's take_reads cannot starve the
+        # others (results are popped only at refcount zero)
+        self._read_refs: dict[int, int] = {}
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # export jobs queued or running: each holds DEVICE gather
+        # buffers alive until materialized, so callers gate on this to
+        # keep HBM from becoming the slow-tier overflow buffer
+        self._export_backlog = 0
         self.hits = 0
         self.misses = 0
+        # per-tier hits/misses/read_bytes/write_bytes (tpu:kv_tier_*)
+        self._tier_counters: dict[str, dict[str, int]] = {}
         self._worker = threading.Thread(
             target=self._run, name="kv-offload-writer", daemon=True
         )
         self._worker.start()
 
-    # -- engine-facing API -------------------------------------------------
+    def _count(self, tier: str, key: str, n: int) -> None:
+        self._count_all({tier: {key: n}})
+
+    def _count_all(self, per_tier: dict[str, dict[str, int]]) -> None:
+        """One lock round-trip for a whole lookup's counter bumps — the
+        worker's per-block loops share this lock with the step thread's
+        contains()/poll_reads() probes."""
+        with self._lock:
+            for tier, deltas in per_tier.items():
+                c = self._tier_counters.setdefault(
+                    tier, {"hits": 0, "misses": 0,
+                           "read_bytes": 0, "write_bytes": 0}
+                )
+                for key, n in deltas.items():
+                    c[key] += n
+
+    def counters(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {t: dict(c) for t, c in self._tier_counters.items()}
+
+    # -- engine-facing API: writes -----------------------------------------
     def put_batch(self, pairs: list[tuple[int, np.ndarray]]) -> None:
         if not pairs:
             return
@@ -285,22 +391,126 @@ class KVOffloadManager:
             ]
             for h, arr in fresh:
                 self._pending[h] = arr
-        for item in fresh:
-            self._q.put(item)
+        for h, arr in fresh:
+            self._q.put(("write", h, arr))
 
-    def get(self, h: int) -> np.ndarray | None:
+    def put_batch_async(
+        self, hashes: list[int], handle, materialize, on_done=None,
+    ) -> None:
+        """Deferred export: `handle` is a DEVICE-side snapshot of the
+        blocks for `hashes` (the engine enqueues it right after the
+        step's dispatch so the copy overlaps compute);
+        `materialize(handle)` runs ON THE WORKER thread and returns the
+        (2, L, n, nkv, bs, d) host array. The hashes become visible to
+        contains() immediately (no duplicate export is ever queued);
+        reads requested for them are served after materialization by
+        FIFO order of the worker queue. `on_done(seconds, blocks,
+        nbytes)` fires on the worker when the batch is stored."""
+        if not hashes:
+            return
+        with self._lock:
+            self._export_backlog += 1
+            for h in hashes:
+                self._pending.setdefault(h, _EXPORT_PENDING)
+        # the handle (live DEVICE gather buffers) travels in a one-shot
+        # box the worker consumes, so neither the queue tuple nor the
+        # worker loop's job binding keeps the buffers alive after the
+        # d2h materialization
+        self._q.put(
+            ("export", list(hashes), [handle], materialize, on_done)
+        )
+
+    def export_backlog(self) -> int:
+        """Deferred-export batches queued or materializing (each pins
+        device gather buffers until the worker's d2h completes)."""
+        with self._lock:
+            return self._export_backlog
+
+    # -- engine-facing API: reads ------------------------------------------
+    def request_reads(self, hashes: list[int]) -> None:
+        """Queue tier fetches on the worker (staged restore). Each call
+        takes a reference on every hash (balanced by take_reads/
+        discard_reads); the fetch itself is queued once per hash."""
+        enq: list[int] = []
+        with self._lock:
+            for h in hashes:
+                self._read_refs[h] = self._read_refs.get(h, 0) + 1
+                if (h not in self._pending_reads
+                        and h not in self._requested_reads):
+                    self._requested_reads.add(h)
+                    enq.append(h)
+        for h in enq:
+            self._q.put(("read", h))
+
+    def poll_reads(self, hashes: list[int]) -> dict[int, tuple]:
+        """Completed subset of `hashes`: h -> (arr | None, tier_name)."""
+        with self._lock:
+            return {
+                h: self._pending_reads[h]
+                for h in hashes if h in self._pending_reads
+            }
+
+    def take_reads(self, hashes: list[int]) -> dict[int, tuple]:
+        """poll_reads + reference release: results are removed only when
+        the LAST wanting record consumed them, so restores sharing a
+        prefix each get their copy."""
+        with self._lock:
+            out = {}
+            for h in hashes:
+                if h in self._pending_reads:
+                    out[h] = self._pending_reads[h]
+                refs = self._read_refs.get(h, 0) - 1
+                if refs > 0:
+                    self._read_refs[h] = refs
+                else:
+                    self._read_refs.pop(h, None)
+                    self._pending_reads.pop(h, None)
+            return out
+
+    def discard_reads(self, hashes: list[int]) -> None:
+        self.take_reads(hashes)
+
+    def _lookup(self, h: int) -> tuple[np.ndarray | None, str | None]:
+        """The ONE block lookup (pending-write map first — a block is
+        readable the moment its write is enqueued — then the tier
+        cascade), with hit/miss/byte accounting. Blocking tier IO runs
+        on the CALLING thread: the worker for async reads, the
+        scheduler thread only on the --sync-kv-offload path."""
         with self._lock:
             arr = self._pending.get(h)
+            if arr is _EXPORT_PENDING:
+                arr = None  # d2h not materialized yet: not readable
         if arr is not None:
             self.hits += 1
-            return arr
+            self._count_all(
+                {"pending": {"hits": 1,
+                             "read_bytes": int(arr.nbytes)}}
+            )
+            return arr, "pending"
+        # accumulate the walk's counters locally; ONE locked flush
+        counts: dict[str, dict[str, int]] = {}
+        hit_tier = None
         for tier in self.tiers:
             arr = tier.get(h)
             if arr is not None:
-                self.hits += 1
-                return arr
+                hit_tier = tier.name
+                counts[tier.name] = {
+                    "hits": 1, "read_bytes": int(arr.nbytes),
+                }
+                break
+            counts[tier.name] = {"misses": 1}
+        if counts:
+            self._count_all(counts)
+        if hit_tier is not None:
+            self.hits += 1
+            return arr, hit_tier
         self.misses += 1
-        return None
+        return None, None
+
+    def get(self, h: int) -> np.ndarray | None:
+        """Synchronous lookup (--sync-kv-offload path and unit tests);
+        the engine's async restore goes through request_reads."""
+        return self._lookup(h)[0]
 
     def contains(self, h: int) -> bool:
         with self._lock:
@@ -331,18 +541,86 @@ class KVOffloadManager:
         self._stop.set()
         self._worker.join(timeout=2.0)
 
-    # -- writer thread -----------------------------------------------------
+    # -- worker thread -----------------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                h, arr = self._q.get(timeout=0.25)
+                job = self._q.get(timeout=0.25)
             except queue.Empty:
                 continue
+            kind = job[0]
+            try:
+                if kind == "write":
+                    self._do_write(job[1], job[2])
+                elif kind == "export":
+                    self._do_export(job[1], job[2], job[3], job[4])
+                else:
+                    self._do_read(job[1])
+            except Exception:  # noqa: BLE001 — one bad block/file must
+                # not kill the worker (and with it every later offload)
+                logger.exception("kv offload %s job failed", kind)
+                if kind == "export":
+                    with self._lock:
+                        for h in job[1]:
+                            if self._pending.get(h) is _EXPORT_PENDING:
+                                self._pending.pop(h, None)
+                elif kind == "read":
+                    with self._lock:
+                        self._requested_reads.discard(job[1])
+                        if self._read_refs.get(job[1], 0) > 0:
+                            # same refcount guard as _do_read: parking
+                            # an unowned failure entry would block the
+                            # NEXT restore's fresh fetch of this hash
+                            self._pending_reads[job[1]] = (None, None)
+
+    def _do_write(self, h: int, arr: np.ndarray) -> None:
+        try:
+            self._store(h, arr)
+        finally:
+            with self._lock:
+                self._pending.pop(h, None)
+
+    def _do_export(self, hashes, box, materialize, on_done) -> None:
+        """Deferred-export body: the BLOCKING d2h materialization plus
+        per-block owning copies, all on this worker thread. `box` holds
+        the device-side handle; popping it here makes this frame the
+        LAST reference, so the gather buffers free the moment the copy
+        lands (or fails) — not when the tier stores finish, and not
+        when the worker loop rebinds its job variable."""
+        t0 = time.perf_counter()
+        try:
+            data = materialize(box.pop())  # (2, L, n, ...) host array
+        finally:
+            with self._lock:
+                self._export_backlog -= 1
+        nbytes = 0
+        for i, h in enumerate(hashes):
+            # per-block contiguous copies: a view of the batched export
+            # array would pin the WHOLE export alive in the CPU tier
+            # until every sibling block is evicted (byte accounting)
+            arr = np.ascontiguousarray(data[:, :, i])
+            nbytes += int(arr.nbytes)
+            with self._lock:
+                self._pending[h] = arr
             try:
                 self._store(h, arr)
             finally:
                 with self._lock:
                     self._pending.pop(h, None)
+        if on_done is not None:
+            on_done(time.perf_counter() - t0, len(hashes), nbytes)
+
+    def _do_read(self, h: int) -> None:
+        """Pending-read body: one _lookup, result parked for the
+        requester(s) (refcounted)."""
+        arr, tier_name = self._lookup(h)
+        with self._lock:
+            self._requested_reads.discard(h)
+            if self._read_refs.get(h, 0) > 0:
+                # only park results someone still wants: every live
+                # restore record holds a reference; a read whose
+                # requesters all dropped (abort/timeout) is garbage
+                self._pending_reads[h] = (arr, tier_name)
 
     def _store(self, h: int, arr: np.ndarray) -> None:
         cascade = [(h, arr)]
@@ -359,6 +637,8 @@ class KVOffloadManager:
                 # controller delete state the tier never held.
                 if not any(eh == ch for eh, _ in evicted):
                     admitted.append(ch)
+                    self._count(tier.name, "write_bytes",
+                                int(carr.nbytes))
                 for eh, earr in evicted:
                     next_cascade.append((eh, earr))
                     if eh != ch:

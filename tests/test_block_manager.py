@@ -131,3 +131,30 @@ def test_fully_cached_prompt_refloors_to_block_boundary():
     assert cached2 == 8
     assert table2[:2] == table[:2]      # shared cached blocks
     assert table2[2] != table[2] or bm.blocks[table2[2]].ref_count >= 1
+
+
+def test_adoption_guard_never_cannibalizes_own_blocks():
+    """Restore landing (PR 4): once only the caller's OWN freshly
+    adopted blocks remain evictable, can_adopt_another must refuse —
+    one more adopt_cached_block would evict an earlier adoption and
+    hand the same block id out twice (duplicate scatter destinations =
+    undefined write order = a cache hash holding another hash's KV)."""
+    bm = BlockManager(num_blocks=6, block_size=4)
+    table, _ = bm.allocate_prompt(list(range(8)))  # 2 blocks referenced
+    adopted: list[int] = []
+    h = 1000
+    while bm.can_adopt_another(len(adopted)):
+        bid = bm.adopt_cached_block(h)
+        if bid is None:
+            break
+        assert bid not in adopted, "block id handed out twice"
+        adopted.append(bid)
+        h += 1
+    # 6 blocks - null - 2 referenced = 3 adoptable; the guard stops
+    # there with every adoption still cached
+    assert len(adopted) == 3
+    assert len(set(adopted)) == len(adopted)
+    for i, bid in enumerate(adopted):
+        assert bm.cached_blocks.get(1000 + i) == bid
+    # and the guard is what stopped us, not pool exhaustion mid-evict
+    assert not bm.can_adopt_another(len(adopted))

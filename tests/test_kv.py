@@ -337,6 +337,225 @@ def test_rejected_block_not_reported_as_tier_evict():
     assert not [e for e in rep.events if e[1] == "reject"], rep.events
 
 
+def test_pending_reads_are_refcounted_across_requesters():
+    """Two restores wanting the SAME hash (shared system prompt) must
+    each get the result: the first take_reads releases one reference
+    but leaves the parked result for the second requester."""
+    cpu = CpuTier(capacity_bytes=1 << 20)
+    m = KVOffloadManager([cpu])
+    try:
+        cpu.put(11, blk(1))
+        m.request_reads([11])  # requester A
+        m.request_reads([11])  # requester B (same hash, no second job)
+        deadline = time.time() + 5
+        while time.time() < deadline and not m.poll_reads([11]):
+            time.sleep(0.01)
+        got_a = m.take_reads([11])
+        assert 11 in got_a and got_a[11][0] is not None
+        got_b = m.take_reads([11])  # B still sees it (refcount)
+        assert 11 in got_b and got_b[11][0] is not None
+        assert m.poll_reads([11]) == {}  # last reference popped it
+        # a read whose requesters ALL dropped before completion is
+        # garbage: nothing parks
+        m.request_reads([12])
+        m.discard_reads([12])
+        cpu.put(12, blk(2))
+        time.sleep(0.3)
+        assert m.poll_reads([12]) == {}
+    finally:
+        m.close()
+
+
+# -- zero-stall tiering: capped-HBM eviction cascade + staged restore -------
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _block_nbytes(model="pst-tiny-debug", block_size=4):
+    from production_stack_tpu.models.config import get_model_config
+
+    mc = get_model_config(model)
+    # wire format (2, L, 1, nkv, bs, d) float32
+    return 2 * mc.num_layers * mc.num_kv_heads * block_size * \
+        mc.head_dim * 4
+
+
+def _capped_cfg(tmp_path, **over):
+    """HBM pool too small for the multi-round working set, CPU tier too
+    small for the whole spill -> eviction cascades into the disk tier."""
+    cfg = dict(
+        model="pst-tiny-debug",
+        tokenizer="byte",
+        dtype="float32",
+        cache_dtype="float32",
+        block_size=4,
+        num_kv_blocks=16,
+        max_num_seqs=2,
+        max_prefill_chunk=32,
+        cpu_offload_bytes=3 * _block_nbytes(),
+        disk_offload_dir=str(tmp_path / "kv-tiers"),
+    )
+    cfg.update(over)
+    return cfg
+
+
+def _run_sessions(engine, rounds):
+    """Run per-user multi-round sessions: each round's prompt is the
+    previous prompt + answer + a fixed question. Returns the final
+    round's outputs per user (resume path exercises restore)."""
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompts = [list(p) for p in rounds["prompts"]]
+    outs = [None] * len(prompts)
+    # ROUND-major: between a user's rounds the OTHER users' rounds churn
+    # the capped HBM pool, so every resume has to restore from the tiers
+    for _ in range(rounds["n"]):
+        for uid in range(len(prompts)):
+            outs[uid] = engine.generate([prompts[uid]], sp)[0]
+            prompts[uid] = (
+                prompts[uid] + list(outs[uid].token_ids)
+                + rounds["questions"][uid]
+            )
+    return list(zip(prompts, outs))
+
+
+def test_kv_tiering_capped_hbm_cascade_e2e(tmp_path):
+    """The acceptance e2e: sessions churn through the HBM pool so
+    eviction cascades cpu -> disk; resumed sessions restore through the
+    staged async path and their tokens stay bit-identical to a
+    recompute-from-scratch control engine (no offload at all)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    rounds = {
+        "n": 3,
+        "prompts": [[10 + u] * 24 for u in range(3)],
+        "questions": [[40 + u] * 8 for u in range(3)],
+    }
+    eng = LLMEngine(EngineConfig(**_capped_cfg(tmp_path)))
+    try:
+        assert eng._kv_async, "async tiering should be the default"
+        finals = _run_sessions(eng, rounds)
+        # the cascade reached the disk tier (cpu holds only 3 blocks)
+        assert _wait_until(lambda: eng.offload.tiers[1].hashes()), (
+            "eviction never cascaded into the disk tier"
+        )
+        # restores actually ran through the staged path and recorded
+        # nonzero overlapped activity (the /metrics histogram feed)
+        assert eng._kv_export_blocks_total > 0
+        assert eng._kv_export_seconds_total > 0.0
+        assert eng._kv_restore_blocks_total > 0
+        assert eng._kv_restore_seconds_total > 0.0
+        exp_obs, rst_obs = eng.drain_kv_observations()
+        assert exp_obs and rst_obs
+        counters = eng.offload.counters()
+        assert sum(c["hits"] for c in counters.values()) > 0
+        assert any(c["write_bytes"] > 0 for c in counters.values())
+        # restore landed as a kv_restore timeline event (tier, blocks,
+        # seconds) on the resumed requests
+        evs = [
+            e
+            for tl in eng.timeline.snapshot(limit=64)
+            for e in tl["events"]
+            if e["name"] == "kv_restore"
+        ]
+        assert evs, "no kv_restore timeline event recorded"
+        assert evs[0]["attributes"]["blocks"] > 0
+        assert evs[0]["attributes"]["seconds"] >= 0.0
+        assert evs[0]["attributes"]["tiers"]
+    finally:
+        eng.shutdown()
+
+    # recompute-from-scratch control: same seed/params, NO offload tiers
+    # and a pool big enough to never evict mid-request
+    ctl = LLMEngine(EngineConfig(**_capped_cfg(
+        tmp_path / "ctl", cpu_offload_bytes=0, disk_offload_dir=None,
+        num_kv_blocks=64,
+    )))
+    try:
+        sp = SamplingParams(
+            max_tokens=4, temperature=0.0, ignore_eos=True
+        )
+        for uid, (final_prompt, out) in enumerate(finals):
+            # final_prompt = final round's prompt + its answer + question;
+            # strip back to the final round's prompt for the control
+            q = rounds["questions"][uid]
+            replay = final_prompt[: len(final_prompt) - len(q)
+                                  - len(out.token_ids)]
+            ctl_out = ctl.generate([replay], sp)[0]
+            assert ctl_out.token_ids == out.token_ids, (
+                f"user {uid}: restore-resumed tokens diverged from the "
+                f"recompute-from-scratch control"
+            )
+    finally:
+        ctl.shutdown()
+
+
+def test_kv_restore_midchain_failure_falls_back(tmp_path):
+    """A block that vanishes from the tiers between contains() and the
+    worker's read (deleted file / evicted entry) truncates the restore
+    at the break; the tail recomputes and tokens stay bit-identical."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    cfg = _capped_cfg(tmp_path, cpu_offload_bytes=64 * 2**20)
+    eng = LLMEngine(EngineConfig(**cfg))
+    sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prompt_a = [7] * 24  # 6 blocks
+    try:
+        out_a1 = eng.generate([prompt_a], sp)[0]
+        cpu = eng.offload.tiers[0]
+        assert _wait_until(lambda: len(cpu.hashes()) >= 4), (
+            "session A never offloaded"
+        )
+        # churn until A's blocks leave HBM
+        for i in range(5):
+            eng.generate([[100 + i] * 24], sp)
+        hashes = eng.block_manager.block_hashes_for(prompt_a, 0)
+        assert _wait_until(
+            lambda: not eng.block_manager.contains_hash(hashes[0])
+        ), "churn never evicted A from HBM"
+        # sabotage the chain mid-way: drop block 2 from every tier AFTER
+        # contains() would have seen it (the worker's read misses)
+        victim = hashes[2]
+        with cpu._lock:
+            if victim in cpu._d:
+                cpu.used -= cpu._d.pop(victim).nbytes
+        disk = eng.offload.tiers[1]
+        with disk._lock:
+            if victim in disk._sizes:
+                disk.used -= disk._sizes.pop(victim)
+                try:
+                    import os as _os
+
+                    _os.remove(disk._path(victim))
+                except OSError:
+                    pass
+        fallbacks0 = eng._kv_restore_fallbacks_total
+        restored0 = eng._kv_restore_blocks_total
+        out_a2 = eng.generate([prompt_a], sp)[0]
+        assert out_a2.token_ids == out_a1.token_ids, (
+            "mid-restore-failure resume diverged from the original"
+        )
+        # the chain truncated: at most the 2 blocks before the break
+        # restored (or none, counted as a fallback) — never the tail
+        assert (eng._kv_restore_blocks_total - restored0) <= 2
+        assert (
+            eng._kv_restore_blocks_total > restored0
+            or eng._kv_restore_fallbacks_total > fallbacks0
+        )
+    finally:
+        eng.shutdown()
+
+
 def test_offloaded_blocks_own_their_memory(tiny_engine_cfg):
     """Engine d2h export must hand each tier per-block OWNING copies: a
     view into the batched export array would pin the whole export alive

@@ -510,6 +510,50 @@ def test_repo_self_scan_is_clean_cli():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_kv_tiering_stays_off_hot_paths():
+    """Zero-stall KV tiering (PR 4): the deferred-export staging
+    (LLMEngine._flush_kv_exports, ModelRunner.stage_export_blocks), the
+    staged-restore staging/landing (_advance_kv_restore,
+    stage_import_blocks, import_staged_blocks), and everything else in
+    engine/ + kv/ must keep device syncs and event-loop stalls off the
+    marked hot paths — the blocking d2h/tier IO belongs to the offload
+    worker thread."""
+    report = analyze_paths(
+        [
+            str(PACKAGE / "engine"),
+            str(PACKAGE / "kv"),
+        ],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    assert report.files_scanned >= 25
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_kv_tiering_hot_marks_present():
+    """The gate above is only meaningful while the staging functions
+    actually carry the hot-path mark — a dropped mark would pass
+    silently. Parse the sources and assert each is marked."""
+    from production_stack_tpu.analysis.core import ModuleContext, iter_functions
+
+    want = {
+        "llm_engine.py": {"_flush_kv_exports", "step"},
+        "model_runner.py": {
+            "stage_export_blocks", "stage_import_blocks",
+            "import_staged_blocks",
+        },
+    }
+    for fname, funcs in want.items():
+        path = PACKAGE / "engine" / fname
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {
+            f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)
+        }
+        missing = funcs - hot
+        assert not missing, f"{fname}: unmarked hot paths {missing}"
+
+
 def test_timeline_recording_stays_off_hot_paths():
     """Request-timeline recording (tracing/ + its engine call sites)
     must not introduce device syncs or event-loop stalls on the marked
