@@ -87,6 +87,14 @@ PREFILL_PIPELINE = os.environ.get("PST_BENCH_PREFILL_PIPELINE", "1") == "1"
 # every existing sweep stays a tracing-free control; @trace enables.
 # Slots: BENCH_SWEEP_trace.json (on) vs the matching untraced config
 TRACE = os.environ.get("PST_BENCH_TRACE", "0") == "1"
+# elastic fused decode (engine device_stop + adaptive_decode_k): stop
+# conditions evaluated INSIDE the fused scan (finished lanes freeze,
+# per-lane valid counts, whole-round early exit) and per-round K sized
+# from pow2 buckets under admission pressure / remaining budget.
+# Default ON (the engine default); @noelastic pins the fixed-trip
+# fixed-K control for the chip-window A/B. Slots:
+# BENCH_SWEEP_elastic.json (on) vs the matching @noelastic control
+ELASTIC = os.environ.get("PST_BENCH_ELASTIC", "1") == "1"
 # KV tiering workload (@kvoff): cap the HBM pool so the multi-round
 # working set churns through the cpu/disk offload tiers — the zero-stall
 # async export/staged-restore measurement. PST_BENCH_KV_BLOCKS overrides
@@ -219,6 +227,10 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_PREFILL_PIPELINE"] = "0"
             elif m == "trace":
                 overrides["PST_BENCH_TRACE"] = "1"
+            elif m == "elastic":
+                overrides["PST_BENCH_ELASTIC"] = "1"
+            elif m == "noelastic":
+                overrides["PST_BENCH_ELASTIC"] = "0"
             elif m == "kvoff":
                 overrides["PST_BENCH_KV_OFFLOAD"] = "1"
             elif m == "synckv":
@@ -227,7 +239,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
-                    "| trace | kvoff | synckv"
+                    "| trace | elastic | noelastic | kvoff | synckv"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -246,7 +258,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
             raise ValueError(
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
-                "|@chunk<N>|@nopfx|@nopfpipe|@trace|@kvoff|@synckv]"
+                "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
+                "|@noelastic|@kvoff|@synckv]"
             )
         configs.append((
             label,
@@ -293,44 +306,12 @@ def _run_sweep() -> None:
             "PST_BENCH_ASYNC": "1" if ad else "0",
             "PST_BENCH_LABEL": label,
         })
-        timed_out = False
-        wedged = False
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=subprocess.PIPE, text=True,
-        )
-        try:
-            stdout, _ = proc.communicate(timeout=per_config_timeout)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            # SIGTERM, never SIGKILL: the child owns the chip session and
-            # must release it via its handler (see utils/chip_guard.py)
-            proc.terminate()
-            try:
-                stdout, _ = proc.communicate(timeout=60)
-            except subprocess.TimeoutExpired:
-                # the child ignored SIGTERM: it still holds the chip
-                # flock, so any further config would fail instantly with
-                # ChipBusyError — abort the sweep instead of recording
-                # lock errors as measurements (and leaving a zombie)
-                stdout = ""
-                wedged = True
-        # even on timeout, a graceful SIGTERM shutdown (or the child's
-        # teardown guard) may have emitted a COMPLETED measurement —
-        # prefer it over a synthetic failure row
-        r = _last_json(stdout)
-        if r is None and timed_out:
-            r = {"metric": f"sweep-config-timeout: {label}",
-                 "value": 0.0, "unit": "gen_tokens/s/chip",
-                 "vs_baseline": 0.0,
-                 "error": f"no result after {per_config_timeout:.0f}s"
-                          + ("; child unresponsive to SIGTERM, sweep "
-                             "aborted" if wedged else "")}
-        elif r is None:
-            r = {"metric": f"sweep-config-failed: {label}",
-                 "value": 0.0, "unit": "gen_tokens/s/chip",
-                 "vs_baseline": 0.0,
-                 "error": f"exit={proc.returncode}, no JSON line"}
+        r, wedged = _run_one_config(label, env, per_config_timeout)
+        # every row records whether the config actually measured;
+        # watchdog rows carry the explicit marker the K=16 wedge
+        # (round 5 window 2) taught us to expect
+        r["ok"] = (not r.get("watchdog")
+                   and r.get("value", 0.0) > 0.0)
         print(f"# sweep {label}: {json.dumps(r)}", file=sys.stderr)
         results.append(r)
         with open(out_path, "w") as f:
@@ -339,11 +320,15 @@ def _run_sweep() -> None:
         if wedged:
             break
         if r.get("value", 0.0) == 0.0:
-            # config produced no measurement — if the chip itself has
-            # stopped answering (tunnel drop mid-window, the 01:01 UTC
-            # failure mode), every remaining config would burn its full
-            # timeout the same way; probe once and stop the sweep so the
-            # probe loop can start hunting for the next window
+            # config produced no measurement — a config-specific wedge
+            # (the K=16 wedge that aborted the whole round-5 matrix) or
+            # a dead chip. The child's in-process watchdog fires on
+            # HOST time, so its row cannot distinguish the two: probe
+            # once (~120 s) and CONTINUE to the remaining configs when
+            # the chip answers ({"ok": false, "watchdog": true} stays
+            # in the JSON), stop the sweep when it doesn't — otherwise
+            # a tunnel drop mid-window (the 01:01 UTC failure mode)
+            # burns every remaining config's full timeout
             probe = os.path.join(os.path.dirname(os.path.abspath(
                 __file__)), "scripts", "tpu_probe.py")
             pp = subprocess.Popen(
@@ -374,6 +359,68 @@ def _run_sweep() -> None:
     print(json.dumps(best))
 
 
+def _run_one_config(
+    label: str, env: dict, timeout: float
+) -> tuple[dict, bool]:
+    """Run ONE sweep config in its own subprocess (chip-session
+    hygiene: process exit is the only reliable HBM-release primitive
+    through the tunnel). Returns (driver-contract row, child_wedged);
+    `child_wedged` means the child ignored SIGTERM and still holds the
+    chip flock, so the caller must abort the sweep. Rows from a fired
+    watchdog (the child's 1200 s run deadline, or the parent timeout
+    here) carry `watchdog: true`; the parent-timeout row additionally
+    carries `parent_timeout: true` (child emitted nothing at all).
+    Either way the sweep probes chip health before continuing — the
+    child watchdog fires on host time, so its row cannot prove the
+    chip is alive. Factored out of _run_sweep so the
+    watchdog-continue contract is testable without a chip."""
+    import subprocess
+
+    timed_out = False
+    wedged = False
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        # SIGTERM, never SIGKILL: the child owns the chip session and
+        # must release it via its handler (see utils/chip_guard.py)
+        proc.terminate()
+        try:
+            stdout, _ = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            # the child ignored SIGTERM: it still holds the chip
+            # flock, so any further config would fail instantly with
+            # ChipBusyError — abort the sweep instead of recording
+            # lock errors as measurements (and leaving a zombie)
+            stdout = ""
+            wedged = True
+    # even on timeout, a graceful SIGTERM shutdown (or the child's
+    # teardown guard) may have emitted a COMPLETED measurement —
+    # prefer it over a synthetic failure row
+    r = _last_json(stdout)
+    if r is None and timed_out:
+        r = {"metric": f"sweep-config-timeout: {label}",
+             "value": 0.0, "unit": "gen_tokens/s/chip",
+             "vs_baseline": 0.0, "watchdog": True,
+             # parent_timeout: the CHILD emitted nothing at all (its
+             # own watchdog never even fired) — kept as a distinct
+             # marker for sweep-JSON forensics
+             "parent_timeout": True,
+             "error": f"no result after {timeout:.0f}s"
+                      + ("; child unresponsive to SIGTERM, sweep "
+                         "aborted" if wedged else "")}
+    elif r is None:
+        r = {"metric": f"sweep-config-failed: {label}",
+             "value": 0.0, "unit": "gen_tokens/s/chip",
+             "vs_baseline": 0.0,
+             "error": f"exit={proc.returncode}, no JSON line"}
+    return r, wedged
+
+
 def _last_json(stdout: str | None) -> dict | None:
     """Parse the last driver-contract JSON line from a child's stdout."""
     lines = [ln for ln in (stdout or "").splitlines()
@@ -401,6 +448,11 @@ def _arm_watchdog(seconds: float, label: str):
             "value": 0.0,
             "unit": "gen_tokens/s/chip",
             "vs_baseline": 0.0,
+            # explicit marker: the sweep parent records this row as
+            # {"ok": false, "watchdog": true} and CONTINUES with the
+            # remaining configs (the K=16 wedge must not abort a
+            # scarce chip window's whole matrix)
+            "watchdog": True,
             "error": f"{label} exceeded {seconds:.0f}s — chip wedged?",
         }), flush=True)
         os._exit(2)
@@ -466,6 +518,10 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         max_prefill_seqs=prefill_seqs,
         tensor_parallel_size=TP,
         num_scheduler_steps=sched_steps,
+        # elastic fused decode A/B: @noelastic pins the fixed-trip
+        # fixed-K control (the pre-elastic behavior) for attribution
+        device_stop=ELASTIC,
+        adaptive_decode_k=ELASTIC,
         async_decode=async_decode,
         prefetch_decode=PREFETCH,
         prefill_pipeline=PREFILL_PIPELINE,
@@ -583,13 +639,29 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         ndisp = rnr.precompile_prefill(singles, groups)
         if ROUNDS > 1:
             # later rounds also cross decode ctx buckets (pow2 block
-            # counts) the warmup never reached
+            # counts) the warmup never reached; elastic serving also
+            # dispatches the pow2 K buckets below the cap (adaptive K)
+            # and the prefetch-chained device-stop variant
             grow = ANSWER_TOK + QUESTION_TOK
-            ndisp += rnr.precompile_decode(
-                [plen + r * grow + ANSWER_TOK for r in range(ROUNDS)],
-                sched_steps,
-                chained=async_decode,
+            decode_ctxs = [
+                plen + r * grow + ANSWER_TOK for r in range(ROUNDS)
+            ]
+            from production_stack_tpu.engine.scheduler import (
+                decode_precompile_variants,
             )
+
+            # the ONE variant-selection policy precompile_serving uses
+            # too — the warmed (k, chained, stop) set must match what
+            # pick_decode_k + the dispatch gates select at runtime
+            for kk, chained, stop in decode_precompile_variants(
+                sched_steps, ELASTIC,
+                overlap=async_decode or PREFETCH,
+                async_chained=async_decode,
+                device_stop=ELASTIC,
+            ):
+                ndisp += rnr.precompile_decode(
+                    decode_ctxs, kk, chained=chained, stop=stop,
+                )
         print(
             f"# prefill precompile: {ndisp} dispatches in "
             f"{time.time() - t0:.1f}s",
@@ -739,6 +811,23 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             "prefill_staged_hits": engine._pf_staged_hits_total,
             "prefill_staged_misses": engine._pf_staged_misses_total,
             "prefill_chained_chunks": engine._pf_chained_chunks_total,
+            # elastic fused decode attribution: chosen-K distribution
+            # (adaptive sizing), host-discarded overshoot slots (the
+            # K=32 waste mode — ~0 under device stops), and whole-round
+            # device early exits
+            "elastic_decode": {
+                "device_stop": ELASTIC,
+                "adaptive_decode_k": ELASTIC,
+                "decode_rounds": engine._decode_rounds_total,
+                "decode_k_hist": {
+                    str(kk): v
+                    for kk, v in sorted(engine._decode_k_hist.items())
+                },
+                "overshoot_tokens":
+                    engine._decode_overshoot_tokens_total,
+                "early_exit_rounds":
+                    engine._decode_early_exit_rounds_total,
+            },
             # zero-stall KV tiering attribution (@kvoff): export time is
             # offload-worker wall (overlapped), restore time is
             # enqueue->landed (overlaps queue wait); tier counters show

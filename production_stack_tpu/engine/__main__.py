@@ -55,7 +55,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "wait (0 = prefill always wins)")
     p.add_argument("--num-scheduler-steps", type=int, default=1,
                    help="fused decode+sample iterations per dispatch "
-                        "(on-device sampling; amortises host RTT)")
+                        "(on-device sampling; amortises host RTT); the "
+                        "CAP under --adaptive-decode-k")
+    p.add_argument("--device-stop", action="store_true", default=True,
+                   help="evaluate EOS/stop-token/max-token stops INSIDE "
+                        "the fused decode scan: finished lanes freeze "
+                        "mid-round, the host takes exactly the "
+                        "generated tokens")
+    p.add_argument("--no-device-stop", dest="device_stop",
+                   action="store_false",
+                   help="fixed-trip fused scan; overshoot discarded on "
+                        "the host (chip-window A/B control)")
+    p.add_argument("--adaptive-decode-k", action="store_true",
+                   default=True,
+                   help="size each fused round from pow2 buckets up to "
+                        "--num-scheduler-steps: clamped low while "
+                        "prefill work waits, bounded by the batch's "
+                        "remaining-token budget")
+    p.add_argument("--no-adaptive-decode-k", dest="adaptive_decode_k",
+                   action="store_false",
+                   help="every round dispatches the full "
+                        "--num-scheduler-steps (fixed-K control)")
     p.add_argument("--num-speculative-tokens", type=int, default=0,
                    help="ngram prompt-lookup speculative decoding: "
                         "draft up to this many tokens and verify them "
@@ -198,6 +218,8 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         max_prefill_seqs=args.max_prefill_seqs,
         decode_interleave=args.decode_interleave,
         num_scheduler_steps=args.num_scheduler_steps,
+        device_stop=args.device_stop,
+        adaptive_decode_k=args.adaptive_decode_k,
         async_decode=args.async_decode,
         precompile_serving=args.precompile_serving,
         prefetch_decode=args.prefetch_decode,

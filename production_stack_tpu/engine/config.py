@@ -46,8 +46,35 @@ class EngineConfig:
     # sampling (incl. presence/frequency/repetition penalties, whose
     # token counts ride on device through the scan) runs on device and K
     # tokens come back in ONE host fetch, amortising the dispatch/fetch
-    # RTT. Must be <= block_size.
+    # RTT. Must be <= block_size. With adaptive_decode_k this is the
+    # CAP: the scheduler sizes each round from pow2 buckets up to it.
     num_scheduler_steps: int = 1
+    # elastic fused decode, part 1 — device-side stop masks: EOS, the
+    # request's stop_token_ids, and a remaining-max_tokens countdown
+    # are evaluated INSIDE the fused K-step scan. A lane that finishes
+    # mid-round freezes (sampled slot pinned to the pad token, KV-slot
+    # writes redirected to the trash slot, penalty/guided state
+    # updates masked) and the dispatch returns per-lane valid counts,
+    # so the host applies exactly the generated tokens instead of
+    # discarding overshoot after the fetch; a round whose lanes all
+    # finish exits early (lax.while_loop). The round-5 chip window
+    # measured K=32 wasting 28% of sampled slots on exactly this
+    # overshoot. False (--no-device-stop) keeps the fixed-trip scan as
+    # the chip-window A/B control. Host-side stop STRINGS still
+    # resolve on the host (text matching cannot run on device).
+    # Multihost engines ignore this (the broadcast wire ships host
+    # token lists, not stop matrices).
+    device_stop: bool = True
+    # elastic fused decode, part 2 — admission-aware adaptive K: the
+    # scheduler picks each round's K from pow2 buckets (precompiled by
+    # --precompile-serving) instead of always dispatching the full
+    # num_scheduler_steps. A queued/cold prefill clamps K low so a
+    # long fused round never starves admission (the K=16 TTFT-blowup
+    # failure mode, PERF.md round 5 window 2), and the batch's max
+    # remaining-token budget bounds K so the last rounds of short
+    # answers stop dispatching full-K programs (the K=32 waste mode).
+    # False (--no-adaptive-decode-k) keeps the fixed-K behavior.
+    adaptive_decode_k: bool = True
     # double-buffered decode (vLLM --async-scheduling role): dispatch
     # decode round N+1 chained on round N's ON-DEVICE sampled tokens
     # before fetching round N, so the device never idles on the

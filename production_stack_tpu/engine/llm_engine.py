@@ -30,6 +30,7 @@ from production_stack_tpu.engine.scheduler import (
     PrefillWork,
     Scheduler,
     SchedulerConfig,
+    decode_precompile_variants,
 )
 from production_stack_tpu.engine.sequence import Sequence, SequenceStatus
 from production_stack_tpu.engine.tokenizer import get_tokenizer
@@ -77,6 +78,11 @@ class LLMEngine:
                 scheduling_policy=config.scheduling_policy,
                 decode_interleave=config.decode_interleave,
                 decode_lookahead=max(0, config.num_scheduler_steps - 1),
+                decode_k_cap=config.num_scheduler_steps,
+                adaptive_decode_k=(
+                    config.adaptive_decode_k
+                    and config.num_scheduler_steps > 1
+                ),
             ),
             self.block_manager,
         )
@@ -128,6 +134,26 @@ class LLMEngine:
             and config.num_scheduler_steps > 1
             and not config.multihost
         )
+        # device-side stop masks (elastic fused decode): EOS / stop-id /
+        # remaining-budget checks ride INSIDE the fused scan, a finished
+        # lane freezes mid-round and the dispatch returns per-lane valid
+        # counts. Multihost is out (the broadcast wire ships host token
+        # lists, not stop matrices); async-chained rounds fall back per
+        # dispatch (the chain commits the NEXT round before the valid
+        # counts are known — see the will_async gate in the decode path)
+        self._device_stop = (
+            config.device_stop
+            and config.num_scheduler_steps > 1
+            and not config.multihost
+        )
+        # elastic decode accounting: chosen-K histogram observations
+        # (drained by the server's stats loop into tpu:decode_k),
+        # host-discarded overshoot tokens (~0 under device stops except
+        # for host-resolved stop STRINGS), and whole-round early exits
+        self._decode_rounds_total = 0
+        self._decode_k_hist: dict[int, int] = {}
+        self._decode_overshoot_tokens_total = 0
+        self._decode_early_exit_rounds_total = 0
         # speculative h2d prefetch (stage_decode_multi): upload the NEXT
         # fused round's packed host inputs while the current round is
         # still executing, then dispatch it chained on the on-device
@@ -215,6 +241,9 @@ class LLMEngine:
 
         self._kv_export_obs: _deque = _deque(maxlen=1024)
         self._kv_restore_obs: _deque = _deque(maxlen=1024)
+        # chosen-K per decode round, drained into the tpu:decode_k
+        # histogram by the server's stats loop (appends/pops GIL-atomic)
+        self._decode_k_obs: _deque = _deque(maxlen=4096)
         self._kv_export_seconds_total = 0.0
         self._kv_export_blocks_total = 0
         self._kv_export_bytes_total = 0
@@ -1064,20 +1093,20 @@ class LLMEngine:
         return self._reserve_next_round(seqs, k)
 
     def _stage_fingerprint(
-        self, seqs: list[Sequence], k: int, future: bool = False
+        self, seqs: list[Sequence], k: int, advance: int = 0
     ) -> tuple:
         """State the staged buffer was built for, as observed at the
         NEXT dispatch: same lanes in the same order, every lane exactly
-        K tokens further, block tables untouched since the stage's
-        growth, and NO free() anywhere in between (the free epoch) —
-        freed block ids can be re-handed to another sequence, making a
-        same-length table reference someone else's KV. `future=True`
-        computes the prediction at stage time (before the in-flight
-        round's tokens are applied)."""
-        d = k if future else 0
+        `advance` tokens further, block tables untouched since the
+        stage's growth, and NO free() anywhere in between (the free
+        epoch) — freed block ids can be re-handed to another sequence,
+        making a same-length table reference someone else's KV. At
+        stage time `advance` is the CURRENT round's K (its tokens are
+        not yet applied) while `k` is the STAGED round's predicted K —
+        under adaptive K the two can differ."""
         return (
             tuple(s.request_id for s in seqs),
-            tuple(s.num_tokens + d for s in seqs),
+            tuple(s.num_tokens + advance for s in seqs),
             tuple(len(s.block_table) for s in seqs),
             self.block_manager.free_epoch,
             k,
@@ -1104,15 +1133,35 @@ class LLMEngine:
     def _apply_multi_tokens(
         self, seqs: list[Sequence], toks: np.ndarray, k: int,
         lps: tuple | None = None,
+        valid: np.ndarray | None = None,
     ) -> None:
         """Apply a fused-K round's (k, b) sampled tokens — the ONE copy
         of the bookkeeping both the sync and async paths share.
         `lps` = (chosen (k,b), top_vals (k,b,CAP), top_ids (k,b,CAP))
-        host arrays when any lane requested logprobs."""
+        host arrays when any lane requested logprobs. `valid` = the
+        device-stop per-lane valid counts ((b,) int32, full-lane
+        padded): rows >= valid[lane] were frozen ON DEVICE (pinned pad,
+        no KV/state writes, never sampled) and are skipped without
+        touching the overshoot counter — the host takes exactly the
+        generated tokens."""
+        nb = len(seqs)
+        # one numpy->python conversion per lane, not one per k*b slot
+        vcounts = valid[:nb].tolist() if valid is not None else None
+        if vcounts and max(vcounts) < k:
+            # every lane froze before the trip count: the device round
+            # exited early instead of paying the all-finished tail
+            self._decode_early_exit_rounds_total += 1
         for i in range(k):
             for j, seq in enumerate(seqs):
+                if vcounts is not None and i >= vcounts[j]:
+                    continue  # device-frozen rows: pad, never sampled
                 if seq.finished:
-                    continue  # overshoot tokens are discarded
+                    # host-side stop (stop strings, guided completion,
+                    # or the fixed-trip --no-device-stop control): this
+                    # slot WAS sampled on device and is now discarded —
+                    # the waste class device stops exist to eliminate
+                    self._decode_overshoot_tokens_total += 1
+                    continue
                 seq.num_computed_tokens = seq.num_tokens
                 entry = None
                 n = seq.sampling_params.logprobs
@@ -1128,12 +1177,29 @@ class LLMEngine:
                         ],
                     }
                 self._append_token(seq, int(toks[i, j]), entry)
+        self._note_decode_round(seqs, k)
+
+    def _note_decode_round(
+        self, seqs: list[Sequence], k: int
+    ) -> None:
+        """Per-round elastic-decode accounting — the ONE copy shared
+        by the fused path (_apply_multi_tokens) and the single-step
+        branch (adaptive K sizes rounds down to 1): tpu:decode_rounds /
+        tpu:decode_k chosen-K histogram, and one SAMPLED timeline tick
+        per request per round (tracing.DECODE_EVENT_EVERY), not per
+        token — the elastic k_chosen/lanes_done fields ride the same
+        append-only event."""
+        self._decode_rounds_total += 1
+        self._decode_k_hist[k] = self._decode_k_hist.get(k, 0) + 1
+        self._decode_k_obs.append(k)
         if self._tl_enabled:
-            # one SAMPLED timeline tick per request per fused round
-            # (tracing.DECODE_EVENT_EVERY), not per token
+            lanes_done = sum(1 for s in seqs if s.finished)
+            attrs = {"k_chosen": k, "lanes_done": lanes_done}
             for seq in seqs:
                 if not seq.finished:
-                    self.timeline.decode_round(seq.request_id, k)
+                    self.timeline.decode_round(
+                        seq.request_id, k, attrs=attrs
+                    )
 
     # -- the step loop ----------------------------------------------------
     # stackcheck: hot-path — may only enqueue (flush = device-snapshot
@@ -1289,7 +1355,11 @@ class LLMEngine:
             positions = [s.num_tokens - 1 for s in seqs]
             tables = [s.block_table for s in seqs]
             ctx_lens = [s.num_tokens for s in seqs]
-            k_steps = self.config.num_scheduler_steps
+            # elastic fused decode: the scheduler sized this round
+            # (pow2 bucket <= num_scheduler_steps, clamped under
+            # admission pressure / the batch's remaining budget); with
+            # adaptive K off this IS num_scheduler_steps
+            k_steps = sched_out.decode.k
             # guided lanes ride the fused multi-step scan via on-device
             # TokenDFA tables (structured.TokenDFA — outlines-style
             # FSM-index compilation); only constraints too large to
@@ -1336,6 +1406,18 @@ class LLMEngine:
                     s.sampling_params.logprobs is not None for s in seqs
                 )
                 bias = self._bias_arrays(seqs)
+                will_async = (
+                    self._async_decode and penalties is None
+                    and guided_tables is None and bias is None
+                )
+                # device-side stop masks: not on async-chained rounds —
+                # the chain commits round N+1 before round N's valid
+                # counts are known, so a mid-round freeze would leave
+                # the chained dispatch running on a pad token
+                stop = (
+                    self._stop_arrays(seqs)
+                    if self._device_stop and not will_async else None
+                )
                 staged_kw = {}
                 st = self._staged_decode
                 self._staged_decode = None
@@ -1355,6 +1437,10 @@ class LLMEngine:
                 # fused on-device decode+sample loop: K tokens per
                 # dispatch, ONE device->host fetch (the per-step RTT is
                 # the serving bottleneck through remote/tunneled chips)
+                # stop rides a conditional kwarg: the multihost runner
+                # wrapper replays host token lists and knows no stop
+                # masks (and _device_stop is already off there)
+                stop_kw = {"stop": stop} if stop is not None else {}
                 ys = self.runner.decode_multi(
                     tokens, positions, tables, ctx_lens, k_steps,
                     temps, top_ps, top_ks, keys, min_ps=min_ps,
@@ -1363,13 +1449,19 @@ class LLMEngine:
                     want_logprobs=want_lp,
                     guided=guided_tables,
                     logit_bias=bias,
+                    **stop_kw,
                     **staged_kw,
-                )  # (k, b) on device [+ logprob arrays]
-                toks_dev, lps_dev = (
-                    (ys[0], ys[1:]) if want_lp else (ys, None)
-                )
-                if (self._async_decode and penalties is None
-                        and guided_tables is None and bias is None):
+                )  # (k, b) on device [+ logprob arrays] [+ valid]
+                valid_dev = None
+                if stop is not None:
+                    toks_dev = ys[0]
+                    valid_dev = ys[-1]
+                    lps_dev = ys[1:-1] if want_lp else None
+                else:
+                    toks_dev, lps_dev = (
+                        (ys[0], ys[1:]) if want_lp else (ys, None)
+                    )
+                if will_async:
                     # start the double-buffered pipeline: leave the
                     # tokens on device; the NEXT step dispatches the
                     # following round before fetching this one
@@ -1386,15 +1478,35 @@ class LLMEngine:
                     # fingerprint before the next dispatch uses it
                     nk = keys.copy()
                     nk[:, 1] += k_steps
+                    # predict the NEXT round's adaptive K; capped at
+                    # this round's K because _reserve_next_round only
+                    # grew the block tables to cover 2*k positions
+                    k_next = min(
+                        self.scheduler.pick_decode_k(
+                            seqs, advance=k_steps),
+                        k_steps,
+                    )
+                    stage_stop = None
+                    if stop is not None:
+                        # the countdowns advance with the k tokens this
+                        # round will apply (a lane that freezes earlier
+                        # breaks the fingerprint, so the stale stage is
+                        # never dispatched)
+                        stage_stop = (
+                            stop[0],
+                            np.maximum(stop[1] - k_steps, 0),
+                            stop[2] - k_steps,
+                            stop[3],
+                        )
                     self._staged_decode = {
                         "fp": self._stage_fingerprint(
-                            seqs, k_steps, future=True),
+                            seqs, k_next, advance=k_steps),
                         "handle": self.runner.stage_decode_multi(
                             [s.num_tokens - 1 + k_steps for s in seqs],
                             [s.block_table for s in seqs],
                             [s.num_tokens + k_steps for s in seqs],
-                            k_steps, temps, top_ps, top_ks, nk,
-                            min_ps=min_ps,
+                            k_next, temps, top_ps, top_ks, nk,
+                            min_ps=min_ps, stop=stage_stop,
                         ),
                         "chain_tokens": toks_dev[-1],
                     }
@@ -1402,6 +1514,10 @@ class LLMEngine:
                     seqs, np.asarray(toks_dev), k_steps,
                     lps=tuple(np.asarray(a) for a in lps_dev)
                     if lps_dev else None,
+                    valid=(
+                        np.asarray(valid_dev)
+                        if valid_dev is not None else None
+                    ),
                 )
                 stepped.extend(seqs)
             else:
@@ -1423,10 +1539,10 @@ class LLMEngine:
                         )
                     self._append_token(seq, int(token), entry)
                     stepped.append(seq)
-                if self._tl_enabled:
-                    for seq in seqs:
-                        if not seq.finished:
-                            self.timeline.decode_round(seq.request_id, 1)
+                # adaptive K can size a round down to 1 (single token
+                # left / admission pressure): those rounds belong in the
+                # tpu:decode_k histogram too
+                self._note_decode_round(seqs, 1)
 
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
@@ -1951,6 +2067,61 @@ class LLMEngine:
                 np.uint32(len(s.generated_token_ids)),
             )
         return temps, top_ps, top_ks, min_ps, keys, needs_penalties
+
+    # stackcheck: hot-path — host-array build feeding the fused decode
+    # dispatch: one pass over the batch, no device work, no blocking IO
+    def _stop_arrays(
+        self, seqs: list[Sequence]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray | None]:
+        """Per-lane device-stop arrays for ModelRunner.decode_multi:
+        (eos, min_rem, budget, stop_ids|None). eos ships -1 under
+        ignore_eos (or an EOS-less tokenizer) so the device check never
+        fires; min_rem/budget are THIS-ROUND countdowns of the host's
+        min_tokens / max_tokens+max_model_len gates (Sequence.check_stop
+        semantics); stop_ids pads each lane's stop_token_ids to the
+        batch's pow2 cap with -1 (token ids are non-negative, the
+        sentinel never matches). Stop STRINGS stay host-resolved — text
+        matching cannot run on device — so their overshoot is discarded
+        exactly as on the fixed-trip path."""
+        b = len(seqs)
+        eos = np.full((b,), -1, np.int32)
+        min_rem = np.zeros((b,), np.int32)
+        budget = np.zeros((b,), np.int32)
+        mml = self.scheduler.config.max_model_len
+        max_ids = 0
+        for i, s in enumerate(seqs):
+            sp = s.sampling_params
+            if not sp.ignore_eos and s.eos_token_id is not None:
+                eos[i] = int(s.eos_token_id)
+            gen = len(s.generated_token_ids)
+            min_rem[i] = max(0, sp.min_tokens - gen)
+            # scheduled lanes are unfinished, so both terms are >= 1
+            budget[i] = max(
+                1, min(sp.max_tokens - gen, mml - s.num_tokens)
+            )
+            if sp.stop_token_ids:
+                max_ids = max(max_ids, len(sp.stop_token_ids))
+        stop_ids = None
+        if max_ids:
+            # pow2 cap (>= 4) keeps the program-variant space tiny
+            cap = max(4, 1 << (max_ids - 1).bit_length())
+            stop_ids = np.full((b, cap), -1, np.int32)
+            for i, s in enumerate(seqs):
+                ids = list(s.sampling_params.stop_token_ids or ())
+                if ids:
+                    stop_ids[i, : len(ids)] = ids
+        return eos, min_rem, budget, stop_ids
+
+    def drain_decode_k_observations(self) -> list[int]:
+        """Chosen-K observations since the last drain — feeds the
+        server's tpu:decode_k histogram (deque pops GIL-atomic)."""
+        out: list[int] = []
+        while True:
+            try:
+                out.append(self._decode_k_obs.popleft())
+            except IndexError:
+                break
+        return out
 
     @staticmethod
     def _bias_arrays(
@@ -2662,6 +2833,13 @@ class LLMEngine:
             prefill_staged_hits_total=self._pf_staged_hits_total,
             prefill_staged_misses_total=self._pf_staged_misses_total,
             prefill_chained_chunks_total=self._pf_chained_chunks_total,
+            decode_rounds_total=self._decode_rounds_total,
+            decode_overshoot_tokens_total=(
+                self._decode_overshoot_tokens_total
+            ),
+            decode_early_exit_rounds_total=(
+                self._decode_early_exit_rounds_total
+            ),
             kv_export_seconds_total=self._kv_export_seconds_total,
             kv_export_blocks_total=self._kv_export_blocks_total,
             kv_export_bytes_total=self._kv_export_bytes_total,
@@ -2764,13 +2942,20 @@ class LLMEngine:
         # decode: pick context lens that land IN each bucket after the
         # +K-1 lookahead shift (passing the bucket boundary itself would
         # shift every program one bucket up and leave the smallest
-        # bucket cold)
-        k = cfg.num_scheduler_steps
-        n += rnr.precompile_decode(
-            [max(1, c - k + 1) for c in ctxs], k,
-            # BOTH overlap features dispatch the chained program variant
-            chained=self._async_decode or self._prefetch_decode,
-        )
+        # bucket cold). Adaptive K dispatches any pow2 bucket below the
+        # cap, so warm each bucket's program (fixed K = just the cap);
+        # device stops select a distinct program variant.
+        for kk, chained, stop in decode_precompile_variants(
+            cfg.num_scheduler_steps,
+            self.scheduler.config.adaptive_decode_k,
+            overlap=self._async_decode or self._prefetch_decode,
+            async_chained=self._async_decode,
+            device_stop=self._device_stop,
+        ):
+            n += rnr.precompile_decode(
+                [max(1, c - kk + 1) for c in ctxs], kk,
+                chained=chained, stop=stop,
+            )
         if cfg.num_speculative_tokens > 0:
             n += rnr.precompile_verify(
                 ctxs, cfg.num_speculative_tokens + 1, cfg.max_num_seqs

@@ -174,6 +174,33 @@ class EngineMetrics:
             "backlog cap (tier IO slower than eviction churn)",
             label, registry=reg,
         )
+        # elastic fused decode: per-round chosen K (adaptive sizing in
+        # pow2 buckets up to num_scheduler_steps), host-discarded
+        # overshoot tokens (the K=32 waste mode — ~0 under device
+        # stops), and whole-round device early exits
+        self.decode_k = Histogram(
+            "tpu:decode_k",
+            "Fused decode iterations dispatched per round (adaptive K "
+            "buckets; the cap with --no-adaptive-decode-k)",
+            label, buckets=(1, 2, 4, 8, 16, 32), registry=reg,
+        )
+        self.decode_rounds = Counter(
+            "tpu:decode_rounds", "Decode rounds dispatched",
+            label, registry=reg,
+        )
+        self.decode_overshoot = Counter(
+            "tpu:decode_overshoot_tokens",
+            "Sampled decode slots discarded by the host past a stop "
+            "condition (device stops freeze these lanes on device "
+            "instead; stop STRINGS still resolve host-side)",
+            label, registry=reg,
+        )
+        self.decode_early_exits = Counter(
+            "tpu:decode_early_exit_rounds",
+            "Fused decode rounds whose device loop exited before the "
+            "trip count because every lane had finished",
+            label, registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -261,6 +288,14 @@ class EngineMetrics:
         self.prefill_chained_chunks.labels(m).inc(max(
             0, s.prefill_chained_chunks_total
             - prev.prefill_chained_chunks_total))
+        self.decode_rounds.labels(m).inc(max(
+            0, s.decode_rounds_total - prev.decode_rounds_total))
+        self.decode_overshoot.labels(m).inc(max(
+            0, s.decode_overshoot_tokens_total
+            - prev.decode_overshoot_tokens_total))
+        self.decode_early_exits.labels(m).inc(max(
+            0, s.decode_early_exit_rounds_total
+            - prev.decode_early_exit_rounds_total))
         self.kv_export_blocks.labels(m).inc(max(
             0, s.kv_export_blocks_total - prev.kv_export_blocks_total))
         self.kv_restore_blocks.labels(m).inc(max(
@@ -296,6 +331,13 @@ class EngineMetrics:
             self.kv_export_s.labels(m).observe(max(0.0, s))
         for s in restore_seconds:
             self.kv_restore_s.labels(m).observe(max(0.0, s))
+
+    def observe_decode_k(self, ks: list[int]) -> None:
+        """Feed drained chosen-K observations (LLMEngine.
+        drain_decode_k_observations) into the tpu:decode_k histogram."""
+        m = self.model_name
+        for k in ks:
+            self.decode_k.labels(m).observe(k)
 
     def observe_request(
         self,

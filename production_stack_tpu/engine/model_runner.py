@@ -1151,7 +1151,8 @@ class ModelRunner:
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
     def _decode_pack_layout(self, b: int, c_pad: int, chained: bool,
-                            guided: bool = False):
+                            guided: bool = False,
+                            stop_cap: int | None = None):
         """Static layout of the ONE int32 host->device buffer a
         multi-step decode dispatch ships.
 
@@ -1160,7 +1161,13 @@ class ModelRunner:
         (tokens, positions, context lens, sampling params, page tables)
         into one transfer makes the h2d cost one RPC instead of eight.
         f32/u32 fields travel bitcast as i32 and are bitcast back on
-        device. Returns ({name: (offset, shape)}, total_len)."""
+        device. Returns ({name: (offset, shape)}, total_len).
+
+        `stop_cap` (device-side stop masks): None = the fixed-trip
+        program without stop fields (--no-device-stop control); an int
+        adds the per-lane EOS id, min_tokens gate, remaining-budget
+        countdown, and — when > 0 — a (b, stop_cap) padded
+        stop-token-id matrix."""
         n_pages = c_pad // self.block_size
         fields: list[tuple[str, tuple[int, ...]]] = []
         if not chained:
@@ -1179,6 +1186,14 @@ class ModelRunner:
             # per-lane DFA state + machine row (the big tables travel
             # separately, device-cached across dispatches)
             fields += [("g_state", (b,)), ("g_lane", (b,))]
+        if stop_cap is not None:
+            fields += [
+                ("stop_eos", (b,)),
+                ("stop_min", (b,)),
+                ("stop_budget", (b,)),
+            ]
+            if stop_cap > 0:
+                fields.append(("stop_ids", (b, stop_cap)))
         if self.attention_impl != "pallas":
             fields.append(("gather_tables", (b, c_pad)))
         return self._layout_of(fields)
@@ -1188,7 +1203,8 @@ class ModelRunner:
                             want_logprobs: bool = False,
                             chained: bool = False,
                             guided_shapes: tuple | None = None,
-                            bias_cap: int = 0):
+                            bias_cap: int = 0,
+                            stop_cap: int | None = None):
         """K fused decode+sample iterations per dispatch.
 
         The serving loop's per-step cost is dominated by the
@@ -1204,13 +1220,32 @@ class ModelRunner:
         Host-side inputs arrive as ONE packed i32 buffer
         (`_decode_pack_layout`); `chained=True` builds the variant whose
         tokens come from the previous round's on-device output instead.
-        """
+
+        `stop_cap` is not None => device-side stop masks (elastic
+        fused decode): a per-lane done mask rides the loop carry. A
+        lane is done once its per-round append count reaches its
+        remaining budget (max_tokens/max_model_len countdown) or it
+        samples its EOS / one of its stop_token_ids at or past its
+        min_tokens gate. A done lane FREEZES — its sampled slot is
+        pinned to STOP_PAD_TOKEN, its KV-slot write is redirected to
+        the trash slot, its position/context stop advancing, and its
+        penalty-count/guided-DFA state stops updating — so overshoot
+        slots cost no cache or state corruption and the loop runs as a
+        lax.while_loop that exits the whole round as soon as EVERY
+        lane is done. The program then additionally returns a (b,)
+        int32 per-lane VALID count (tokens sampled before freezing);
+        tokens at positions >= valid[lane] are pad, never host-applied.
+        Tokens below the valid count are bit-identical to the
+        fixed-trip program — masking engages strictly after the stop
+        token is sampled."""
         mc = self.model_config
         scale = self._scale
         bs = self.block_size
         from production_stack_tpu.engine.sampler import (
+            STOP_PAD_TOKEN,
             apply_penalties,
             sample_tokens,
+            stop_hit,
             token_logprobs,
         )
 
@@ -1244,8 +1279,10 @@ class ModelRunner:
                 )
 
         use_pages = self.attention_impl == "pallas"
+        use_stop = stop_cap is not None
         layout, _total = self._decode_pack_layout(
-            b, c_pad, chained, guided=guided_shapes is not None
+            b, c_pad, chained, guided=guided_shapes is not None,
+            stop_cap=stop_cap,
         )
 
         def _seg(packed, name, _lo=layout):
@@ -1301,8 +1338,23 @@ class ModelRunner:
                 lane_tc = None
                 g_state0 = jnp.zeros((b,), jnp.int32)  # unused carry
 
-            def one(carry, i):
-                kc, vc, tokens, positions, ctx, counts, g_state = carry
+            if use_stop:
+                eos_ids = _seg(packed, "stop_eos")
+                min_need = _seg(packed, "stop_min")
+                budget = _seg(packed, "stop_budget")
+                s_ids = _seg(packed, "stop_ids") if stop_cap else None
+                # padded lanes ship budget 0: done from iteration 0, so
+                # an all-real-lanes-finished round early-exits even
+                # when the static lane count exceeds the live batch
+                done0 = budget <= 0
+            else:
+                s_ids = None
+                done0 = jnp.zeros((b,), bool)  # unused carry
+            valid0 = jnp.zeros((b,), jnp.int32)
+
+            def one(kc, vc, carry, i):
+                (tokens, positions, ctx, counts, g_state, done,
+                 valid) = carry
                 # slot for each lane's current position from its block
                 # table (idle lanes carry the zero table -> trash block 0;
                 # K <= block_size keeps them inside it)
@@ -1310,6 +1362,10 @@ class ModelRunner:
                     page_tables[lane, positions // bs] * bs
                     + positions % bs
                 )
+                if use_stop:
+                    # frozen lanes write the trash slot: a done lane's
+                    # overshoot KV must never land past its real end
+                    write_slots = jnp.where(done, 0, write_slots)
                 attn_tables = page_tables if use_pages else gather_tables
                 attn_fn = functools.partial(
                     attn, page_tables=attn_tables, context_lens=ctx,
@@ -1346,32 +1402,116 @@ class ModelRunner:
                 keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
                 nxt = sample_tokens(logits, temps, top_ps, top_ks, keys,
                                     min_p=min_ps)
+                live = jnp.logical_not(done)
+                if use_stop:
+                    # pin frozen lanes' sampled slots to the pad token
+                    # (the host reads only valid[lane] tokens anyway)
+                    nxt = jnp.where(done, STOP_PAD_TOKEN, nxt)
                 if guided_shapes is not None:
                     cls = jnp.take_along_axis(
                         lane_tc, nxt[:, None], axis=1
                     )[:, 0]
-                    g_state = g_class_trans[g_state, cls]
+                    new_g = g_class_trans[g_state, cls]
+                    # a frozen lane's DFA state stops stepping (the pad
+                    # token is not part of its stream)
+                    g_state = (
+                        jnp.where(done, g_state, new_g)
+                        if use_stop else new_g
+                    )
                 if use_penalties:
-                    counts = counts.at[lane, nxt].add(1.0)
+                    # frozen lanes stop updating penalty counts: pinned
+                    # pad tokens are not generated output
+                    counts = counts.at[lane, nxt].add(
+                        live.astype(jnp.float32) if use_stop else 1.0
+                    )
+                valid = valid + live.astype(jnp.int32)
+                if use_stop:
+                    # the sampled token is valid (the stop token itself
+                    # is appended, same as the host path); the lane
+                    # freezes FROM THE NEXT iteration. Budget first,
+                    # then the min_tokens-gated EOS/stop-id check —
+                    # check_stop's exact ordering.
+                    hit = stop_hit(nxt, eos_ids, s_ids)
+                    done = done | (valid >= budget) | (
+                        live & hit & (valid >= min_need)
+                    )
+                    adv = jnp.where(done, 0, 1)
+                else:
+                    adv = 1
                 if want_logprobs:
                     # on-device logprobs ride the same single fetch —
                     # (k, b) chosen + (k, b, CAP) top alternatives
                     ys = (nxt, *token_logprobs(logits, nxt))
                 else:
                     ys = nxt
-                return (
-                    (kc, vc, nxt, positions + 1, ctx + 1, counts,
-                     g_state),
-                    ys,
+                carry = (nxt, positions + adv, ctx + adv, counts,
+                         g_state, done, valid)
+                return kc, vc, carry, ys
+
+            carry0 = (tokens, positions, context_lens, counts0,
+                      g_state0, done0, valid0)
+            if not use_stop:
+
+                def scan_one(sc, i):
+                    kc, vc, c = sc
+                    kc, vc, c, ys = one(kc, vc, c, i)
+                    return (kc, vc, c), ys
+
+                (kc, vc, _), ys = jax.lax.scan(
+                    scan_one, (kc, vc, carry0), jnp.arange(k_steps)
+                )
+                return ys, kc, vc  # ys: (k, b) toks [+ logprob arrays]
+
+            # device-stop variant: while_loop over preallocated output
+            # rows so the round EXITS as soon as every lane is done —
+            # an all-finished tail iteration would otherwise still pay
+            # the full forward. Unwritten rows stay at the pad token;
+            # the host consumes only valid[lane] tokens per lane.
+            from production_stack_tpu.engine.sampler import LOGPROB_CAP
+
+            toks_buf = jnp.full((k_steps, b), STOP_PAD_TOKEN, jnp.int32)
+            lp_bufs = ()
+            if want_logprobs:
+                lp_bufs = (
+                    jnp.zeros((k_steps, b), jnp.float32),
+                    jnp.zeros((k_steps, b, LOGPROB_CAP), jnp.float32),
+                    jnp.zeros((k_steps, b, LOGPROB_CAP), jnp.int32),
                 )
 
-            (kc, vc, *_), ys = jax.lax.scan(
-                one,
-                (kc, vc, tokens, positions, context_lens, counts0,
-                 g_state0),
-                jnp.arange(k_steps),
+            def cond(state):
+                i, c = state[0], state[3]
+                done = c[5]
+                return jnp.logical_and(
+                    i < k_steps, jnp.logical_not(jnp.all(done))
+                )
+
+            def body(state):
+                i, kc, vc, c, tb = state[:5]
+                lps = list(state[5:])
+                kc, vc, c, ys = one(kc, vc, c, i)
+                if want_logprobs:
+                    nxt, ch, tv, ti = ys
+                    lps = [
+                        lps[0].at[i].set(ch),
+                        lps[1].at[i].set(tv),
+                        lps[2].at[i].set(ti),
+                    ]
+                else:
+                    nxt = ys
+                tb = tb.at[i].set(nxt)
+                return (i + 1, kc, vc, c, tb, *lps)
+
+            state = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), kc, vc, carry0, toks_buf, *lp_bufs),
             )
-            return ys, kc, vc  # ys: (k, b) toks [+ logprob arrays]
+            _, kc, vc, c, tb = state[:5]
+            valid = c[6]
+            if want_logprobs:
+                ys = (tb, *state[5:8], valid)
+            else:
+                ys = (tb, valid)
+            return ys, kc, vc  # ys: (toks, [lp arrays,] valid)
 
         return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
 
@@ -1734,6 +1874,7 @@ class ModelRunner:
     def precompile_decode(
         self, context_lens: list[int], steps: int,
         chained: bool = False,
+        stop: bool = False,
     ) -> int:
         """Compile the fused-K decode program for every ctx bucket the
         given context lengths reach, against trash blocks at the top of
@@ -1748,7 +1889,13 @@ class ModelRunner:
         `chained=True` additionally compiles the async-pipeline variant
         (device-array token input — a DISTINCT program cache key): the
         chained dispatch crosses the same ctx buckets mid-pipeline, so
-        async serving needs both programs warm."""
+        async serving needs both programs warm.
+
+        `stop=True` compiles the device-stop (elastic) program variant
+        instead of the fixed-trip scan, at stop-id cap 0 — the cap only
+        grows when a request ships stop_token_ids, which is
+        request-dependent and out of precompile scope (same caveat as
+        the penalties/logprobs variants)."""
         b = self.config.max_num_seqs
         bs = self.block_size
         nb = self.num_blocks
@@ -1779,16 +1926,28 @@ class ModelRunner:
             table = list(range(nb - npages, nb))
             ctx = c_pad - max(0, steps - 1)
             if steps > 1:
+                stop_kw = {}
+                if stop:
+                    # budget == steps: nothing freezes, the while_loop
+                    # runs its full trip — the PROGRAM is identical to
+                    # what a live batch with real budgets selects
+                    stop_kw = {"stop": (
+                        np.full((b,), -1, np.int32),
+                        np.zeros((b,), np.int32),
+                        np.full((b,), steps, np.int32),
+                        None,
+                    )}
                 out = self.decode_multi(
                     [1] * b, [ctx - 1] * b, [table] * b, [ctx] * b,
-                    steps, temps, top_ps, top_ks, keys,
+                    steps, temps, top_ps, top_ks, keys, **stop_kw,
                 )
                 jax.block_until_ready(out)
+                toks = out[0] if isinstance(out, tuple) else out
                 n += 1
                 if chained:
                     out = self.decode_multi(
-                        out[-1], [ctx - 1] * b, [table] * b, [ctx] * b,
-                        steps, temps, top_ps, top_ks, keys,
+                        toks[-1], [ctx - 1] * b, [table] * b, [ctx] * b,
+                        steps, temps, top_ps, top_ks, keys, **stop_kw,
                     )
                     jax.block_until_ready(out)
                     n += 1
@@ -1939,15 +2098,23 @@ class ModelRunner:
         temps, top_ps, top_ks, keys,
         min_ps=None,
         guided_lanes: tuple | None = None,
+        stop: tuple | None = None,
     ) -> np.ndarray:
         """Build the ONE packed int32 host buffer a fused decode
         dispatch ships (layout: _decode_pack_layout). Shared by the
         dispatch path (decode_multi) and the speculative prefetch path
-        (stage_decode_multi)."""
+        (stage_decode_multi). `stop` = (eos, min_rem, budget,
+        stop_ids|None) per-lane device-stop arrays (see decode_multi);
+        padded lanes ship eos -1 and budget 0 (frozen from iteration
+        0, so all-real-lanes-done rounds early-exit)."""
         b = self.config.max_num_seqs
         b_actual = len(positions)
+        stop_cap = None
+        if stop is not None:
+            stop_cap = 0 if stop[3] is None else int(stop[3].shape[1])
         layout, total = self._decode_pack_layout(
-            b, c_pad, chained, guided=guided_lanes is not None
+            b, c_pad, chained, guided=guided_lanes is not None,
+            stop_cap=stop_cap,
         )
         packed = np.zeros((total,), np.int32)
 
@@ -2009,12 +2176,27 @@ class ModelRunner:
             g_lane = np.zeros((b,), np.int32)
             g_lane[:b_actual] = lane_map[:b_actual]
             put("g_lane", g_lane)
+        if stop is not None:
+            eos, min_rem, budget, stop_ids = stop
+            eos_full = np.full((b,), -1, np.int32)
+            eos_full[:b_actual] = eos
+            put("stop_eos", eos_full)
+            min_full = np.zeros((b,), np.int32)
+            min_full[:b_actual] = min_rem
+            put("stop_min", min_full)
+            bud_full = np.zeros((b,), np.int32)  # padded lanes: done
+            bud_full[:b_actual] = budget
+            put("stop_budget", bud_full)
+            if stop_cap:
+                sid_full = np.full((b, stop_cap), -1, np.int32)
+                sid_full[:b_actual] = stop_ids
+                put("stop_ids", sid_full)
         return packed
 
     # stackcheck: hot-path
     def stage_decode_multi(
         self, positions, block_tables, context_lens, steps,
-        temps, top_ps, top_ks, keys, min_ps=None,
+        temps, top_ps, top_ks, keys, min_ps=None, stop=None,
     ):
         """Speculative h2d prefetch for the NEXT chained fused round:
         build the packed buffer and START its async host->device
@@ -2022,14 +2204,15 @@ class ModelRunner:
         execution and token fetch instead of sitting serially between
         them (measured ~116 ms per h2d vs ~300 ms total round time
         through the tunneled chip). The engine stages with PREDICTED
-        state (positions/ctx/keys advanced by K on the same lanes) and
-        validates the prediction before dispatching on it; a stale
+        state (positions/ctx/keys — and, under device stops, the
+        min_rem/budget countdowns — advanced by K on the same lanes)
+        and validates the prediction before dispatching on it; a stale
         stage (ctx-bucket mismatch) is ignored by decode_multi.
         Returns (c_pad, device_array) for decode_multi(staged=...)."""
         c_pad = self._ctx_bucket(max(context_lens) + max(0, steps - 1))
         packed = self._fill_decode_pack(
             c_pad, True, None, positions, block_tables, context_lens,
-            temps, top_ps, top_ks, keys, min_ps=min_ps,
+            temps, top_ps, top_ks, keys, min_ps=min_ps, stop=stop,
         )
         return (c_pad, jax.device_put(packed))
 
@@ -2055,11 +2238,23 @@ class ModelRunner:
                                           #  (b_actual, cap) f32 vals)
         staged: tuple | None = None,  # pre-uploaded (c_pad, packed_dev)
                                       # from stage_decode_multi
+        stop: tuple | None = None,  # device-side stop masks: (eos
+                                    # (b_actual,) i32 — -1 = ignore,
+                                    # min_rem (b_actual,) i32,
+                                    # budget (b_actual,) i32,
+                                    # stop_ids (b_actual, cap) i32
+                                    # padded -1, or None)
     ):
         """`steps` fused decode+sample iterations (one dispatch, one
         fetch); returns (steps, b) int32 sampled tokens on device — or,
         with `want_logprobs`, a tuple (tokens, chosen_lp (k, b) f32,
-        top_vals (k, b, CAP) f32, top_ids (k, b, CAP) i32). The
+        top_vals (k, b, CAP) f32, top_ids (k, b, CAP) i32). With
+        `stop` (device-side stop masks, see _build_decode_multi) the
+        return is ALWAYS a tuple whose last element is the (b,) int32
+        per-lane valid count — (tokens, valid) or (tokens, chosen_lp,
+        top_vals, top_ids, valid); tokens at rows >= valid[lane] are
+        pinned pad, the round early-exits once every lane is done, and
+        the caller applies exactly valid[lane] tokens per lane. The
         caller must have grown each block table to cover
         context_len + steps - 1 positions (scheduler lookahead).
 
@@ -2100,15 +2295,26 @@ class ModelRunner:
         guided_lanes = None
         if guided is not None:
             guided_lanes = (guided[1], guided[2])
+        stop_cap = None
+        if stop is not None:
+            stop_cap = 0 if stop[3] is None else int(stop[3].shape[1])
         packed_dev = None
         if (staged is not None and chained and guided is None
                 and staged[0] == c_pad):
-            packed_dev = staged[1]
+            # the staged buffer must carry the SAME field layout this
+            # dispatch expects — the stop fields vary with the per-batch
+            # stop-id cap, so a total-length mismatch is a stale stage
+            # (rebuild + upload serially), never a dispatch error
+            _, want_total = self._decode_pack_layout(
+                b, c_pad, chained, guided=False, stop_cap=stop_cap,
+            )
+            if int(staged[1].shape[0]) == want_total:
+                packed_dev = staged[1]
         if packed_dev is None:
             packed_dev = jnp.asarray(self._fill_decode_pack(
                 c_pad, chained, token_ids, positions, block_tables,
                 context_lens, temps, top_ps, top_ks, keys,
-                min_ps=min_ps, guided_lanes=guided_lanes,
+                min_ps=min_ps, guided_lanes=guided_lanes, stop=stop,
             ))
 
         pen_kw = {}
@@ -2175,18 +2381,20 @@ class ModelRunner:
                 "lb_vals": jnp.asarray(vals_full),
             }
         cache_key = (b, c_pad, steps, penalties is not None,
-                     want_logprobs, chained, guided_shapes, bias_cap)
+                     want_logprobs, chained, guided_shapes, bias_cap,
+                     stop_cap)
         if cache_key not in self._decode_multi_fns:
             logger.info(
                 "compiling multi-step decode b=%d ctx=%d k=%d pen=%s "
-                "lp=%s chained=%s guided=%s bias=%d",
+                "lp=%s chained=%s guided=%s bias=%d stop=%s",
                 b, c_pad, steps, penalties is not None, want_logprobs,
-                chained, guided_shapes, bias_cap,
+                chained, guided_shapes, bias_cap, stop_cap,
             )
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
                 want_logprobs=want_logprobs, chained=chained,
                 guided_shapes=guided_shapes, bias_cap=bias_cap,
+                stop_cap=stop_cap,
             )
         fn = self._decode_multi_fns[cache_key]
         lora_kw = {}

@@ -279,15 +279,21 @@ class BroadcastingRunner:
         })
         return self._runner.precompile_prefill(singles, groups)
 
-    def precompile_decode(self, context_lens, steps, chained=False):
+    def precompile_decode(self, context_lens, steps, chained=False,
+                          stop=False):
+        # stop is always False under multihost (_device_stop is gated
+        # off — the broadcast wire ships host token lists, not stop
+        # matrices), but precompile_serving passes the kwarg
+        # unconditionally, so the proxy must accept and forward it
         self._bc.publish({
             "kind": "precompile_decode",
             "context_lens": [int(c) for c in context_lens],
             "steps": int(steps),
             "chained": bool(chained),
+            "stop": bool(stop),
         })
         return self._runner.precompile_decode(
-            context_lens, steps, chained=chained
+            context_lens, steps, chained=chained, stop=stop,
         )
 
     def shutdown_followers(self) -> None:

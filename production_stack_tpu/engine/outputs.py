@@ -57,6 +57,13 @@ class EngineStatsSnapshot:
     prefill_staged_hits_total: int = 0
     prefill_staged_misses_total: int = 0
     prefill_chained_chunks_total: int = 0
+    # elastic fused decode: rounds dispatched, sampled-then-discarded
+    # overshoot tokens (~0 with device stops, except host-resolved stop
+    # strings), and whole-round device early exits — tpu:decode_* in
+    # /metrics and the bench `elastic_decode` detail slot
+    decode_rounds_total: int = 0
+    decode_overshoot_tokens_total: int = 0
+    decode_early_exit_rounds_total: int = 0
     # zero-stall KV tiering attribution: deferred-export batches (wall
     # seconds measured ON THE OFFLOAD WORKER — overlapped activity, not
     # step-loop stalls) and staged restores (enqueue -> landed), plus

@@ -89,6 +89,29 @@ def apply_penalties(
     return jnp.where(output_mask, penalized, logits)
 
 
+# device-side stop masks (elastic fused decode): the pad token a frozen
+# lane's sampled slot is pinned to. 0 is safe — the host consumes only
+# the per-lane valid counts, never the pinned slots.
+STOP_PAD_TOKEN = 0
+
+
+def stop_hit(
+    tokens: jax.Array,  # (b,) int32 just-sampled tokens
+    eos_ids: jax.Array,  # (b,) int32 per-lane EOS (-1 = ignore_eos/none)
+    stop_ids: jax.Array | None,  # (b, cap) int32 padded with -1, or None
+) -> jax.Array:
+    """Per-lane bool: the sampled token is that lane's EOS or one of
+    its stop_token_ids. Shared by the fused decode scan so the device
+    check can never drift from one copy of the semantics; the
+    min_tokens/max_tokens gates are applied by the caller (they depend
+    on the scan's per-lane append counters, not on the token). -1
+    sentinels never match (token ids are non-negative)."""
+    hit = tokens == eos_ids
+    if stop_ids is not None:
+        hit = hit | jnp.any(tokens[:, None] == stop_ids, axis=1)
+    return hit
+
+
 LOGPROB_CAP = 20  # static top-N bucket; hosts slice to the requested N
 
 

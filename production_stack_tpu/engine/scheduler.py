@@ -55,6 +55,11 @@ class PrefillWork:
 @dataclass
 class DecodeWork:
     seqs: list[Sequence]
+    # fused decode iterations this round (elastic fused decode): the
+    # scheduler sizes each round from pow2 buckets up to decode_k_cap —
+    # clamped low under admission pressure, bounded by the batch's
+    # remaining-token budget; the cap itself with adaptive K off
+    k: int = 1
 
 
 @dataclass
@@ -102,8 +107,15 @@ class SchedulerConfig:
     decode_interleave: int = 1
     # extra decode positions to reserve per scheduled sequence so a
     # multi-step dispatch (num_scheduler_steps - 1 lookahead) never runs
-    # off the end of its block table mid-scan
+    # off the end of its block table mid-scan (always the CAP, so a
+    # round sized below the cap is trivially covered)
     decode_lookahead: int = 0
+    # fused decode iterations per dispatch, ceiling (engine
+    # num_scheduler_steps); pick_decode_k sizes each round up to it
+    decode_k_cap: int = 1
+    # admission-aware adaptive K (EngineConfig.adaptive_decode_k):
+    # False = every round dispatches the full cap
+    adaptive_decode_k: bool = False
     # pipelined prefill: a chunk whose packed h2d buffer is already
     # uploaded (engine sets `staged_prefill_ready`) is admitted as
     # zero cost against the decode interleave — cold multi-chunk
@@ -112,6 +124,47 @@ class SchedulerConfig:
     # dispatches may bypass starvation before decode gets its turn
     # (bounds worst-case ITL for very long prompts).
     max_staged_prefill_run: int = 8
+
+
+def decode_k_buckets(cap: int, adaptive: bool) -> list[int]:
+    """The fused-decode K program variants a serving config can
+    dispatch: just the cap with adaptive K off, plus every pow2 below
+    it with adaptive K on (pick_decode_k rounds remaining budgets UP
+    to the next pow2, so these are exactly the reachable Ks). The ONE
+    copy shared by LLMEngine.precompile_serving and bench.py's warmup
+    so the warmed variant set can never drift from the scheduler's
+    rounding."""
+    cap = max(1, cap)
+    ks = {cap}
+    if adaptive and cap > 1:
+        p = 1
+        while p < cap:
+            ks.add(p)
+            p *= 2
+    return sorted(ks)
+
+
+def decode_precompile_variants(
+    cap: int, adaptive: bool, *,
+    overlap: bool, async_chained: bool, device_stop: bool,
+) -> list[tuple[int, bool, bool]]:
+    """(k, chained, stop) decode program variants a serving config
+    dispatches — the ONE copy of the variant-selection policy shared by
+    LLMEngine.precompile_serving and bench.py's warmup, so neither can
+    silently warm a different set than the runtime selects (a missed
+    variant = a mid-request XLA compile). `overlap` = async decode OR
+    h2d prefetch (both dispatch the chained program); `async_chained`
+    rounds never carry stop masks (the chain commits round N+1 before
+    round N's valid counts exist), so async engines warm fixed-trip
+    programs instead."""
+    return [
+        (
+            k,
+            overlap and k > 1,
+            device_stop and not async_chained and k > 1,
+        )
+        for k in decode_k_buckets(cap, adaptive)
+    ]
 
 
 class Scheduler:
@@ -398,8 +451,57 @@ class Scheduler:
                 decode_seqs.append(seq)
 
         if decode_seqs:
-            out.decode = DecodeWork(seqs=decode_seqs)
+            out.decode = DecodeWork(
+                seqs=decode_seqs, k=self.pick_decode_k(decode_seqs)
+            )
         return out
+
+    # K clamp while admission work exists: a fused round never keeps a
+    # cold prompt waiting for more than ~this many steps (the K=16
+    # TTFT-blowup failure mode was 16 uninterruptible steps per round
+    # while prefill chunks queued — PERF.md round 5 window 2)
+    ADMISSION_K_CLAMP = 2
+
+    # stackcheck: hot-path — pure host arithmetic on the scheduling
+    # path; one pass over the decode batch, no allocation beyond ints
+    def pick_decode_k(
+        self, seqs: list[Sequence], advance: int = 0
+    ) -> int:
+        """Size this round's fused decode K (elastic fused decode):
+        pow2 buckets up to decode_k_cap, clamped to ADMISSION_K_CLAMP
+        while any prefill work is pending (waiting queue or a running
+        mid-prefill sequence — admission must never be starved by a
+        long uninterruptible round), and bounded by the batch's MAX
+        remaining-token budget (when every lane has <=4 tokens left, a
+        K=16 dispatch wastes 3/4 of its slots — the K=32 overshoot
+        mode; under device stops the shorter lanes freeze mid-round
+        anyway, so the max is the right bound). `advance` predicts the
+        pick `advance` tokens ahead (h2d prefetch stages the NEXT
+        round before this one's tokens are applied). Returns the cap
+        unchanged with adaptive K off."""
+        cap = max(1, self.config.decode_k_cap)
+        if not self.config.adaptive_decode_k or cap == 1 or not seqs:
+            return cap
+        k = cap
+        if self.waiting or any(
+            not s.prefill_done for s in self.running
+        ):
+            k = min(k, self.ADMISSION_K_CLAMP)
+        rem = 0
+        mml = self.config.max_model_len
+        for s in seqs:
+            sp = s.sampling_params
+            r = min(
+                sp.max_tokens - len(s.generated_token_ids),
+                mml - s.num_tokens,
+            ) - advance
+            rem = max(rem, r)
+        rem = max(1, rem)
+        if rem < k:
+            # round UP to the pow2 bucket so the variant space stays
+            # O(log cap) (precompiled by --precompile-serving)
+            k = 1 << (rem - 1).bit_length()
+        return max(1, min(k, cap))
 
     def _note_admitted(self, seq: Sequence) -> None:
         """Queue-wait/stall bookkeeping + timeline event on each

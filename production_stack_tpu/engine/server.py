@@ -165,6 +165,9 @@ class EngineServer:
                 self.metrics.observe_kv(
                     *self.engine.drain_kv_observations()
                 )
+                self.metrics.observe_decode_k(
+                    self.engine.drain_decode_k_observations()
+                )
             except Exception:  # pragma: no cover
                 logger.exception("stats update failed")
             await asyncio.sleep(STATS_UPDATE_INTERVAL_S)
@@ -1196,6 +1199,9 @@ class EngineServer:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self.metrics.update_from_snapshot(self.engine.stats())
         self.metrics.observe_kv(*self.engine.drain_kv_observations())
+        self.metrics.observe_decode_k(
+            self.engine.drain_decode_k_observations()
+        )
         return web.Response(
             body=generate_latest(self.registry),
             content_type="text/plain",

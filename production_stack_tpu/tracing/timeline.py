@@ -156,9 +156,12 @@ class TimelineRecorder:
         if tl is not None:
             tl.append(name, attrs)
 
-    def decode_round(self, request_id: str, k: int = 1) -> None:
+    def decode_round(self, request_id: str, k: int = 1,
+                     attrs: dict | None = None) -> None:
         """One fused decode round applied for this request; records an
-        event every DECODE_EVENT_EVERY rounds."""
+        event every DECODE_EVENT_EVERY rounds. `attrs` (e.g. the
+        elastic-decode k_chosen/lanes_done fields) merge into the same
+        append-only event."""
         if not self.enabled:
             return
         tl = self._active.get(request_id)
@@ -168,7 +171,7 @@ class TimelineRecorder:
         if tl.decode_rounds % DECODE_EVENT_EVERY == 0:
             tl.append(
                 "decode_round",
-                {"round": tl.decode_rounds, "k": k},
+                {"round": tl.decode_rounds, "k": k, **(attrs or {})},
             )
 
     def finish(self, request_id: str, reason: str | None,

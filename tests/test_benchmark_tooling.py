@@ -201,14 +201,7 @@ def test_sweep_label_modifiers_parse():
     """bench.py sweep labels: @-suffixes override per-config workload
     env so one chip session can walk the reference's QPS/user serving
     curve (run.sh sweeps QPS)."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod", os.path.join(os.path.dirname(__file__), "..",
-                                  "bench.py")
-    )
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench()
 
     cfgs = bench._parse_sweep_labels(
         "k8-sync-packed@qps4@u32@r1,k12-async-nopack@chunk1024,"
@@ -233,3 +226,198 @@ def test_sweep_label_modifiers_parse():
         bench._parse_sweep_labels("k8-sync-packed@bogus7")
     with pytest.raises(ValueError, match="bad sweep config"):
         bench._parse_sweep_labels("k8-asynch-packed")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod_wd", os.path.join(os.path.dirname(__file__), "..",
+                                     "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_elastic_sweep_modifiers_parse():
+    """@elastic / @noelastic drive the elastic-fused-decode A/B
+    (device stops + adaptive K vs the fixed-trip fixed-K control)."""
+    bench = _load_bench()
+    (on,) = bench._parse_sweep_labels("k16-sync-packed@elastic")
+    assert on[4] == {"PST_BENCH_ELASTIC": "1"}
+    (off,) = bench._parse_sweep_labels("k16-sync-packed@noelastic")
+    assert off[4] == {"PST_BENCH_ELASTIC": "0"}
+
+
+def test_sweep_continues_past_watchdog_config(tmp_path, monkeypatch):
+    """Regression (the K=16 wedge, PERF.md round 5 window 2): a config
+    whose child hits the 1200 s run watchdog is recorded in the sweep
+    JSON as {"ok": false, "watchdog": true} and the sweep CONTINUES to
+    the remaining configs instead of aborting the run. The child's
+    watchdog fires on HOST time — it cannot prove the chip is alive —
+    so the sweep probes chip health once and continues only because
+    the probe answers."""
+    bench = _load_bench()
+    rows = {
+        "k16-sync-packed": {
+            "metric": "bench-aborted: watchdog (run_config"
+                      "[k16-sync-packed])",
+            "value": 0.0, "unit": "gen_tokens/s/chip",
+            "vs_baseline": 0.0, "watchdog": True,
+            "error": "k16 exceeded 1200s — chip wedged?",
+        },
+        "k8-sync-packed": {
+            "metric": "stub measurement", "value": 42.0,
+            "unit": "gen_tokens/s/chip", "vs_baseline": 0.1,
+        },
+    }
+    calls = []
+
+    def fake_run_one(label, env, timeout):
+        calls.append(label)
+        # the stub stands in for the per-config subprocess: the wedged
+        # config's child emitted its watchdog row and exited
+        return dict(rows[label]), False
+
+    probes = []
+
+    class FakeProbe:
+        def __init__(self, *a, **kw):
+            probes.append(a)
+
+        def wait(self, timeout=None):
+            return 0  # chip answers: the sweep should continue
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(bench, "_run_one_config", fake_run_one)
+    monkeypatch.setattr(subprocess, "Popen", FakeProbe)
+    out = tmp_path / "sweep.json"
+    monkeypatch.setenv("PST_BENCH_SWEEP_CONFIGS",
+                       "k16-sync-packed,k8-sync-packed")
+    monkeypatch.setenv("PST_BENCH_SWEEP_OUT", str(out))
+    bench._run_sweep()
+
+    data = json.loads(out.read_text())
+    assert [r.get("ok") for r in data["results"]] == [False, True]
+    assert data["results"][0]["watchdog"] is True
+    # the sweep probed once and did NOT abort after the watchdog config
+    assert len(probes) == 1
+    assert calls == ["k16-sync-packed", "k8-sync-packed"]
+
+
+def test_sweep_stops_when_chip_dead_after_watchdog(tmp_path,
+                                                   monkeypatch):
+    """A child-watchdog row with a DEAD chip (tunnel drop mid-window:
+    the in-process watchdog still fires — it runs on host time) must
+    stop the sweep after one failed probe instead of burning every
+    remaining config's full timeout against a chip that stopped
+    answering."""
+    bench = _load_bench()
+    calls = []
+
+    def fake_run_one(label, env, timeout):
+        calls.append(label)
+        return ({
+            "metric": f"bench-aborted: watchdog (run_config[{label}])",
+            "value": 0.0, "unit": "gen_tokens/s/chip",
+            "vs_baseline": 0.0, "watchdog": True,
+            "error": "exceeded 1200s — chip wedged?",
+        }, False)
+
+    class DeadProbe:
+        def __init__(self, *a, **kw):
+            pass
+
+        def wait(self, timeout=None):
+            return 1  # chip does not answer
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(bench, "_run_one_config", fake_run_one)
+    monkeypatch.setattr(subprocess, "Popen", DeadProbe)
+    out = tmp_path / "sweep.json"
+    monkeypatch.setenv("PST_BENCH_SWEEP_CONFIGS",
+                       "k16-sync-packed,k8-sync-packed")
+    monkeypatch.setenv("PST_BENCH_SWEEP_OUT", str(out))
+    bench._run_sweep()
+
+    data = json.loads(out.read_text())
+    # only the first config ran: the dead-chip probe stopped the sweep
+    assert calls == ["k16-sync-packed"]
+    assert data["results"][0]["ok"] is False
+
+
+def test_parent_timeout_row_still_probes_chip(tmp_path, monkeypatch):
+    """A parent-timeout row (child emitted NOTHING — possibly a dead
+    tunnel, the 01:01 UTC failure mode) also runs the chip-health
+    probe and, when the probe answers, continues to the remaining
+    configs."""
+    bench = _load_bench()
+    rows = {
+        "k16-sync-packed": {
+            "metric": "sweep-config-timeout: k16-sync-packed",
+            "value": 0.0, "unit": "gen_tokens/s/chip",
+            "vs_baseline": 0.0, "watchdog": True,
+            "parent_timeout": True,
+            "error": "no result after 1500s",
+        },
+        "k8-sync-packed": {
+            "metric": "stub measurement", "value": 42.0,
+            "unit": "gen_tokens/s/chip", "vs_baseline": 0.1,
+        },
+    }
+    calls = []
+
+    def fake_run_one(label, env, timeout):
+        calls.append(label)
+        return dict(rows[label]), False
+
+    probes = []
+
+    class FakeProbe:
+        def __init__(self, *a, **kw):
+            probes.append(a)
+
+        def wait(self, timeout=None):
+            return 0  # chip answers: the sweep should continue
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(bench, "_run_one_config", fake_run_one)
+    monkeypatch.setattr(subprocess, "Popen", FakeProbe)
+    out = tmp_path / "sweep.json"
+    monkeypatch.setenv("PST_BENCH_SWEEP_CONFIGS",
+                       "k16-sync-packed,k8-sync-packed")
+    monkeypatch.setenv("PST_BENCH_SWEEP_OUT", str(out))
+    bench._run_sweep()
+
+    data = json.loads(out.read_text())
+    assert [r.get("ok") for r in data["results"]] == [False, True]
+    # the probe RAN (unlike the child-watchdog case) and, alive, the
+    # sweep continued to the next config
+    assert len(probes) == 1
+    assert calls == ["k16-sync-packed", "k8-sync-packed"]
+
+
+def test_child_watchdog_row_carries_marker(capsys):
+    """The in-child run watchdog emits the explicit watchdog marker the
+    sweep parent keys on (and exits via os._exit, stubbed here)."""
+    bench = _load_bench()
+    import os as _os
+
+    exited = {}
+    orig_exit = _os._exit
+    _os._exit = lambda code: exited.setdefault("code", code)
+    try:
+        t = bench._arm_watchdog(3600.0, "run_config[stub]")
+        t.cancel()
+        # fire the timer body directly instead of waiting an hour
+        t.function()
+    finally:
+        _os._exit = orig_exit
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["watchdog"] is True and row["value"] == 0.0
+    assert exited["code"] == 2

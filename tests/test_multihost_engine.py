@@ -365,9 +365,13 @@ def test_broadcast_carries_precompile():
         "kind": "precompile_prefill",
         "singles": [[16, 32]], "groups": [[2, 16, 32]],
     }
+    # stop is always False under multihost (_device_stop is gated off)
+    # but the proxy must accept + forward the kwarg: precompile_serving
+    # passes it unconditionally
     assert bc.published[1] == {
         "kind": "precompile_decode",
         "context_lens": [64, 128], "steps": 4, "chained": True,
+        "stop": False,
     }
     follower = _PrecompileRunner()
     _drain_follower(bc, follower)

@@ -554,6 +554,46 @@ def test_kv_tiering_hot_marks_present():
         assert not missing, f"{fname}: unmarked hot paths {missing}"
 
 
+def test_elastic_decode_stays_off_hot_paths():
+    """Elastic fused decode (device-side stop masks + adaptive K): the
+    stop-array build (LLMEngine._stop_arrays), the round sizing
+    (Scheduler.pick_decode_k), and the dispatch/staging path they feed
+    (decode_multi / stage_decode_multi) must keep device syncs and
+    event-loop stalls off the marked hot paths — zero unsuppressed
+    device-sync-hot + blocking-async over the touched engine files."""
+    report = analyze_paths(
+        [str(PACKAGE / "engine")],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    assert report.files_scanned >= 20
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_elastic_decode_hot_marks_present():
+    """The sweep above only bites while the elastic-decode functions
+    carry the hot-path mark — a dropped mark would pass silently."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    want = {
+        "llm_engine.py": {"_stop_arrays", "_step_impl"},
+        "scheduler.py": {"pick_decode_k"},
+        "model_runner.py": {"decode_multi", "stage_decode_multi"},
+    }
+    for fname, funcs in want.items():
+        path = PACKAGE / "engine" / fname
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {
+            f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)
+        }
+        missing = funcs - hot
+        assert not missing, f"{fname}: unmarked hot paths {missing}"
+
+
 def test_timeline_recording_stays_off_hot_paths():
     """Request-timeline recording (tracing/ + its engine call sites)
     must not introduce device syncs or event-loop stalls on the marked
