@@ -9,6 +9,7 @@ FastAPI+uvicorn — same endpoints, same Prometheus names, fewer moving parts.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 
 from aiohttp import web
 
@@ -41,6 +42,10 @@ from production_stack_tpu.router.services.rewriter import (
 from production_stack_tpu.router.stats.engine_stats import (
     get_engine_stats_scraper,
     initialize_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.health import (
+    get_engine_health_board,
+    initialize_engine_health_board,
 )
 from production_stack_tpu.router.stats.log_stats import (
     update_prometheus_and_render,
@@ -125,6 +130,9 @@ class RouterApp:
 
         initialize_engine_stats_scraper(args.engine_stats_interval)
         initialize_request_stats_monitor(args.request_stats_window)
+        initialize_engine_health_board(
+            ewma_alpha=getattr(args, "health_ewma_alpha", 0.1)
+        )
 
         tokenizer = None
         if args.tokenizer:
@@ -211,6 +219,7 @@ class RouterApp:
         r.add_get("/health", self.handle_health)
         r.add_get("/metrics", self.handle_metrics)
         r.add_get("/engines", self.handle_engines)
+        r.add_get("/debug/engines", self.handle_debug_engines)
         r.add_get("/debug/requests", self.handle_debug_requests)
         r.add_post("/sleep", self._sleep_wake_handler)
         r.add_post("/wake_up", self._sleep_wake_handler)
@@ -374,6 +383,32 @@ class RouterApp:
                 "engine_stats": dataclasses.asdict(es) if es else None,
                 "request_stats": dataclasses.asdict(rs) if rs else None,
             })
+        return web.json_response({"engines": out})
+
+    async def handle_debug_engines(
+        self, request: web.Request
+    ) -> web.Response:
+        """Per-engine health scoreboard: EWMA latency/TTFT, in-flight,
+        EWMA error rate, consecutive-failure streak, retry/error totals,
+        and last-scrape age — the router-observed signal surface behind
+        routing policies. `/engines` stays the discovery/stats view;
+        this is the data-plane view (phases + failures as the PROXY saw
+        them), joined per backend with the scraped engine stats."""
+        board = get_engine_health_board()
+        health = board.snapshot()
+        engine_stats = get_engine_stats_scraper().get_engine_stats()
+        known = {ep.url for ep in
+                 get_service_discovery().get_endpoint_info()}
+        out = []
+        for url in sorted(set(health) | known):
+            es = engine_stats.get(url)
+            row = health.get(url) or {"url": url}
+            row["discovered"] = url in known
+            row["healthy"] = board.is_healthy(url)
+            row["engine_stats"] = (
+                dataclasses.asdict(es) if es else None
+            )
+            out.append(row)
         return web.json_response({"engines": out})
 
     async def handle_debug_requests(

@@ -136,6 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
     stats = p.add_argument_group("stats")
     stats.add_argument("--engine-stats-interval", type=float, default=10.0)
     stats.add_argument("--request-stats-window", type=float, default=60.0)
+    stats.add_argument("--health-ewma-alpha", type=float, default=0.1,
+                       help="EWMA smoothing factor for the per-engine "
+                            "health scoreboard (/debug/engines): higher "
+                            "reacts faster to latency/error swings, "
+                            "lower smooths transients")
     stats.add_argument("--log-stats", action="store_true")
     stats.add_argument("--log-stats-interval", type=float, default=10.0)
 

@@ -1,13 +1,22 @@
-"""Router-level Prometheus gauges.
+"""Router-level Prometheus metrics: gauges + data-plane histograms.
 
 Parity: reference src/vllm_router/services/metrics_service/__init__.py:5-47 —
 the same `vllm:*` gauge names, labeled by server (engine URL), so the
 reference's Grafana dashboard panels read ours unchanged.
+
+On top of the reference's Gauges, the proxy hot path records per-hop
+phase HISTOGRAMS under `tpu_router:*` (routing decision, upstream
+connect, upstream TTFT, stream relay, relay tokens/s) plus
+request/error/retry counters — aggregate gauges can say an engine is
+slow, only the phase distributions say WHERE a request's router time
+went. Fed through ``observe_proxy_phases`` (one call per finished proxy
+attempt, see stats/health.py); scoreboard gauges are pushed by
+``stats/log_stats.py`` on render.
 """
 
 from __future__ import annotations
 
-from prometheus_client import CollectorRegistry, Gauge
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
 ROUTER_REGISTRY = CollectorRegistry()
 
@@ -47,6 +56,147 @@ healthy_pods_total = _g(
     "vllm:healthy_pods_total", "Healthy serving engines"
 )
 avg_ttft = _g("vllm:avg_ttft", "Average time to first token")
+
+# -- router data-plane phase histograms (proxy hot path) ---------------------
+# sub-ms buckets matter: routing decisions and upstream connects on a
+# LAN are 10us-5ms events; the top buckets catch timeout-shaped tails
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+_THROUGHPUT_BUCKETS = (
+    1.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0, 10000.0, 50000.0,
+    100000.0,
+)
+
+
+def _h(name: str, doc: str, buckets=_LATENCY_BUCKETS) -> Histogram:
+    return Histogram(
+        name, doc, ["server"], registry=ROUTER_REGISTRY, buckets=buckets
+    )
+
+
+receive_seconds = _h(
+    "tpu_router:receive_seconds",
+    "Body parse + callbacks + rewrite + endpoint filter, per request",
+)
+routing_decision_seconds = _h(
+    "tpu_router:routing_decision_seconds",
+    "Routing-logic pick (incl. kv/ttft estimation), per request",
+)
+upstream_connect_seconds = _h(
+    "tpu_router:upstream_connect_seconds",
+    "Upstream connect + request write until response headers",
+)
+upstream_ttft_seconds = _h(
+    "tpu_router:upstream_ttft_seconds",
+    "Upstream response headers until first body byte",
+)
+stream_relay_seconds = _h(
+    "tpu_router:stream_relay_seconds",
+    "First upstream byte until eof written to the client",
+)
+finalize_seconds = _h(
+    "tpu_router:finalize_seconds",
+    "Post-stream bookkeeping (cache store, callbacks, span export)",
+)
+request_e2e_seconds = _h(
+    "tpu_router:request_e2e_seconds",
+    "Whole proxied request as the router saw it (receive -> finish)",
+)
+relay_tokens_per_second = _h(
+    "tpu_router:relay_tokens_per_second",
+    "Streaming relay throughput (chunks relayed / relay seconds)",
+    buckets=_THROUGHPUT_BUCKETS,
+)
+
+PHASE_HISTOGRAMS = {
+    "receive": receive_seconds,
+    "route_decision": routing_decision_seconds,
+    "upstream_connect": upstream_connect_seconds,
+    "upstream_ttft": upstream_ttft_seconds,
+    "stream_relay": stream_relay_seconds,
+    "finalize": finalize_seconds,
+}
+
+# renders as tpu_router:requests_total / tpu_router:upstream_errors_total /
+# tpu_router:upstream_retries_total (prometheus_client appends _total)
+proxy_requests = Counter(
+    "tpu_router:requests", "Finished proxy attempts",
+    ["server", "outcome"], registry=ROUTER_REGISTRY,
+)
+upstream_errors = Counter(
+    "tpu_router:upstream_errors", "Failed proxy attempts by error kind",
+    ["server", "kind"], registry=ROUTER_REGISTRY,
+)
+upstream_retries = Counter(
+    "tpu_router:upstream_retries",
+    "Connect-stage failures re-proxied to another backend "
+    "(counted on the failed backend)",
+    ["server"], registry=ROUTER_REGISTRY,
+)
+
+# engine health scoreboard gauges (mirror of GET /debug/engines; pushed
+# by stats/log_stats.py on each render so /metrics scrapes stay fresh)
+engine_ewma_latency = _g(
+    "tpu_router:engine_ewma_latency_seconds",
+    "EWMA e2e latency per backend (router-observed)",
+)
+engine_ewma_ttft = _g(
+    "tpu_router:engine_ewma_ttft_seconds",
+    "EWMA upstream TTFT per backend (router-observed)",
+)
+engine_error_rate = _g(
+    "tpu_router:engine_error_rate",
+    "EWMA error rate per backend (0..1)",
+)
+engine_consecutive_failures = _g(
+    "tpu_router:engine_consecutive_failures",
+    "Current consecutive-failure streak per backend",
+)
+engine_inflight = _g(
+    "tpu_router:engine_inflight",
+    "Requests currently proxied to each backend",
+)
+engine_last_scrape_age = _g(
+    "tpu_router:engine_last_scrape_age_seconds",
+    "Seconds since the stats scraper last reached each backend",
+)
+
+
+def observe_proxy_phases(
+    url: str,
+    phases: dict[str, float],
+    e2e_s: float,
+    ok: bool,
+    error_kind: str | None = None,
+    tokens: int = 0,
+    engine_fault: bool = True,
+) -> None:
+    """Record one finished proxy attempt into the phase histograms and
+    outcome counters (called via stats.health.record_proxy_observation
+    on the proxy hot path — keep this allocation-light).
+
+    A failure with ``engine_fault=False`` (client disconnect, handler
+    cancellation) gets its own outcome label and stays out of
+    ``upstream_errors`` — those count failures the BACKEND caused."""
+    for name, seconds in phases.items():
+        hist = PHASE_HISTOGRAMS.get(name)
+        if hist is not None:
+            hist.labels(server=url).observe(seconds)
+    request_e2e_seconds.labels(server=url).observe(e2e_s)
+    relay_s = phases.get("stream_relay", 0.0)
+    if tokens > 0 and relay_s > 0:
+        relay_tokens_per_second.labels(server=url).observe(
+            tokens / relay_s
+        )
+    outcome = "ok" if ok else ("error" if engine_fault else "client_abort")
+    proxy_requests.labels(server=url, outcome=outcome).inc()
+    if not ok and engine_fault:
+        upstream_errors.labels(
+            server=url, kind=error_kind or "error"
+        ).inc()
+
 
 # router-host resource gauges (reference: routers/metrics_router.py:42-53)
 _router_g = lambda name, doc: Gauge(name, doc, registry=ROUTER_REGISTRY)

@@ -9,6 +9,17 @@ disaggregated-prefill two-phase flow route_disaggregated_prefill_request:349
 Implementation is aiohttp end to end: one shared upstream ClientSession with
 unbounded pool (reference: aiohttp_client.py:21), chunked pass-through so
 first-token latency is preserved.
+
+Observability: every proxied request runs under a ``PhaseClock`` whose
+tiled marks decompose the router's time into
+receive -> route_decision -> upstream_connect -> upstream_ttft ->
+stream_relay -> finalize. Each finished attempt feeds the
+``tpu_router:*`` phase histograms + the per-engine health scoreboard
+(stats/health.py), and — when tracing is on — each phase is exported as
+a child span of the request's ``proxy_request`` span, so the router's
+decomposition joins the engine-side timeline (PR 3) under one trace id.
+A connect-stage failure (nothing sent to either side's socket yet) is
+retried against the remaining routing candidates before giving up.
 """
 
 from __future__ import annotations
@@ -30,8 +41,16 @@ from production_stack_tpu.router.routing_logic import (
 from production_stack_tpu.router.service_discovery import (
     get_service_discovery,
 )
+from production_stack_tpu.router.services.metrics_service import (
+    upstream_retries,
+)
 from production_stack_tpu.router.stats.engine_stats import (
     get_engine_stats_scraper,
+)
+from production_stack_tpu.router.stats.health import (
+    PhaseClock,
+    get_engine_health_board,
+    record_proxy_observation,
 )
 from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
@@ -39,17 +58,56 @@ from production_stack_tpu.router.stats.request_stats import (
 from production_stack_tpu.tracing import (
     REQUEST_ID_HEADER,
     TRACEPARENT_HEADER,
+    Span,
     parse_traceparent,
 )
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
 
+# connect-stage failures re-route to at most this many other candidates
+MAX_CONNECT_RETRIES = 2
+
 _HOP_HEADERS = {
     "host", "content-length", "connection", "keep-alive", "te", "trailers",
     "transfer-encoding", "upgrade", "proxy-authenticate",
     "proxy-authorization",
 }
+
+
+class _ClientDisconnected(Exception):
+    """A CLIENT-socket write failed mid-proxy. Kept distinct from the
+    upstream exception types so the health scoreboard can tell an
+    impatient client apart from a failing engine (engine_fault=False)."""
+
+    def __init__(self, orig: BaseException) -> None:
+        super().__init__(str(orig))
+        self.orig = orig
+
+
+async def _to_client(coro) -> None:
+    """Await a client-socket write, translating its failure. TimeoutError
+    and ConnectionResetError both subclass OSError, so this covers every
+    transport-level way the client can go away."""
+    try:
+        await coro
+    except OSError as e:
+        raise _ClientDisconnected(e) from e
+
+
+def _mark_open_phase(
+    clock: PhaseClock, prepared: bool, first_chunk_seen: bool
+) -> str:
+    """Close the open slice on the phase that was in progress when a
+    proxy attempt died; returns the error-kind label for it."""
+    if not prepared:
+        clock.mark("upstream_connect")
+        return "connect"
+    if not first_chunk_seen:
+        clock.mark("upstream_ttft")
+        return "ttft"
+    clock.mark("stream_relay")
+    return "stream"
 
 
 def _forward_headers(request: web.Request) -> dict[str, str]:
@@ -133,9 +191,11 @@ class RequestService:
         return serving, resolved
 
     # -- main entry (reference: request.py:141) ----------------------------
+    # stackcheck: hot-path — per-request proxy entry; no blocking calls
     async def route_general_request(
         self, request: web.Request, endpoint_path: str
     ) -> web.StreamResponse:
+        clock = PhaseClock()
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -185,6 +245,7 @@ class RequestService:
         rr = RouterRequest(
             headers=dict(request.headers), body=body, endpoint=endpoint_path
         )
+        clock.mark("receive")
         try:
             url = await router.route_request(
                 candidates, engine_stats, request_stats, rr
@@ -195,15 +256,62 @@ class RequestService:
                            "service_unavailable"}},
                 status=503,
             )
+        clock.mark("route_decision")
         logger.info(
             "Routing request %s to %s at endpoint %s",
             request_id, url, endpoint_path,
         )
+        # connect-stage failures may fall over to the other candidates
+        alternates = [
+            e.url for e in candidates if e.url != url
+        ][:MAX_CONNECT_RETRIES]
         return await self.process_request(
-            request, body, url, endpoint_path, request_id
+            request, body, url, endpoint_path, request_id,
+            clock=clock, alternates=alternates,
         )
 
+    def _emit_phase_spans(
+        self, span: Span, clock: PhaseClock, request_id: str,
+        windows: list[tuple[int, str]],
+    ) -> None:
+        """Export the clock's tiled marks as child spans of the
+        proxy_request span. Monotonic marks map onto the parent's
+        epoch anchor, so the children line up with the engine-side
+        timeline spans in one cross-hop trace view. `receive`/
+        `route_decision` legitimately start BEFORE the parent span was
+        created (the span needs the routing outcome for its backend
+        attribute) — their small negative offsets are truthful.
+
+        ``windows`` maps mark index ranges to backends ((first mark
+        index, url) per connect attempt): a retried request's failed
+        connect slice carries the DEAD backend's url, not the one that
+        eventually served it."""
+        if not span.sampled:
+            return  # same contract as the parent: sampled-out = local only
+        anchor = span._start_mono
+        for i, (name, start, end) in enumerate(clock.marks):
+            backend = windows[0][1]
+            for w_start, w_url in windows:
+                if w_start <= i:
+                    backend = w_url
+                else:
+                    break
+            child = Span(
+                name=f"router.{name}",
+                trace_id=span.trace_id,
+                span_id=self.tracer.new_span_id(),
+                parent_span_id=span.span_id,
+                start_time=span.start_time + (start - anchor),
+                sampled=span.sampled,
+                attributes={
+                    "request_id": request_id, "backend": backend,
+                },
+            )
+            child.end_time = span.start_time + (end - anchor)
+            self.tracer.finish(child)
+
     # -- proxy + streaming (reference: request.py:55-138) ------------------
+    # stackcheck: hot-path — per-chunk relay loop; no blocking calls
     async def process_request(
         self,
         request: web.Request,
@@ -212,13 +320,16 @@ class RequestService:
         endpoint_path: str,
         request_id: str,
         stats_url: str | None = None,
+        clock: PhaseClock | None = None,
+        alternates: list[str] | tuple[str, ...] = (),
     ) -> web.StreamResponse:
         monitor = get_request_stats_monitor()
-        stats_url = stats_url or backend_url
+        board = get_engine_health_board()
+        if clock is None:
+            # direct callers (PD decode phase) skipped the routed entry:
+            # receive/route_decision tile as zero-width phases
+            clock = PhaseClock()
         prompt_tokens = _estimate_prompt_tokens(body)
-        monitor.on_new_request(
-            stats_url, request_id, time.time(), prompt_tokens
-        )
         # correlation: the engine adopts this id as ITS request id (and
         # echoes it back), so router logs/spans and engine logs/spans/
         # timelines join end-to-end — previously the generated id was
@@ -246,6 +357,8 @@ class RequestService:
                 "endpoint": endpoint_path,
                 "model": body.get("model"),
                 "prompt_tokens_est": prompt_tokens,
+                # stackcheck: disable=device-sync-hot — plain dict
+                # truthiness; the router never holds device arrays
                 "stream": bool(body.get("stream")),
             }
             if legacy is not None and parent is None:
@@ -262,7 +375,6 @@ class RequestService:
             # engine spans/timelines become children of this span
             _set_header(headers, TRACEPARENT_HEADER, span.traceparent)
         self.in_flight += 1
-        first_chunk_seen = False
         # store-after-response for the semantic cache (reference:
         # semantic_cache_integration.py:74): only whole (non-stream) chat
         # completions are cacheable
@@ -271,66 +383,210 @@ class RequestService:
             and endpoint_path.endswith("chat/completions")
             and not body.get("stream")
         )
-        captured: list[bytes] = []
+        # connect-stage failures (nothing written to either socket yet)
+        # fall over to the remaining routing candidates; once the client
+        # response is prepared the stream is committed to one backend
+        targets = [backend_url]
+        targets += [u for u in alternates if u not in targets]
+        last_exc: Exception | None = None
+        committed: web.StreamResponse | None = None
+        # (first mark index, url) per connect attempt — phase spans use
+        # this to attribute each slice to the backend that owned it
+        attempt_windows: list[tuple[int, str]] = [(0, backend_url)]
         try:
-            async with self.session.post(
-                f"{backend_url}{endpoint_path}",
-                json=body,
-                headers=headers,
-            ) as upstream:
-                resp = web.StreamResponse(
-                    status=upstream.status,
-                    headers={
-                        k: v
-                        for k, v in upstream.headers.items()
-                        if k.lower() not in _HOP_HEADERS
-                    },
+            for attempt, url in enumerate(targets):
+                surl = stats_url or url
+                # retry attempts observe only their own window
+                # (PhaseClock.checkpoint): the healthy fallback backend
+                # must not absorb the dead backend's connect timeout
+                # into its histograms/EWMA, nor re-observe the shared
+                # receive/route_decision slices (charged to attempt 0)
+                ckpt = clock.checkpoint() if attempt else None
+                if attempt:
+                    attempt_windows.append((len(clock.marks), url))
+                monitor.on_new_request(
+                    surl, request_id, num_prompt_tokens=prompt_tokens
                 )
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
-                    if not first_chunk_seen:
-                        first_chunk_seen = True
-                        monitor.on_request_response(
-                            stats_url, request_id, time.time()
+                board.on_request_start(surl)
+                first_chunk_seen = False
+                prepared = False
+                completed = False  # monitor.on_request_complete ran
+                observed = False   # record_proxy_observation ran
+                tokens_relayed = 0
+                ttft_s: float | None = None
+                captured: list[bytes] = []
+                try:
+                    async with self.session.post(
+                        f"{url}{endpoint_path}",
+                        json=body,
+                        headers=headers,
+                    ) as upstream:
+                        t_connect = clock.mark("upstream_connect")
+                        resp = web.StreamResponse(
+                            status=upstream.status,
+                            headers={
+                                k: v
+                                for k, v in upstream.headers.items()
+                                if k.lower() not in _HOP_HEADERS
+                            },
                         )
+                        await _to_client(resp.prepare(request))
+                        prepared = True
+                        committed = resp
+                        async for chunk in upstream.content.iter_any():
+                            if not first_chunk_seen:
+                                first_chunk_seen = True
+                                t_first = clock.mark("upstream_ttft")
+                                ttft_s = t_first - t_connect
+                                monitor.on_request_response(
+                                    surl, request_id
+                                )
+                                if span is not None:
+                                    span.add_event("first_token")
+                            else:
+                                monitor.on_token(surl, request_id)
+                            tokens_relayed += 1
+                            if cache_body and upstream.status == 200:
+                                captured.append(chunk)
+                            await _to_client(resp.write(chunk))
+                        await _to_client(resp.write_eof())
+                        clock.mark("stream_relay")
+                        monitor.on_request_complete(surl, request_id)
+                        completed = True
+                        if captured:
+                            try:
+                                self.semantic_cache.store(
+                                    body, json.loads(b"".join(captured))
+                                )
+                            except (json.JSONDecodeError,
+                                    UnicodeDecodeError):
+                                pass
+                        if self.callbacks is not None:
+                            self.callbacks.post_request(request_id, body)
                         if span is not None:
-                            span.add_event("first_token")
-                    else:
-                        monitor.on_token(stats_url, request_id)
-                    if cache_body and upstream.status == 200:
-                        captured.append(chunk)
-                    await resp.write(chunk)
-                await resp.write_eof()
-                monitor.on_request_complete(
-                    stats_url, request_id, time.time()
-                )
-                if captured:
-                    try:
-                        self.semantic_cache.store(
-                            body, json.loads(b"".join(captured))
+                            span.set_attribute(
+                                "http.status", upstream.status
+                            )
+                            if attempt:
+                                span.set_attribute("backend", url)
+                                span.set_attribute(
+                                    "connect_retries", attempt
+                                )
+                        clock.mark("finalize")
+                        # upstream 5xx counts against engine health;
+                        # 4xx is the client's problem, not the engine's
+                        record_proxy_observation(
+                            surl, clock,
+                            ok=upstream.status < 500,
+                            error_kind=(
+                                None if upstream.status < 500
+                                else f"http_{upstream.status}"
+                            ),
+                            ttft_s=ttft_s,
+                            tokens=tokens_relayed,
+                            since=ckpt,
                         )
-                    except (json.JSONDecodeError, UnicodeDecodeError):
-                        pass
-                if self.callbacks is not None:
-                    self.callbacks.post_request(request_id, body)
-                if span is not None:
-                    span.set_attribute("http.status", upstream.status)
-                    self.tracer.finish(span)
-                    span = None
-                return resp
-        except (aiohttp.ClientError, ConnectionResetError) as e:
-            monitor.on_request_complete(stats_url, request_id, time.time())
-            logger.warning(
-                "backend %s failed for request %s: %s",
-                backend_url, request_id, e,
-            )
+                        observed = True
+                        if span is not None:
+                            self._emit_phase_spans(
+                                span, clock, request_id, attempt_windows
+                            )
+                            self.tracer.finish(span)
+                            span = None
+                        return resp
+                except _ClientDisconnected as e:
+                    # the CLIENT went away (prepare/write failed) — the
+                    # engine did its job: record the sample + phase
+                    # histograms but leave its error totals/streak/EWMA
+                    # untouched, and never burn a retry candidate on it
+                    if not completed:
+                        monitor.on_request_complete(surl, request_id)
+                    clock.mark(
+                        "stream_relay" if first_chunk_seen
+                        else "upstream_ttft"
+                    )
+                    record_proxy_observation(
+                        surl, clock, ok=False,
+                        error_kind="client_disconnect",
+                        ttft_s=ttft_s, tokens=tokens_relayed,
+                        engine_fault=False, since=ckpt,
+                    )
+                    logger.info(
+                        "client for request %s went away mid-proxy "
+                        "(backend %s): %s", request_id, url, e,
+                    )
+                    return resp
+                except (aiohttp.ClientError, ConnectionResetError,
+                        asyncio.TimeoutError) as e:
+                    last_exc = e
+                    if not completed:
+                        monitor.on_request_complete(surl, request_id)
+                    # attribute the open slice to the phase in progress
+                    kind = _mark_open_phase(
+                        clock, prepared, first_chunk_seen
+                    )
+                    record_proxy_observation(
+                        surl, clock, ok=False, error_kind=kind,
+                        ttft_s=ttft_s, tokens=tokens_relayed,
+                        since=ckpt,
+                    )
+                    retriable = (
+                        not prepared and attempt + 1 < len(targets)
+                    )
+                    logger.warning(
+                        "backend %s failed for request %s during %s: "
+                        "%s%s",
+                        url, request_id, kind, e,
+                        " (retrying on next candidate)"
+                        if retriable else "",
+                    )
+                    if not retriable:
+                        break
+                    board.note_retry(surl)
+                    upstream_retries.labels(server=surl).inc()
+                except BaseException as e:
+                    # anything else — handler cancellation (client gone
+                    # / server shutdown), an unexpected bug — must not
+                    # leak the board's in-flight count or the monitor's
+                    # open entry; not charged to engine health (the
+                    # backend did nothing wrong that we know of). The
+                    # completed/observed guards keep a failure in the
+                    # post-stream bookkeeping (callbacks, span export)
+                    # from double-counting a finished request.
+                    if not completed:
+                        monitor.on_request_complete(surl, request_id)
+                    if not observed:
+                        _mark_open_phase(
+                            clock, prepared, first_chunk_seen
+                        )
+                        record_proxy_observation(
+                            surl, clock, ok=False,
+                            error_kind=(
+                                "cancelled"
+                                if isinstance(e, asyncio.CancelledError)
+                                else type(e).__name__
+                            ),
+                            ttft_s=ttft_s, tokens=tokens_relayed,
+                            engine_fault=False, since=ckpt,
+                        )
+                    raise
+            if committed is not None:
+                # the client stream is already committed to a failed
+                # backend: a fresh 502 body cannot go out on this
+                # connection — close it so the client sees truncation
+                # (SSE consumers: no terminating [DONE])
+                committed.force_close()
+                return committed
             return web.json_response(
-                {"error": {"message": f"backend error: {e}",
+                {"error": {"message": f"backend error: {last_exc}",
                            "type": "bad_gateway"}},
                 status=502,
             )
         finally:
             if span is not None:
+                self._emit_phase_spans(
+                    span, clock, request_id, attempt_windows
+                )
                 self.tracer.finish(span, status="ERROR")
             self.in_flight -= 1
 
@@ -346,6 +602,7 @@ class RequestService:
         request_id = request_id or uuid.uuid4().hex
         body = dict(body)
         body.pop("stream", None)
+        clock = PhaseClock()
         endpoints = get_service_discovery().get_endpoint_info()
         candidates, resolved_model = self._filter_endpoints(
             endpoints, body.get("model")
@@ -358,6 +615,7 @@ class RequestService:
                 "type": "service_unavailable"}}
         router = get_routing_logic()
         monitor = get_request_stats_monitor()
+        clock.mark("receive")
         try:
             url = await router.route_request(
                 candidates,
@@ -368,17 +626,26 @@ class RequestService:
         except RuntimeError as e:
             return 503, {"error": {"message": str(e),
                                    "type": "service_unavailable"}}
+        clock.mark("route_decision")
         monitor.on_new_request(
-            url, request_id, time.time(), _estimate_prompt_tokens(body)
+            url, request_id, num_prompt_tokens=_estimate_prompt_tokens(body)
         )
+        board = get_engine_health_board()
+        board.on_request_start(url)
         self.in_flight += 1
+        ok, kind = False, "connect"
         try:
             async with self.session.post(
                 f"{url}{endpoint_path}", json=body,
                 headers={REQUEST_ID_HEADER: request_id},
             ) as upstream:
-                monitor.on_request_response(url, request_id, time.time())
+                clock.mark("upstream_connect")
+                monitor.on_request_response(url, request_id)
+                kind = "stream"
                 payload = await upstream.json(content_type=None)
+                clock.mark("stream_relay")
+                ok = upstream.status < 500
+                kind = None if ok else f"http_{upstream.status}"
                 return upstream.status, payload
         except (aiohttp.ClientError, ConnectionResetError,
                 asyncio.TimeoutError, json.JSONDecodeError,
@@ -386,7 +653,13 @@ class RequestService:
             return 502, {"error": {"message": f"backend error: {e}",
                                    "type": "bad_gateway"}}
         finally:
-            monitor.on_request_complete(url, request_id, time.time())
+            monitor.on_request_complete(url, request_id)
+            # batch requests are whole-body reads: no relay throughput,
+            # and no sample ring entry (the ring is the loadgen's view
+            # of the streaming proxy path)
+            record_proxy_observation(
+                url, clock, ok=ok, error_kind=kind, record_sample=False
+            )
             self.in_flight -= 1
 
     # -- disaggregated prefill (reference: request.py:349-441) -------------
@@ -424,10 +697,12 @@ class RequestService:
         prefill_body.setdefault("kv_transfer_params", {})["role"] = (
             "producer"
         )
-        t0 = time.time()
+        # interval math on time.monotonic() only (wall-clock steps must
+        # not corrupt the logged prefill duration or the stats window)
+        t0 = time.monotonic()
         monitor.on_new_request(
-            prefill_url, f"{request_id}-prefill", t0,
-            _estimate_prompt_tokens(body),
+            prefill_url, f"{request_id}-prefill",
+            num_prompt_tokens=_estimate_prompt_tokens(body),
         )
         try:
             async with self.session.post(
@@ -437,7 +712,7 @@ class RequestService:
                 if pr.status != 200:
                     detail = await pr.text()
                     monitor.on_request_complete(
-                        prefill_url, f"{request_id}-prefill", time.time()
+                        prefill_url, f"{request_id}-prefill"
                     )
                     return web.json_response(
                         {"error": {"message":
@@ -448,7 +723,7 @@ class RequestService:
                 await pr.read()
         except aiohttp.ClientError as e:
             monitor.on_request_complete(
-                prefill_url, f"{request_id}-prefill", time.time()
+                prefill_url, f"{request_id}-prefill"
             )
             return web.json_response(
                 {"error": {"message": f"prefiller unreachable: {e}",
@@ -456,14 +731,14 @@ class RequestService:
                 status=502,
             )
         monitor.on_request_response(
-            prefill_url, f"{request_id}-prefill", time.time()
+            prefill_url, f"{request_id}-prefill"
         )
         monitor.on_request_complete(
-            prefill_url, f"{request_id}-prefill", time.time()
+            prefill_url, f"{request_id}-prefill"
         )
         logger.info(
             "PD request %s: prefill on %s took %.3fs; decoding on %s",
-            request_id, prefill_url, time.time() - t0, decode_url,
+            request_id, prefill_url, time.monotonic() - t0, decode_url,
         )
 
         # Phase 2: decode streams to the client, pulling KV from prefiller

@@ -96,10 +96,19 @@ class EngineStatsScraper:
             *(self._scrape_one(ep.url) for ep in endpoints),
             return_exceptions=True,
         )
+        # the health scoreboard's last-scrape age / scrape-failure
+        # streak is fed here (the scraper is the only component that
+        # touches every backend on a clock, request traffic or not)
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        board = get_engine_health_board()
         fresh: dict[str, EngineStats] = {}
         for ep, res in zip(endpoints, results):
             if isinstance(res, EngineStats):
                 fresh[ep.url] = res
+            board.note_scrape(ep.url, ok=isinstance(res, EngineStats))
         self._stats = fresh
 
     async def _scrape_one(self, url: str) -> EngineStats | None:

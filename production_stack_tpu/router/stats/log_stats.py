@@ -16,6 +16,9 @@ from production_stack_tpu.router.service_discovery import (
 from production_stack_tpu.router.stats.engine_stats import (
     get_engine_stats_scraper,
 )
+from production_stack_tpu.router.stats.health import (
+    get_engine_health_board,
+)
 from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
 )
@@ -30,6 +33,43 @@ def update_prometheus_and_render() -> str:
     request_stats = get_request_stats_monitor().get_request_stats()
 
     ms.healthy_pods_total.labels(server="all").set(len(endpoints))
+
+    # health scoreboard gauges (mirror of /debug/engines; histograms
+    # observe on the hot path, gauges refresh here on render/scrape)
+    board = get_engine_health_board()
+    # discovery churn (pod restarts → fresh URLs) must not grow the
+    # scoreboard and its exported label sets without bound
+    for url in board.prune({ep.url for ep in endpoints}):
+        for g in (
+            ms.engine_ewma_latency, ms.engine_ewma_ttft,
+            ms.engine_error_rate, ms.engine_consecutive_failures,
+            ms.engine_inflight, ms.engine_last_scrape_age,
+        ):
+            try:
+                g.remove(url)
+            except KeyError:
+                pass  # that gauge never exported this backend
+    for url, row in board.snapshot().items():
+        # -1.0 means "no completed request yet" — leave the series
+        # absent rather than exporting a fake 0s latency that would
+        # read as the fastest backend in the fleet
+        if row["ewma_latency_s"] >= 0:
+            ms.engine_ewma_latency.labels(server=url).set(
+                row["ewma_latency_s"]
+            )
+        if row["ewma_ttft_s"] >= 0:
+            ms.engine_ewma_ttft.labels(server=url).set(
+                row["ewma_ttft_s"]
+            )
+        ms.engine_error_rate.labels(server=url).set(row["error_rate"])
+        ms.engine_consecutive_failures.labels(server=url).set(
+            row["consecutive_failures"]
+        )
+        ms.engine_inflight.labels(server=url).set(row["in_flight"])
+        if row["last_scrape_age_s"] is not None:
+            ms.engine_last_scrape_age.labels(server=url).set(
+                row["last_scrape_age_s"]
+            )
     lines = ["", "==================== Router Stats ===================="]
     for ep in endpoints:
         url = ep.url

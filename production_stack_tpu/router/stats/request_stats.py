@@ -6,6 +6,13 @@ on_request_response:219 / on_request_complete:250, the prefill-TPS estimator
 built on a union of overlapping prefill time periods (_calc_engine_prefill_tps
 :363), and uncomputed-prefix-token accounting (:384) that feeds the TTFT
 router.
+
+Clock discipline (mirrors tracing/spans.py): every interval —
+sliding-window expiry, TTFT, ITL, latency, prefill-period unions — is
+measured on ``time.monotonic()``; a wall-clock step (NTP slew, manual
+set) must never expire a whole window or mint a negative TTFT. Callers
+either omit the timestamp (monotonic now) or pass stamps from ONE
+consistent clock; nothing here exports epoch time.
 """
 
 from __future__ import annotations
@@ -23,7 +30,7 @@ class RequestStats:
     in_decoding_requests: int = 0
     finished_requests: int = 0
     uncomputed_prefix_tokens: int = 0
-    prefill_tps: float = -1.0  # tokens/s the engine prefises; -1 = no data
+    prefill_tps: float = -1.0  # tokens/s the engine prefills; -1 = no data
     avg_decoding_length: float = -1.0
     avg_latency: float = -1.0
     avg_itl: float = -1.0  # inter-token latency
@@ -122,7 +129,9 @@ class RequestStatsMonitor:
         self, engine_url: str, request_id: str,
         timestamp: float | None = None, num_prompt_tokens: int = 0,
     ) -> None:
-        ts = timestamp if timestamp is not None else time.time()
+        """timestamp, when given, must be time.monotonic()-domain (as
+        must every other explicit stamp passed to this monitor)."""
+        ts = timestamp if timestamp is not None else time.monotonic()
         if self.first_query_time is None:
             self.first_query_time = ts
         self._mon(self._qps, engine_url).update(ts, 1.0)
@@ -133,7 +142,7 @@ class RequestStatsMonitor:
         timestamp: float | None = None,
     ) -> None:
         """First token received -> request moves prefill -> decode."""
-        ts = timestamp if timestamp is not None else time.time()
+        ts = timestamp if timestamp is not None else time.monotonic()
         key = (engine_url, request_id)
         entry = self._in_prefill.pop(key, None)
         if entry is None:
@@ -157,7 +166,7 @@ class RequestStatsMonitor:
         self, engine_url: str, request_id: str,
         timestamp: float | None = None,
     ) -> None:
-        ts = timestamp if timestamp is not None else time.time()
+        ts = timestamp if timestamp is not None else time.monotonic()
         key = (engine_url, request_id)
         # a request may complete straight from prefill (e.g. PD prefill pass)
         pre = self._in_prefill.pop(key, None)
@@ -202,7 +211,7 @@ class RequestStatsMonitor:
     def get_request_stats(
         self, current_time: float | None = None
     ) -> dict[str, RequestStats]:
-        now = current_time if current_time is not None else time.time()
+        now = current_time if current_time is not None else time.monotonic()
         urls = (
             set(self._qps)
             | {u for u, _ in self._in_prefill}

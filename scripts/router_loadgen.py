@@ -1,0 +1,383 @@
+#!/usr/bin/env python3
+"""Router-only load harness: production traffic without a chip.
+
+Drives thousands of concurrent STREAMING sessions through the real
+router app (real TCP sockets, real aiohttp proxy hot path) against
+in-process stub engines (tests/fake_engine.py), once per routing
+algorithm, and writes ``ROUTER_BENCH.json``:
+
+- per-phase p50/p99 from the router's own tiled phase decomposition
+  (receive / route_decision / upstream_connect / upstream_ttft /
+  stream_relay / finalize — stats/health.py sample ring),
+- the phase-closure check (sum of phases vs independently measured
+  e2e; the tiling contract makes this ≈ exact, and the smoke gate in
+  tests/test_router_loadbench.py pins it within 5%),
+- client-observed e2e/TTFT percentiles, RPS, error/retry counts, and
+  the per-engine health scoreboard snapshot.
+
+Everything runs in ONE asyncio process on a CPU box — engines, router,
+and load clients — which is exactly what makes it a tier-1/CI
+regression gate (no jax, no chip, no cluster). Usage:
+
+    python scripts/router_loadgen.py --smoke          # CI profile
+    python scripts/router_loadgen.py                  # full profile
+    python scripts/router_loadgen.py --algorithms roundrobin,ttft \
+        --requests 5000 --concurrency 1024
+
+Exit status: 0 when every algorithm's gates pass (phase closure <= 5%,
+error rate <= 1%), 2 otherwise — so a bare CI step fails loudly even
+without the pytest gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import aiohttp  # noqa: E402
+from aiohttp import web  # noqa: E402
+
+from production_stack_tpu.router import parsers  # noqa: E402
+from production_stack_tpu.router.routing_logic import (  # noqa: E402
+    _reset_routing_logic,
+)
+from production_stack_tpu.router.service_discovery import (  # noqa: E402
+    _reset_service_discovery,
+)
+from production_stack_tpu.router.stats.health import (  # noqa: E402
+    PROXY_PHASES,
+    _reset_engine_health_board,
+    get_engine_health_board,
+)
+from tests.fake_engine import FakeEngine  # noqa: E402
+
+DEFAULT_ALGORITHMS = ("roundrobin", "session", "prefixaware", "ttft")
+
+
+def quiet_logs() -> None:
+    """Silence per-request INFO logging: the proxy logs one line per
+    routed request, which at harness volume measures the logger, not
+    the data plane. Module loggers are non-propagating with their own
+    levels (utils/log.py), so sweep existing ones AND set the env
+    default for modules imported later (build_app imports lazily)."""
+    import logging
+    import os
+
+    os.environ.setdefault("PST_LOG_LEVEL", "WARNING")
+    for name in list(logging.root.manager.loggerDict):
+        if name.startswith("production_stack_tpu"):
+            logging.getLogger(name).setLevel(logging.WARNING)
+
+# gates (also pinned by tests/test_router_loadbench.py)
+CLOSURE_GATE = 0.05     # per-request |sum(phases) - e2e| / e2e
+ERROR_RATE_GATE = 0.01
+
+
+@dataclass
+class RunConfig:
+    requests: int = 2560          # per algorithm (4 algos -> 10k+ total)
+    concurrency: int = 1024       # concurrent streaming sessions
+    engines: int = 4
+    tokens: int = 8               # streamed chunks per request
+    tokens_per_sec: float = 2000.0
+    engine_ttft_s: float = 0.0
+    algorithms: tuple[str, ...] = DEFAULT_ALGORITHMS
+    out: str = "ROUTER_BENCH.json"
+
+
+def smoke_config() -> RunConfig:
+    """The CI profile: >= 1k requests and >= 512 concurrent sessions
+    per algorithm, small enough for an ungpu'd runner."""
+    return RunConfig(requests=1024, concurrency=512, engines=4,
+                     tokens=8, tokens_per_sec=2000.0)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return -1.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def _dist_ms(vals: list[float]) -> dict:
+    s = sorted(vals)
+    return {
+        "count": len(s),
+        "p50_ms": round(_percentile(s, 0.50) * 1e3, 4),
+        "p99_ms": round(_percentile(s, 0.99) * 1e3, 4),
+        "max_ms": round(s[-1] * 1e3, 4) if s else -1.0,
+    }
+
+
+async def _worker(
+    wid: int,
+    client: aiohttp.ClientSession,
+    base: str,
+    cfg: RunConfig,
+    counter: dict,
+    out: dict,
+) -> None:
+    """One streaming session: issues requests until the shared budget
+    is spent. Session-affine headers + a per-session prompt prefix give
+    the session/prefixaware algorithms something real to chew on."""
+    prefix = f"session-{wid} shared history preamble. "
+    while True:
+        i = counter["next"]
+        if i >= cfg.requests:
+            return
+        counter["next"] = i + 1
+        body = {
+            "model": "fake-model",
+            "prompt": f"{prefix}turn {i} payload " + "x" * 64,
+            "max_tokens": cfg.tokens,
+            "stream": True,
+        }
+        t0 = time.monotonic()
+        ttft = None
+        try:
+            async with client.post(
+                f"{base}/v1/completions", json=body,
+                headers={"x-user-id": f"user-{wid}"},
+            ) as r:
+                async for _chunk in r.content.iter_any():
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                if r.status != 200:
+                    out["client_errors"] += 1
+                    continue
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            out["client_errors"] += 1
+            continue
+        out["e2e"].append(time.monotonic() - t0)
+        if ttft is not None:
+            out["ttft"].append(ttft)
+
+
+async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
+    """One full load run: fresh singletons, fresh engines, fresh router
+    on an ephemeral port, cfg.concurrency workers, cfg.requests total."""
+    quiet_logs()
+    from production_stack_tpu.router.app import build_app
+
+    _reset_routing_logic()
+    _reset_service_discovery()
+    _reset_engine_health_board()
+
+    engines = [
+        FakeEngine(
+            model="fake-model",
+            tokens_per_sec=cfg.tokens_per_sec,
+            ttft_s=cfg.engine_ttft_s,
+            num_tokens=cfg.tokens,
+        )
+        for _ in range(cfg.engines)
+    ]
+    for e in engines:
+        await e.start()
+
+    argv = [
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", ",".join("fake-model" for _ in engines),
+        "--routing-logic", algo,
+        "--engine-stats-interval", "0.5",
+        # empty url disables the kv-controller handshake for ttft
+        # (no jax, no controller process on the load box)
+        "--kv-controller-url", "",
+    ]
+    if algo == "session":
+        argv += ["--session-key", "x-user-id"]
+    args = parsers.parse_args(argv)
+    router_app = build_app(args)
+
+    # the sample ring must hold the whole run for exact percentiles
+    get_engine_health_board().set_sample_capacity(cfg.requests * 2)
+
+    runner = web.AppRunner(router_app.app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+
+    out = {"e2e": [], "ttft": [], "client_errors": 0}
+    counter = {"next": 0}
+    t_start = time.monotonic()
+    async with aiohttp.ClientSession(
+        connector=aiohttp.TCPConnector(limit=0),
+        timeout=aiohttp.ClientTimeout(total=120),
+    ) as client:
+        await asyncio.gather(*(
+            _worker(w, client, base, cfg, counter, out)
+            for w in range(cfg.concurrency)
+        ))
+        wall_s = time.monotonic() - t_start
+        # smoke-sanity: the data-plane histograms must be live
+        async with client.get(f"{base}/metrics") as r:
+            metrics_ok = "tpu_router:" in await r.text()
+        async with client.get(f"{base}/debug/engines") as r:
+            scoreboard = (await r.json())["engines"]
+
+    board = get_engine_health_board()
+    samples = list(board.samples)
+    await runner.cleanup()
+    for e in engines:
+        await e.stop()
+    _reset_routing_logic()
+    _reset_service_discovery()
+
+    phase_vals: dict[str, list[float]] = {p: [] for p in PROXY_PHASES}
+    closure_errs: list[float] = []
+    router_errors = 0
+    retries = sum(row.get("retries_total", 0) for row in scoreboard)
+    for s in samples:
+        if not s["ok"]:
+            router_errors += 1
+        for name, v in s["phases"].items():
+            phase_vals.setdefault(name, []).append(v)
+        if s["e2e_s"] > 0:
+            gap = abs(sum(s["phases"].values()) - s["e2e_s"])
+            closure_errs.append(gap / s["e2e_s"])
+
+    completed = len(out["e2e"])
+    result = {
+        "requests": completed,
+        "errors": out["client_errors"],
+        "router_errors": router_errors,
+        "retries": retries,
+        "wall_s": round(wall_s, 3),
+        "rps": round(completed / wall_s, 2) if wall_s > 0 else -1.0,
+        "client": {
+            "e2e": _dist_ms(out["e2e"]),
+            "ttft": _dist_ms(out["ttft"]),
+        },
+        "phases": {
+            name: _dist_ms(vals)
+            for name, vals in phase_vals.items() if vals
+        },
+        "phase_closure": {
+            "checked": len(closure_errs),
+            "mean_rel_err": (
+                round(sum(closure_errs) / len(closure_errs), 6)
+                if closure_errs else -1.0
+            ),
+            "max_rel_err": (
+                round(max(closure_errs), 6) if closure_errs else -1.0
+            ),
+        },
+        "metrics_exported": metrics_ok,
+        "per_engine": scoreboard,
+    }
+    return result
+
+
+def gates_pass(algo_result: dict) -> list[str]:
+    """Returns the list of violated gates (empty = pass)."""
+    bad = []
+    closure = algo_result["phase_closure"]
+    if closure["checked"] == 0 or closure["max_rel_err"] > CLOSURE_GATE:
+        bad.append(
+            f"phase closure {closure['max_rel_err']} > {CLOSURE_GATE}"
+        )
+    total = max(1, algo_result["requests"] + algo_result["errors"])
+    # the client-side and router-side counts see the SAME failures from
+    # two vantage points — summing them would double-count each failed
+    # request and trip the gate at half the intended threshold; gate on
+    # whichever side saw more
+    err_rate = max(
+        algo_result["errors"], algo_result["router_errors"]
+    ) / total
+    if err_rate > ERROR_RATE_GATE:
+        bad.append(f"error rate {err_rate:.4f} > {ERROR_RATE_GATE}")
+    if not algo_result["metrics_exported"]:
+        bad.append("tpu_router:* metrics missing from /metrics")
+    return bad
+
+
+async def run_suite(cfg: RunConfig) -> dict:
+    results: dict = {
+        "config": {
+            "requests_per_algorithm": cfg.requests,
+            "concurrency": cfg.concurrency,
+            "engines": cfg.engines,
+            "tokens": cfg.tokens,
+            "tokens_per_sec": cfg.tokens_per_sec,
+        },
+        "algorithms": {},
+    }
+    for algo in cfg.algorithms:
+        print(f"[loadgen] {algo}: {cfg.requests} requests @ "
+              f"{cfg.concurrency} concurrent ...", flush=True)
+        r = await run_algorithm(algo, cfg)
+        results["algorithms"][algo] = r
+        print(
+            f"[loadgen] {algo}: rps={r['rps']} "
+            f"e2e_p99={r['client']['e2e']['p99_ms']}ms "
+            f"errors={r['errors']}+{r['router_errors']} "
+            f"closure_max={r['phase_closure']['max_rel_err']}",
+            flush=True,
+        )
+    return results
+
+
+def write_bench(results: dict, path: str | Path) -> None:
+    Path(path).write_text(json.dumps(results, indent=2) + "\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="router_loadgen",
+        description="router-only load harness (no chip, no jax)",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI profile: 1024 requests x 512 sessions "
+                         "per algorithm")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per algorithm")
+    ap.add_argument("--concurrency", type=int, default=None)
+    ap.add_argument("--engines", type=int, default=None)
+    ap.add_argument("--tokens", type=int, default=None)
+    ap.add_argument("--tokens-per-sec", type=float, default=None)
+    ap.add_argument("--engine-ttft-s", type=float, default=None)
+    ap.add_argument("--algorithms", type=str, default=None,
+                    help="comma list from: " + ",".join(
+                        DEFAULT_ALGORITHMS))
+    ap.add_argument("--out", type=str, default=None)
+    ns = ap.parse_args(argv)
+
+    cfg = smoke_config() if ns.smoke else RunConfig()
+    for name in ("requests", "concurrency", "engines", "tokens",
+                 "tokens_per_sec", "engine_ttft_s", "out"):
+        val = getattr(ns, name)
+        if val is not None:
+            setattr(cfg, name, val)
+    if ns.algorithms:
+        cfg.algorithms = tuple(
+            a.strip() for a in ns.algorithms.split(",") if a.strip()
+        )
+
+    quiet_logs()
+    results = asyncio.run(run_suite(cfg))
+    write_bench(results, cfg.out)
+    print(f"[loadgen] wrote {cfg.out}")
+
+    failed = False
+    for algo, r in results["algorithms"].items():
+        bad = gates_pass(r)
+        if bad:
+            failed = True
+            print(f"[loadgen] GATE FAIL {algo}: {'; '.join(bad)}")
+    return 2 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

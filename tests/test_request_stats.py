@@ -1,0 +1,276 @@
+"""Edge cases for the router's request-stats monitors and the engine
+health scoreboard (stats/request_stats.py + stats/health.py).
+
+The monitors take explicit timestamps so every case here drives a
+synthetic clock — but the PRODUCTION default is now time.monotonic()
+(wall-clock steps must never expire a whole window or mint a negative
+TTFT), which the monotonic-default tests pin directly.
+"""
+
+from __future__ import annotations
+
+import time
+
+from production_stack_tpu.router.stats.health import (
+    PROXY_PHASES,
+    EngineHealthBoard,
+    PhaseClock,
+    get_engine_health_board,
+    initialize_engine_health_board,
+)
+from production_stack_tpu.router.stats.request_stats import (
+    MovingAverageMonitor,
+    RequestStats,
+    RequestStatsMonitor,
+)
+
+
+class TestMovingAverageMonitor:
+    def test_single_point_rate(self):
+        m = MovingAverageMonitor(window_s=10.0)
+        m.update(100.0, 1.0)
+        # one event over a 10s window
+        assert m.rate(100.0) == 0.1
+        assert m.count(100.0) == 1
+        assert m.average(100.0) == 1.0
+
+    def test_full_window_expiry(self):
+        m = MovingAverageMonitor(window_s=10.0)
+        for t in (100.0, 101.0, 102.0):
+            m.update(t, 5.0)
+        assert m.count(105.0) == 3
+        # everything strictly older than now - window expires
+        assert m.count(120.1) == 0
+        assert m.rate(121.0) == 0.0
+
+    def test_average_after_expiry_returns_sentinel(self):
+        m = MovingAverageMonitor(window_s=5.0)
+        m.update(50.0, 3.0)
+        assert m.average(50.0) == 3.0
+        assert m.average(100.0) == -1.0  # no data = -1.0, not 0.0
+
+    def test_partial_expiry_average(self):
+        m = MovingAverageMonitor(window_s=10.0)
+        m.update(100.0, 2.0)
+        m.update(109.0, 4.0)
+        # at t=111 the first point (t=100) is outside [101, 111]
+        assert m.average(111.0) == 4.0
+
+    def test_boundary_point_not_expired(self):
+        m = MovingAverageMonitor(window_s=10.0)
+        m.update(100.0, 7.0)
+        # exactly window-old is kept (expiry is strict <)
+        assert m.count(110.0) == 1
+
+
+class TestRequestStatsMonitor:
+    URL = "http://e1"
+
+    def test_ttft_and_lifecycle(self):
+        mon = RequestStatsMonitor(sliding_window_s=60.0)
+        mon.on_new_request(self.URL, "r1", 100.0, num_prompt_tokens=40)
+        s = mon.get_request_stats(100.5)[self.URL]
+        assert s.in_prefill_requests == 1
+        assert s.uncomputed_prefix_tokens == 40
+        assert s.ttft == -1.0
+
+        mon.on_request_response(self.URL, "r1", 101.25)
+        s = mon.get_request_stats(101.5)[self.URL]
+        assert s.in_prefill_requests == 0
+        assert s.in_decoding_requests == 1
+        assert abs(s.ttft - 1.25) < 1e-9
+
+        for _ in range(4):
+            mon.on_token(self.URL, "r1", 101.5)
+        mon.on_request_complete(self.URL, "r1", 103.25)
+        s = mon.get_request_stats(103.5)[self.URL]
+        assert s.in_decoding_requests == 0
+        assert s.finished_requests == 1
+        # ITL: (complete - first_ts) / (n - 1), n = post-first tokens
+        assert abs(s.avg_itl - 2.0 / 3.0) < 1e-9
+
+    def test_window_expiry_resets_averages(self):
+        mon = RequestStatsMonitor(sliding_window_s=10.0)
+        mon.on_new_request(self.URL, "r1", 100.0)
+        mon.on_request_response(self.URL, "r1", 100.5)
+        mon.on_request_complete(self.URL, "r1", 101.0)
+        assert mon.get_request_stats(101.0)[self.URL].ttft > 0
+        # a full window later every moving average reports no-data
+        s = mon.get_request_stats(200.0)[self.URL]
+        assert s.ttft == -1.0
+        assert s.avg_latency == -1.0
+        assert s.qps == 0.0
+        assert s.prefill_tps == -1.0
+        assert s.finished_requests == 1  # lifetime counter survives
+
+    def test_complete_straight_from_prefill(self):
+        """PD prefill passes complete without ever streaming a token."""
+        mon = RequestStatsMonitor(sliding_window_s=60.0)
+        mon.on_new_request(self.URL, "p1", 100.0)
+        mon.on_request_complete(self.URL, "p1", 100.75)
+        s = mon.get_request_stats(101.0)[self.URL]
+        assert s.finished_requests == 1
+        assert abs(s.avg_latency - 0.75) < 1e-9
+
+    def test_monotonic_default_clock(self):
+        """Omitted timestamps use time.monotonic(): a request stamped
+        by the default clock must produce a sane sub-second TTFT even
+        though epoch time is ~1.7e9 (mixing clocks would explode it)."""
+        mon = RequestStatsMonitor(sliding_window_s=60.0)
+        mon.on_new_request(self.URL, "r1")
+        mon.on_request_response(self.URL, "r1")
+        mon.on_request_complete(self.URL, "r1")
+        s = mon.get_request_stats()[self.URL]
+        assert 0.0 <= s.ttft < 1.0
+        assert s.qps > 0.0
+
+    def test_prefill_tps_doc_and_default(self):
+        import inspect
+
+        from production_stack_tpu.router.stats import request_stats
+
+        # the "prefises" typo stays fixed, and nothing in the monitor
+        # measures intervals on wall-clock time anymore
+        src = inspect.getsource(request_stats)
+        assert "prefises" not in src
+        assert "time.time()" not in src
+        # the dataclass default contract: -1 means no data
+        assert RequestStats().prefill_tps == -1.0
+
+
+class TestPhaseClock:
+    def test_marks_tile_elapsed(self):
+        clock = PhaseClock()
+        for ph in PROXY_PHASES:
+            clock.mark(ph)
+        phases = clock.phases
+        assert set(phases) == set(PROXY_PHASES)
+        # tiling contract: phases sum to e2e (the loadbench closure
+        # gate relies on this staying exact)
+        total = sum(phases.values())
+        assert abs(total - (clock._last - clock.t0)) < 1e-9
+        assert clock.elapsed_s >= total
+
+    def test_repeated_marks_accumulate(self):
+        clock = PhaseClock()
+        clock.mark("upstream_connect")
+        time.sleep(0.001)
+        clock.mark("upstream_connect")  # retry path re-marks the phase
+        assert clock.phases["upstream_connect"] >= 0.001
+        assert len(clock.marks) == 2
+
+
+class TestEngineHealthBoard:
+    URL = "http://e1"
+
+    def _observe(self, board, ok, e2e=1.0, **kw):
+        board.on_request_start(self.URL)
+        board.observe(self.URL, {"stream_relay": e2e}, e2e, ok, **kw)
+
+    def test_ewma_decay(self):
+        board = EngineHealthBoard(ewma_alpha=0.5)
+        self._observe(board, True, e2e=1.0)
+        self._observe(board, True, e2e=3.0)
+        row = board.snapshot()[self.URL]
+        # first sample seeds, second folds at alpha: 0.5*1 + 0.5*3
+        assert abs(row["ewma_latency_s"] - 2.0) < 1e-9
+        # error EWMA decays toward 0 on successes
+        assert row["error_rate"] == 0.0
+        self._observe(board, False, error_kind="connect")
+        row = board.snapshot()[self.URL]
+        assert abs(row["error_rate"] - 0.5) < 1e-9
+        self._observe(board, True)
+        assert abs(
+            board.snapshot()[self.URL]["error_rate"] - 0.25
+        ) < 1e-9
+
+    def test_failure_streak_and_recovery(self):
+        board = EngineHealthBoard()
+        for _ in range(3):
+            self._observe(board, False, error_kind="connect")
+        row = board.snapshot()[self.URL]
+        assert row["consecutive_failures"] == 3
+        assert row["errors_total"] == 3
+        assert row["last_error"] == "connect"
+        assert not board.is_healthy(self.URL)
+        # one success clears the streak (but not the totals)
+        self._observe(board, True)
+        row = board.snapshot()[self.URL]
+        assert row["consecutive_failures"] == 0
+        assert row["errors_total"] == 3
+        assert board.is_healthy(self.URL)
+
+    def test_failed_ewma_latency_not_folded(self):
+        """Error latencies must not poison the latency EWMA (a fast
+        connect-refused would otherwise make a dead engine look
+        fast)."""
+        board = EngineHealthBoard()
+        self._observe(board, True, e2e=2.0)
+        self._observe(board, False, e2e=0.001, error_kind="connect")
+        assert board.snapshot()[self.URL]["ewma_latency_s"] == 2.0
+
+    def test_in_flight_and_retries(self):
+        board = EngineHealthBoard()
+        board.on_request_start(self.URL)
+        assert board.snapshot()[self.URL]["in_flight"] == 1
+        board.note_retry(self.URL)
+        board.observe(self.URL, {}, 0.1, False, error_kind="connect")
+        row = board.snapshot()[self.URL]
+        assert row["in_flight"] == 0
+        assert row["retries_total"] == 1
+
+    def test_scrape_age(self):
+        board = EngineHealthBoard()
+        assert board.snapshot() == {}
+        board.note_scrape(self.URL, ok=True)
+        row = board.snapshot()[self.URL]
+        assert 0.0 <= row["last_scrape_age_s"] < 1.0
+        board.note_scrape(self.URL, ok=False)
+        row = board.snapshot()[self.URL]
+        assert row["scrape_failures"] == 1
+        # a failed scrape keeps the last GOOD age ticking, not None
+        assert row["last_scrape_age_s"] is not None
+
+    def test_sample_ring_bounded(self):
+        board = EngineHealthBoard(sample_capacity=4)
+        for i in range(10):
+            self._observe(board, True, e2e=float(i + 1))
+        assert len(board.samples) == 4
+        assert board.samples[-1]["e2e_s"] == 10.0
+        board.set_sample_capacity(2)
+        assert len(board.samples) == 2
+
+    def test_prune_evicts_departed_idle_backends(self):
+        """Discovery churn must not grow the scoreboard forever: a
+        backend that is no longer discovered, has nothing in flight,
+        and has idled past the threshold gets evicted — kept, busy,
+        and recently-active rows survive."""
+        board = EngineHealthBoard()
+        self._observe(board, True)             # e1: idle, departed
+        board.on_request_start("http://busy")  # in flight, departed
+        board.note_scrape("http://recent")     # departed, just scraped
+        self._observe(board, True)  # e1 again (still just two rows +2)
+        evicted = board.prune({"http://kept"}, min_idle_s=0.0)
+        # min_idle_s=0 → every idle row is stale; in-flight survives
+        assert set(evicted) == {self.URL, "http://recent"}
+        assert set(board.snapshot()) == {"http://busy"}
+        # a recently-active departed row survives a real threshold
+        board.note_scrape("http://recent")
+        assert board.prune(set(), min_idle_s=600.0) == []
+        assert "http://recent" in board.snapshot()
+        # a still-discovered row is never pruned no matter how idle
+        self._observe(board, True)
+        assert self.URL not in board.prune({self.URL}, min_idle_s=0.0)
+
+    def test_singleton_auto_init(self):
+        from production_stack_tpu.router.stats.health import (
+            _reset_engine_health_board,
+        )
+
+        _reset_engine_health_board()
+        board = get_engine_health_board()  # never raises: auto-creates
+        assert board is get_engine_health_board()
+        explicit = initialize_engine_health_board(ewma_alpha=0.3)
+        assert get_engine_health_board() is explicit
+        assert explicit.ewma_alpha == 0.3
+        _reset_engine_health_board()

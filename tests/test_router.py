@@ -225,6 +225,11 @@ def reset_singletons():
     yield
     _reset_routing_logic()
     _reset_service_discovery()
+    from production_stack_tpu.router.stats.health import (
+        _reset_engine_health_board,
+    )
+
+    _reset_engine_health_board()
 
 
 async def _start_stack(routing="roundrobin", n_engines=2, extra_args=(),
@@ -375,6 +380,205 @@ class TestRouterE2E:
             text = await r.text()
             assert "vllm:healthy_pods_total" in text
             assert "router:cpu_usage_percent" in text
+            # data-plane phase histograms observed the request above
+            assert "tpu_router:routing_decision_seconds_bucket" in text
+            assert "tpu_router:upstream_ttft_seconds_bucket" in text
+            assert 'tpu_router:requests_total' in text
+            # scoreboard gauges refresh on render
+            assert "tpu_router:engine_ewma_latency_seconds" in text
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_debug_engines_scoreboard(self, reset_singletons):
+        async def run():
+            client, engines = await _start_stack()
+            for _ in range(4):
+                r = await client.post("/v1/completions", json={
+                    "model": "fake-model", "prompt": "x",
+                    "max_tokens": 2, "stream": True})
+                assert r.status == 200
+                await r.text()
+            r = await client.get("/debug/engines")
+            rows = (await r.json())["engines"]
+            assert len(rows) == 2
+            by_url = {row["url"]: row for row in rows}
+            assert all(row["discovered"] for row in rows)
+            assert all(row["healthy"] for row in rows)
+            # roundrobin spread 4 requests over 2 engines, 2 each
+            assert sum(
+                row["requests_total"] for row in rows
+            ) == 4
+            for e in engines:
+                row = by_url[e.url]
+                assert row["ewma_latency_s"] > 0
+                assert row["error_rate"] == 0.0
+                assert row["consecutive_failures"] == 0
+                assert row["in_flight"] == 0
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_connect_failure_retries_next_candidate(
+            self, reset_singletons):
+        """A backend that refuses connections must not surface as a
+        client-visible 502 while healthy candidates exist: the proxy
+        retries connect-stage failures on the remaining endpoints and
+        the scoreboard records the streak against the dead one."""
+        async def run():
+            client, engines = await _start_stack(n_engines=2)
+            dead = engines[0]
+            dead_url = dead.url
+            await dead.stop()  # port now refuses connections
+            for i in range(6):
+                r = await client.post("/v1/completions", json={
+                    "model": "fake-model", "prompt": f"p{i}",
+                    "max_tokens": 2})
+                assert r.status == 200  # every request lands on alive
+            r = await client.get("/debug/engines")
+            rows = {row["url"]: row
+                    for row in (await r.json())["engines"]}
+            assert rows[dead_url]["retries_total"] >= 1
+            assert rows[dead_url]["errors_total"] >= 1
+            assert rows[dead_url]["last_error"] == "connect"
+            assert rows[dead_url]["consecutive_failures"] >= 1
+            alive = rows[engines[1].url]
+            assert alive["errors_total"] == 0
+            assert alive["requests_total"] == 6
+            await _stop_stack(client, engines[1:])
+        asyncio.run(run())
+
+    def test_upstream_timeout_cleans_up_and_counts(
+            self, reset_singletons, monkeypatch):
+        """An upstream total-timeout (asyncio.TimeoutError — NOT an
+        aiohttp.ClientError) must 502, count against engine health,
+        and leave no in-flight leak on the scoreboard."""
+        import aiohttp as aiohttp_mod
+
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        async def run():
+            client, engines = await _start_stack(n_engines=1)
+            upstream_prefix = engines[0].url
+            orig_post = aiohttp_mod.ClientSession.post
+
+            def failing_post(self, url, **kw):
+                # only the router's upstream hop fails; the TestClient
+                # reaches the router via ClientSession.request
+                if str(url).startswith(upstream_prefix):
+                    raise asyncio.TimeoutError()
+                return orig_post(self, url, **kw)
+
+            monkeypatch.setattr(
+                aiohttp_mod.ClientSession, "post", failing_post
+            )
+            r = await client.post("/v1/completions", json={
+                "model": "fake-model", "prompt": "x", "max_tokens": 2})
+            assert r.status == 502
+            monkeypatch.setattr(
+                aiohttp_mod.ClientSession, "post", orig_post
+            )
+            row = get_engine_health_board().snapshot()[upstream_prefix]
+            assert row["in_flight"] == 0
+            assert row["errors_total"] == 1
+            assert row["last_error"] == "connect"
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_handler_cancellation_cleans_up_without_engine_fault(
+            self, reset_singletons, monkeypatch):
+        """A cancellation racing the upstream hop (client gone, server
+        shutdown) must clean up the scoreboard WITHOUT charging the
+        engine: in_flight returns to 0, error totals stay untouched,
+        and the sample records 'cancelled'."""
+        import aiohttp as aiohttp_mod
+
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        async def run():
+            client, engines = await _start_stack(n_engines=1)
+            upstream_prefix = engines[0].url
+            orig_post = aiohttp_mod.ClientSession.post
+
+            def cancelling_post(self, url, **kw):
+                if str(url).startswith(upstream_prefix):
+                    raise asyncio.CancelledError()
+                return orig_post(self, url, **kw)
+
+            monkeypatch.setattr(
+                aiohttp_mod.ClientSession, "post", cancelling_post
+            )
+            try:
+                await client.post("/v1/completions", json={
+                    "model": "fake-model", "prompt": "x",
+                    "max_tokens": 2})
+            except aiohttp_mod.ClientError:
+                pass  # server dropped the connection — expected
+            monkeypatch.setattr(
+                aiohttp_mod.ClientSession, "post", orig_post
+            )
+            board = get_engine_health_board()
+            row = board.snapshot()[upstream_prefix]
+            assert row["in_flight"] == 0
+            assert row["errors_total"] == 0
+            assert row["consecutive_failures"] == 0
+            assert row["requests_total"] == 1
+            assert board.samples[-1]["error"] == "cancelled"
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_client_disconnect_not_charged_to_engine(
+            self, reset_singletons, monkeypatch):
+        """A client that goes away mid-relay must not mark a healthy
+        engine unhealthy: the attempt records a client_disconnect
+        sample (engine_fault=False) and the engine's error totals,
+        failure streak, and EWMA error rate stay untouched."""
+        import types
+
+        from aiohttp import web as aioweb
+
+        from production_stack_tpu.router.services import (
+            request_service as rs_mod,
+        )
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        class _DroppingResponse(aioweb.StreamResponse):
+            """First chunk relays, then the client 'goes away'."""
+
+            async def write(self, data):
+                await super().write(data)
+                raise ConnectionResetError("client gone")
+
+        # scope the failure to the ROUTER's client-facing response only
+        # (the in-process FakeEngine uses web.StreamResponse too)
+        proxy_web = types.SimpleNamespace(
+            **{k: getattr(aioweb, k) for k in dir(aioweb)
+               if not k.startswith("_")}
+        )
+        proxy_web.StreamResponse = _DroppingResponse
+
+        async def run():
+            client, engines = await _start_stack(n_engines=1)
+            monkeypatch.setattr(rs_mod, "web", proxy_web)
+            r = await client.post("/v1/completions", json={
+                "model": "fake-model", "prompt": "x",
+                "max_tokens": 8, "stream": True})
+            await r.read()  # router stops relaying after the drop
+            monkeypatch.setattr(rs_mod, "web", aioweb)
+            board = get_engine_health_board()
+            row = board.snapshot()[engines[0].url]
+            assert row["requests_total"] == 1
+            assert row["errors_total"] == 0
+            assert row["consecutive_failures"] == 0
+            assert row["error_rate"] == 0.0
+            assert row["in_flight"] == 0
+            sample = board.samples[-1]
+            assert sample["ok"] is False
+            assert sample["error"] == "client_disconnect"
             await _stop_stack(client, engines)
         asyncio.run(run())
 

@@ -594,6 +594,38 @@ def test_elastic_decode_hot_marks_present():
         assert not missing, f"{fname}: unmarked hot paths {missing}"
 
 
+def test_router_proxy_stays_off_blocking_paths():
+    """Router data plane (PR 6): the proxy hot path
+    (route_general_request / process_request) relays every chunk of
+    every request — one blocking call or swallowed exception there
+    stalls or silently degrades the WHOLE router, so router/services/
+    must stay at zero unsuppressed blocking-async + silent-except
+    findings."""
+    report = analyze_paths(
+        [str(PACKAGE / "router" / "services")],
+        select=["blocking-async", "silent-except"],
+    )
+    assert report.files_scanned >= 6
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_router_proxy_hot_marks_present():
+    """The sweep above only bites while the proxy entry points carry
+    the hot-path mark — a dropped mark would pass silently."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    path = PACKAGE / "router" / "services" / "request_service.py"
+    ctx = ModuleContext(str(path), path.read_text())
+    hot = {f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)}
+    missing = {"route_general_request", "process_request"} - hot
+    assert not missing, f"request_service.py: unmarked hot paths {missing}"
+
+
 def test_timeline_recording_stays_off_hot_paths():
     """Request-timeline recording (tracing/ + its engine call sites)
     must not introduce device syncs or event-loop stalls on the marked

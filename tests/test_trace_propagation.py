@@ -232,9 +232,14 @@ def test_router_tracing_shim_reexports():
 # -- e2e: router injects correlation + trace headers -------------------------
 @pytest.fixture()
 def reset_singletons():
+    from production_stack_tpu.router.stats.health import (
+        _reset_engine_health_board,
+    )
+
     yield
     _reset_routing_logic()
     _reset_service_discovery()
+    _reset_engine_health_board()
 
 
 def test_router_injects_request_id_and_traceparent(reset_singletons):
