@@ -95,6 +95,14 @@ TRACE = os.environ.get("PST_BENCH_TRACE", "0") == "1"
 # fixed-K control for the chip-window A/B. Slots:
 # BENCH_SWEEP_elastic.json (on) vs the matching @noelastic control
 ELASTIC = os.environ.get("PST_BENCH_ELASTIC", "1") == "1"
+# unified ragged prefill+decode dispatch (engine ragged_dispatch):
+# mixed rounds run prefill-chunk lanes and fused decode lanes in ONE
+# lane-typed device program — the interleave throttle and the
+# admission-K clamp for in-round prefill work dissolve. Default ON
+# (the engine default); @noragged pins the split alternating rounds
+# as the attribution control. Slots: BENCH_SWEEP_ragged.json (on) vs
+# the matching @noragged control
+RAGGED = os.environ.get("PST_BENCH_RAGGED", "1") == "1"
 # KV tiering workload (@kvoff): cap the HBM pool so the multi-round
 # working set churns through the cpu/disk offload tiers — the zero-stall
 # async export/staged-restore measurement. PST_BENCH_KV_BLOCKS overrides
@@ -213,7 +221,13 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
         base, *mods = label.split("@")
         overrides: dict[str, str] = {}
         for m in mods:
-            if m.startswith("qps"):
+            # exact-keyword modifiers FIRST: @ragged would otherwise
+            # match the r<N> rounds prefix rule below
+            if m == "ragged":
+                overrides["PST_BENCH_RAGGED"] = "1"
+            elif m == "noragged":
+                overrides["PST_BENCH_RAGGED"] = "0"
+            elif m.startswith("qps"):
                 overrides["PST_BENCH_QPS"] = str(float(m[3:]))
             elif m.startswith("chunk"):
                 overrides["PST_BENCH_PREFILL_CHUNK"] = str(int(m[5:]))
@@ -239,7 +253,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
-                    "| trace | elastic | noelastic | kvoff | synckv"
+                    "| trace | elastic | noelastic | ragged | noragged "
+                    "| kvoff | synckv"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -259,7 +274,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
                 "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
-                "|@noelastic|@kvoff|@synckv]"
+                "|@noelastic|@ragged|@noragged|@kvoff|@synckv]"
             )
         configs.append((
             label,
@@ -522,6 +537,9 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         # fixed-K control (the pre-elastic behavior) for attribution
         device_stop=ELASTIC,
         adaptive_decode_k=ELASTIC,
+        # unified ragged dispatch A/B: @noragged pins the split
+        # alternating prefill/decode rounds for attribution
+        ragged_dispatch=RAGGED,
         async_decode=async_decode,
         prefetch_decode=PREFETCH,
         prefill_pipeline=PREFILL_PIPELINE,
@@ -662,6 +680,25 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                 ndisp += rnr.precompile_decode(
                     decode_ctxs, kk, chained=chained, stop=stop,
                 )
+            if RAGGED and not async_decode:
+                # mixed rounds here pair resume-tail prefill lanes with
+                # decode lanes in the same session-length regime: warm
+                # the small lane-mix buckets on the decode-ctx diagonal
+                # (resubmission bursts are mostly 1-2 lanes; bigger
+                # mixes and off-diagonal ctx pairs compile on first use
+                # and are cheap on restart via JAX_COMPILATION_CACHE_DIR)
+                from production_stack_tpu.engine.scheduler import (
+                    decode_k_buckets,
+                )
+
+                ndisp += rnr.precompile_ragged(
+                    [max(1, c - sched_steps + 1) for c in decode_ctxs],
+                    decode_k_buckets(sched_steps, ELASTIC),
+                    min(2, prefill_seqs),
+                    PREFILL_CHUNK,
+                    stop=ELASTIC,
+                    chained=PREFETCH,
+                )
         print(
             f"# prefill precompile: {ndisp} dispatches in "
             f"{time.time() - t0:.1f}s",
@@ -729,7 +766,10 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                         sampling_params=sp,
                     )
                     submit_t[nrid] = now
-        if engine.last_step_kind == "decode":
+        if engine.last_step_kind in ("decode", "ragged"):
+            # ragged rounds generate decode tokens too; their wall time
+            # includes the fused prefill lanes BY DESIGN (the unified
+            # round is the thing being measured)
             gen_tokens += sum(len(o.new_token_ids) for o in outs)
             decode_time += dt
     total_time = time.time() - t_start
@@ -827,6 +867,28 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                     engine._decode_overshoot_tokens_total,
                 "early_exit_rounds":
                     engine._decode_early_exit_rounds_total,
+            },
+            # unified ragged dispatch attribution (@ragged/@noragged):
+            # fused lane-typed rounds, their lane-mix distribution
+            # ("p<prefill>+d<decode>" per fused round), the share of
+            # rounds that carried prefill lanes, split-execution
+            # fallbacks (exotic lanes), and ragged h2d-staging
+            # effectiveness
+            "ragged_dispatch": {
+                "enabled": RAGGED,
+                "ragged_rounds": engine._ragged_rounds_total,
+                "split_rounds": engine._ragged_split_rounds_total,
+                "lane_mix_hist": dict(sorted(
+                    engine._ragged_lane_mix_hist.items()
+                )),
+                # of all rounds that decoded, how many also carried
+                # prefill lanes (ragged rounds tick decode_rounds too)
+                "prefill_lane_share": round(
+                    engine._ragged_rounds_total
+                    / max(1, engine._decode_rounds_total), 3,
+                ),
+                "staged_hits": engine._ragged_staged_hits_total,
+                "staged_misses": engine._ragged_staged_misses_total,
             },
             # zero-stall KV tiering attribution (@kvoff): export time is
             # offload-worker wall (overlapped), restore time is

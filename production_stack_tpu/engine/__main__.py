@@ -104,6 +104,16 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false",
                    help="serial per-array prefill uploads (the "
                         "pre-pipeline path; bench attribution control)")
+    p.add_argument("--ragged-dispatch", action="store_true",
+                   default=True,
+                   help="unified ragged prefill+decode rounds: when "
+                        "prefill chunks and decode lanes are both "
+                        "ready, dispatch them as ONE lane-typed device "
+                        "program — no prefill/decode interleave wait")
+    p.add_argument("--no-ragged-dispatch", dest="ragged_dispatch",
+                   action="store_false",
+                   help="split alternating prefill/decode rounds (the "
+                        "pre-ragged path; bench attribution control)")
     p.add_argument("--precompile-serving", action="store_true",
                    default=False,
                    help="compile every steady-state prefill/decode "
@@ -224,6 +234,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         precompile_serving=args.precompile_serving,
         prefetch_decode=args.prefetch_decode,
         prefill_pipeline=args.prefill_pipeline,
+        ragged_dispatch=args.ragged_dispatch,
         num_speculative_tokens=args.num_speculative_tokens,
         ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,

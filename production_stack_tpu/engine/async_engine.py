@@ -205,6 +205,11 @@ class AsyncLLMEngine:
         Lock-free: same GIL-atomic deque contract as the KV drain."""
         return self.engine.drain_decode_k_observations()
 
+    def drain_ragged_observations(self) -> list[int]:
+        """Ragged lane-mix observations (tpu:ragged_lane_mix) since the
+        last drain. Lock-free: same GIL-atomic deque contract."""
+        return self.engine.drain_ragged_observations()
+
     @property
     def tokenizer(self):
         return self.engine.tokenizer

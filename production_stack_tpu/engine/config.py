@@ -111,6 +111,21 @@ class EngineConfig:
     # pre-pipeline per-array upload path (--no-prefill-pipeline, the
     # bench attribution control).
     prefill_pipeline: bool = True
+    # unified ragged prefill+decode dispatch (Ragged Paged Attention
+    # role, PAPERS.md): when a round has BOTH mid-prefill runners and
+    # decode-ready lanes, the scheduler plans ONE lane-typed round
+    # (scheduler.plan_ragged_round) and the engine dispatches ONE
+    # device program (model_runner.ragged_dispatch) whose packed h2d
+    # buffer carries prefill-chunk lanes and fused decode lanes
+    # together — the prefill/decode interleave throttle and the
+    # admission-K clamp for in-round prefill work dissolve, a waiting
+    # prompt's chunk runs in the very next round, and the decode half
+    # keeps the device stop masks + staged h2d prefetch. Tokens are
+    # bit-identical to the split path (tests/test_ragged_dispatch.py).
+    # False (--no-ragged-dispatch) keeps the split alternating rounds
+    # as the bench attribution control; multihost engines, async-
+    # chained decode, and meshed (tp/pp) engines always split.
+    ragged_dispatch: bool = True
     # compile every steady-state serving program shape at startup
     # (full-chunk + resume-tail prefill, packed groups, fused-K decode,
     # per ctx bucket) so no XLA compile lands inside a live request's

@@ -184,6 +184,38 @@ class LLMEngine:
         self._pf_staged_hits_total = 0
         self._pf_staged_misses_total = 0
         self._pf_chained_chunks_total = 0
+        # unified ragged prefill+decode dispatch: mixed rounds run as
+        # ONE lane-typed device program (model_runner.ragged_dispatch);
+        # the scheduler plans them (plan_ragged_round) instead of
+        # alternating behind the interleave. Multihost is out (the
+        # broadcast wire ships host argument lists), async-chained
+        # decode is out (the chain commits round N+1 before round N's
+        # lane mix is known), and meshed engines are out (the fused
+        # buffer is a committed single-device transfer — same rule as
+        # the prefill pipeline / decode prefetch staging).
+        self._ragged_dispatch = (
+            config.ragged_dispatch
+            and not config.multihost
+            and not self._async_decode
+            and self.runner.mesh is None
+        )
+        self.scheduler.config.ragged_dispatch = self._ragged_dispatch
+        # staged NEXT ragged round (h2d prefetch): fingerprint-validated
+        # like _staged_decode/_staged_prefill; a lane-mix change between
+        # stage and dispatch is a counted miss, never a dispatch error
+        self._staged_ragged: dict | None = None
+        self._ragged_staged_hits_total = 0
+        self._ragged_staged_misses_total = 0
+        # ragged accounting: rounds dispatched fused, rounds a mixed
+        # plan had to run split (exotic lanes: prompt_logprobs,
+        # host-sampled finals, near-budget guided), per-round lane-mix
+        # observations (prefill lanes per fused round — drained into
+        # the tpu:ragged_lane_mix histogram), and lane totals
+        self._ragged_rounds_total = 0
+        self._ragged_split_rounds_total = 0
+        self._ragged_prefill_lanes_total = 0
+        self._ragged_decode_lanes_total = 0
+        self._ragged_lane_mix_hist: dict[str, int] = {}
         # speculative decoding works under multihost too: verify_batch
         # is part of the broadcast protocol (multihost_engine.py), so
         # followers replay the same packed verify host 0 dispatches
@@ -244,6 +276,9 @@ class LLMEngine:
         # chosen-K per decode round, drained into the tpu:decode_k
         # histogram by the server's stats loop (appends/pops GIL-atomic)
         self._decode_k_obs: _deque = _deque(maxlen=4096)
+        # prefill-lane count per fused ragged round, drained into the
+        # tpu:ragged_lane_mix histogram (appends/pops GIL-atomic)
+        self._ragged_obs: _deque = _deque(maxlen=4096)
         self._kv_export_seconds_total = 0.0
         self._kv_export_blocks_total = 0
         self._kv_export_bytes_total = 0
@@ -1088,6 +1123,12 @@ class LLMEngine:
             # transfer; under a mesh jit would have to reshard it
         if self.scheduler.waiting:
             return False  # admission will change the lane set
+        if self._ragged_dispatch and any(
+            not s.prefill_done for s in self.scheduler.running
+        ):
+            return False  # the next round is lane-typed (ragged): the
+            # ragged stage covers it; a pure-decode stage would only
+            # be dropped at the next schedule()
         if any(self._is_guided(s) for s in seqs):
             return False  # per-round DFA state re-init (see _can_chain)
         return self._reserve_next_round(seqs, k)
@@ -1134,6 +1175,7 @@ class LLMEngine:
         self, seqs: list[Sequence], toks: np.ndarray, k: int,
         lps: tuple | None = None,
         valid: np.ndarray | None = None,
+        round_attrs: dict | None = None,
     ) -> None:
         """Apply a fused-K round's (k, b) sampled tokens — the ONE copy
         of the bookkeeping both the sync and async paths share.
@@ -1177,10 +1219,11 @@ class LLMEngine:
                         ],
                     }
                 self._append_token(seq, int(toks[i, j]), entry)
-        self._note_decode_round(seqs, k)
+        self._note_decode_round(seqs, k, extra_attrs=round_attrs)
 
     def _note_decode_round(
-        self, seqs: list[Sequence], k: int
+        self, seqs: list[Sequence], k: int,
+        extra_attrs: dict | None = None,
     ) -> None:
         """Per-round elastic-decode accounting — the ONE copy shared
         by the fused path (_apply_multi_tokens) and the single-step
@@ -1194,7 +1237,14 @@ class LLMEngine:
         self._decode_k_obs.append(k)
         if self._tl_enabled:
             lanes_done = sum(1 for s in seqs if s.finished)
-            attrs = {"k_chosen": k, "lanes_done": lanes_done}
+            # lane-mix attribution: a split-path decode round carries
+            # no prefill lanes; ragged rounds override via extra_attrs
+            attrs = {
+                "k_chosen": k, "lanes_done": lanes_done,
+                "prefill_lanes": 0, "decode_lanes": len(seqs),
+            }
+            if extra_attrs:
+                attrs.update(extra_attrs)
             for seq in seqs:
                 if not seq.finished:
                     self.timeline.decode_round(
@@ -1266,8 +1316,20 @@ class LLMEngine:
         if sched_out.preempted or sched_out.prefills or sched_out.aborted:
             # any table free/reassignment or lane-set change invalidates
             # the staged prefetch (the epoch in the fingerprint already
-            # guarantees this; dropping early frees the device buffer)
+            # guarantees this; dropping early frees the device buffer).
+            # Exception: a RAGGED round's staged buffer expects prefill
+            # lanes — it is validated (or miss-counted) in _step_ragged
             self._staged_decode = None
+        if self._staged_ragged is not None and (
+            sched_out.preempted or sched_out.aborted
+            or not sched_out.is_ragged
+        ):
+            # the staged lane mix did not materialize (a table was
+            # freed, prefill drained, or the round went pure): a COUNTED
+            # staging miss — the fingerprint/total-length checks would
+            # refuse the buffer anyway, never a dispatch error
+            self._ragged_staged_misses_total += 1
+            self._staged_ragged = None
         if sched_out.preempted:
             # same rule for the staged PREFILL buffer: preemption frees
             # tables that can be re-handed. (Admission ABORTS don't
@@ -1284,7 +1346,9 @@ class LLMEngine:
             self.scheduler.staged_prefill_ready = False
         self._preemptions_total += len(sched_out.preempted)
         self.last_step_kind = (
-            "prefill"
+            "ragged"
+            if sched_out.is_ragged
+            else "prefill"
             if sched_out.prefills
             else "decode"
             if sched_out.decode is not None
@@ -1310,7 +1374,14 @@ class LLMEngine:
             self.timeline.finish(seq.request_id, seq.finish_reason)
 
         stepped: list[Sequence] = []
-        if sched_out.prefills:
+        if sched_out.is_ragged:
+            # unified ragged dispatch: prefill-chunk lanes + the decode
+            # batch in ONE lane-typed device round (split execution for
+            # lane sets the fused program cannot express)
+            stepped.extend(
+                self._step_ragged(sched_out.prefills, sched_out.decode)
+            )
+        elif sched_out.prefills:
             # pipelined prefill: a buffer staged in an earlier round may
             # cover this dispatch (validated by fingerprint inside
             # _run_prefill_works); afterwards, a cold group's remaining
@@ -1351,201 +1422,605 @@ class LLMEngine:
                     stepped.extend(spec)
                     outputs.extend(self._finalize_stepped(stepped))
                     return outputs
-            tokens = [s.all_token_ids[-1] for s in seqs]
-            positions = [s.num_tokens - 1 for s in seqs]
-            tables = [s.block_table for s in seqs]
-            ctx_lens = [s.num_tokens for s in seqs]
-            # elastic fused decode: the scheduler sized this round
-            # (pow2 bucket <= num_scheduler_steps, clamped under
-            # admission pressure / the batch's remaining budget); with
-            # adaptive K off this IS num_scheduler_steps
-            k_steps = sched_out.decode.k
-            # guided lanes ride the fused multi-step scan via on-device
-            # TokenDFA tables (structured.TokenDFA — outlines-style
-            # FSM-index compilation); only constraints too large to
-            # compile under budget fall back to the host-masked
-            # single-step path below
-            guided_tables = None
-            needs_guided = any(self._is_guided(s) for s in seqs)
-            if needs_guided and k_steps > 1:
-                # leave the fused path when any guided lane is close to
-                # its token budget: the final steps need budget-aware
-                # completion steering (_steer_allowed), which only the
-                # host-masked path evaluates. Parity with K=1 holds —
-                # unsteered steps mask identically on both paths.
-                near_budget = any(
-                    self._is_guided(s)
-                    and (s.sampling_params.max_tokens
-                         - len(s.generated_token_ids))
-                    <= k_steps + self.GUIDED_STEER_BOUND
-                    for s in seqs
-                )
-                if not near_budget:
-                    guided_tables = self._device_guided_tables(seqs)
-            if k_steps > 1 and (not needs_guided
-                                or guided_tables is not None):
-                temps, top_ps, top_ks, min_ps, keys, needs_pen = (
-                    self._sampling_arrays(seqs)
-                )
-                penalties = None
-                if needs_pen:
-                    # token-count state rides on device through the scan;
-                    # only the compact generated-id lists cross the bus
-                    pres = np.zeros((len(seqs),), np.float32)
-                    freq = np.zeros((len(seqs),), np.float32)
-                    rep = np.ones((len(seqs),), np.float32)
-                    for i, s in enumerate(seqs):
-                        pres[i] = s.sampling_params.presence_penalty
-                        freq[i] = s.sampling_params.frequency_penalty
-                        rep[i] = s.sampling_params.repetition_penalty
-                    penalties = (
-                        [list(s.generated_token_ids) for s in seqs],
-                        pres, freq, rep,
-                    )
-                want_lp = any(
-                    s.sampling_params.logprobs is not None for s in seqs
-                )
-                bias = self._bias_arrays(seqs)
-                will_async = (
-                    self._async_decode and penalties is None
-                    and guided_tables is None and bias is None
-                )
-                # device-side stop masks: not on async-chained rounds —
-                # the chain commits round N+1 before round N's valid
-                # counts are known, so a mid-round freeze would leave
-                # the chained dispatch running on a pad token
-                stop = (
-                    self._stop_arrays(seqs)
-                    if self._device_stop and not will_async else None
-                )
-                staged_kw = {}
-                st = self._staged_decode
-                self._staged_decode = None
-                if st is not None:
-                    if (penalties is None and bias is None
-                            and guided_tables is None
-                            and st["fp"] == self._stage_fingerprint(
-                                seqs, k_steps)):
-                        # the prediction held: dispatch chained on the
-                        # previous round's on-device tokens with the
-                        # pre-uploaded packed buffer — zero serial h2d
-                        staged_kw = {"staged": st["handle"]}
-                        tokens = st["chain_tokens"]
-                        self._staged_hits_total += 1
-                    else:
-                        self._staged_misses_total += 1
-                # fused on-device decode+sample loop: K tokens per
-                # dispatch, ONE device->host fetch (the per-step RTT is
-                # the serving bottleneck through remote/tunneled chips)
-                # stop rides a conditional kwarg: the multihost runner
-                # wrapper replays host token lists and knows no stop
-                # masks (and _device_stop is already off there)
-                stop_kw = {"stop": stop} if stop is not None else {}
-                ys = self.runner.decode_multi(
-                    tokens, positions, tables, ctx_lens, k_steps,
-                    temps, top_ps, top_ks, keys, min_ps=min_ps,
-                    lora_slots=[self._lora_slot(s) for s in seqs],
-                    penalties=penalties,
-                    want_logprobs=want_lp,
-                    guided=guided_tables,
-                    logit_bias=bias,
-                    **stop_kw,
-                    **staged_kw,
-                )  # (k, b) on device [+ logprob arrays] [+ valid]
-                valid_dev = None
-                if stop is not None:
-                    toks_dev = ys[0]
-                    valid_dev = ys[-1]
-                    lps_dev = ys[1:-1] if want_lp else None
-                else:
-                    toks_dev, lps_dev = (
-                        (ys[0], ys[1:]) if want_lp else (ys, None)
-                    )
-                if will_async:
-                    # start the double-buffered pipeline: leave the
-                    # tokens on device; the NEXT step dispatches the
-                    # following round before fetching this one
-                    self._pending_decode = {
-                        "seqs": seqs, "toks": toks_dev, "k": k_steps,
-                        "lps": lps_dev,
-                    }
-                    return outputs
-                if (self._prefetch_decode and penalties is None
-                        and guided_tables is None and bias is None
-                        and self._can_stage(seqs, k_steps)):
-                    # upload round N+1's predicted inputs NOW — the
-                    # transfer rides out the fetch below; validated by
-                    # fingerprint before the next dispatch uses it
-                    nk = keys.copy()
-                    nk[:, 1] += k_steps
-                    # predict the NEXT round's adaptive K; capped at
-                    # this round's K because _reserve_next_round only
-                    # grew the block tables to cover 2*k positions
-                    k_next = min(
-                        self.scheduler.pick_decode_k(
-                            seqs, advance=k_steps),
-                        k_steps,
-                    )
-                    stage_stop = None
-                    if stop is not None:
-                        # the countdowns advance with the k tokens this
-                        # round will apply (a lane that freezes earlier
-                        # breaks the fingerprint, so the stale stage is
-                        # never dispatched)
-                        stage_stop = (
-                            stop[0],
-                            np.maximum(stop[1] - k_steps, 0),
-                            stop[2] - k_steps,
-                            stop[3],
-                        )
-                    self._staged_decode = {
-                        "fp": self._stage_fingerprint(
-                            seqs, k_next, advance=k_steps),
-                        "handle": self.runner.stage_decode_multi(
-                            [s.num_tokens - 1 + k_steps for s in seqs],
-                            [s.block_table for s in seqs],
-                            [s.num_tokens + k_steps for s in seqs],
-                            k_next, temps, top_ps, top_ks, nk,
-                            min_ps=min_ps, stop=stage_stop,
-                        ),
-                        "chain_tokens": toks_dev[-1],
-                    }
-                self._apply_multi_tokens(
-                    seqs, np.asarray(toks_dev), k_steps,
-                    lps=tuple(np.asarray(a) for a in lps_dev)
-                    if lps_dev else None,
-                    valid=(
-                        np.asarray(valid_dev)
-                        if valid_dev is not None else None
-                    ),
-                )
-                stepped.extend(seqs)
-            else:
-                logits = self.runner.decode(
-                    tokens, positions, tables, ctx_lens,
-                    lora_slots=[self._lora_slot(s) for s in seqs],
-                )
-                sampled, used_logits = self._sample(
-                    seqs, logits[: len(seqs)], return_logits=True
-                )
-                used_logits = np.asarray(used_logits)
-                for i, (seq, token) in enumerate(zip(seqs, sampled)):
-                    seq.num_computed_tokens = seq.num_tokens
-                    entry = None
-                    if seq.sampling_params.logprobs is not None:
-                        entry = self._host_logprob_entry(
-                            used_logits[i], int(token),
-                            seq.sampling_params.logprobs,
-                        )
-                    self._append_token(seq, int(token), entry)
-                    stepped.append(seq)
-                # adaptive K can size a round down to 1 (single token
-                # left / admission pressure): those rounds belong in the
-                # tpu:decode_k histogram too
-                self._note_decode_round(seqs, 1)
+            stepped.extend(
+                self._run_decode_round(seqs, sched_out.decode.k)
+            )
 
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
+
+    def _run_decode_round(
+        self, seqs: list[Sequence], k_steps: int
+    ) -> list[Sequence]:
+        """Dispatch one decode round over `seqs` (the body of the
+        decode step, shared by the split path and the ragged round's
+        split-execution fallback): the fused K-step on-device path when
+        the batch supports it, the host-sampled single-step path
+        otherwise. Returns the stepped sequences (empty when the round
+        went async — resolution happens on a later step)."""
+        stepped: list[Sequence] = []
+        tokens = [s.all_token_ids[-1] for s in seqs]
+        positions = [s.num_tokens - 1 for s in seqs]
+        tables = [s.block_table for s in seqs]
+        ctx_lens = [s.num_tokens for s in seqs]
+        # guided lanes ride the fused multi-step scan via on-device
+        # TokenDFA tables (structured.TokenDFA — outlines-style
+        # FSM-index compilation); only constraints too large to
+        # compile under budget fall back to the host-masked
+        # single-step path below
+        guided_tables = None
+        needs_guided = any(self._is_guided(s) for s in seqs)
+        if needs_guided and k_steps > 1:
+            # leave the fused path when any guided lane is close to
+            # its token budget: the final steps need budget-aware
+            # completion steering (_steer_allowed), which only the
+            # host-masked path evaluates. Parity with K=1 holds —
+            # unsteered steps mask identically on both paths.
+            near_budget = any(
+                self._is_guided(s)
+                and (s.sampling_params.max_tokens
+                     - len(s.generated_token_ids))
+                <= k_steps + self.GUIDED_STEER_BOUND
+                for s in seqs
+            )
+            if not near_budget:
+                guided_tables = self._device_guided_tables(seqs)
+        if k_steps > 1 and (not needs_guided
+                            or guided_tables is not None):
+            temps, top_ps, top_ks, min_ps, keys, needs_pen = (
+                self._sampling_arrays(seqs)
+            )
+            # token-count state rides on device through the scan; only
+            # the compact generated-id lists cross the bus
+            penalties = self._penalty_args(seqs) if needs_pen else None
+            want_lp = any(
+                s.sampling_params.logprobs is not None for s in seqs
+            )
+            bias = self._bias_arrays(seqs)
+            will_async = (
+                self._async_decode and penalties is None
+                and guided_tables is None and bias is None
+            )
+            # device-side stop masks: not on async-chained rounds —
+            # the chain commits round N+1 before round N's valid
+            # counts are known, so a mid-round freeze would leave
+            # the chained dispatch running on a pad token
+            stop = (
+                self._stop_arrays(seqs)
+                if self._device_stop and not will_async else None
+            )
+            staged_kw = {}
+            st = self._staged_decode
+            self._staged_decode = None
+            if st is not None:
+                if (penalties is None and bias is None
+                        and guided_tables is None
+                        and st["fp"] == self._stage_fingerprint(
+                            seqs, k_steps)):
+                    # the prediction held: dispatch chained on the
+                    # previous round's on-device tokens with the
+                    # pre-uploaded packed buffer — zero serial h2d
+                    staged_kw = {"staged": st["handle"]}
+                    tokens = st["chain_tokens"]
+                    self._staged_hits_total += 1
+                else:
+                    self._staged_misses_total += 1
+            # fused on-device decode+sample loop: K tokens per
+            # dispatch, ONE device->host fetch (the per-step RTT is
+            # the serving bottleneck through remote/tunneled chips)
+            # stop rides a conditional kwarg: the multihost runner
+            # wrapper replays host token lists and knows no stop
+            # masks (and _device_stop is already off there)
+            stop_kw = {"stop": stop} if stop is not None else {}
+            ys = self.runner.decode_multi(
+                tokens, positions, tables, ctx_lens, k_steps,
+                temps, top_ps, top_ks, keys, min_ps=min_ps,
+                lora_slots=[self._lora_slot(s) for s in seqs],
+                penalties=penalties,
+                want_logprobs=want_lp,
+                guided=guided_tables,
+                logit_bias=bias,
+                **stop_kw,
+                **staged_kw,
+            )  # (k, b) on device [+ logprob arrays] [+ valid]
+            valid_dev = None
+            if stop is not None:
+                toks_dev = ys[0]
+                valid_dev = ys[-1]
+                lps_dev = ys[1:-1] if want_lp else None
+            else:
+                toks_dev, lps_dev = (
+                    (ys[0], ys[1:]) if want_lp else (ys, None)
+                )
+            if will_async:
+                # start the double-buffered pipeline: leave the
+                # tokens on device; the NEXT step dispatches the
+                # following round before fetching this one
+                self._pending_decode = {
+                    "seqs": seqs, "toks": toks_dev, "k": k_steps,
+                    "lps": lps_dev,
+                }
+                return stepped
+            if (self._prefetch_decode and penalties is None
+                    and guided_tables is None and bias is None
+                    and self._can_stage(seqs, k_steps)):
+                # upload round N+1's predicted inputs NOW — the
+                # transfer rides out the fetch below; validated by
+                # fingerprint before the next dispatch uses it
+                nk = keys.copy()
+                nk[:, 1] += k_steps
+                # predict the NEXT round's adaptive K; capped at
+                # this round's K because _reserve_next_round only
+                # grew the block tables to cover 2*k positions
+                k_next = min(
+                    self.scheduler.pick_decode_k(
+                        seqs, advance=k_steps),
+                    k_steps,
+                )
+                stage_stop = None
+                if stop is not None:
+                    # the countdowns advance with the k tokens this
+                    # round will apply (a lane that freezes earlier
+                    # breaks the fingerprint, so the stale stage is
+                    # never dispatched)
+                    stage_stop = (
+                        stop[0],
+                        np.maximum(stop[1] - k_steps, 0),
+                        stop[2] - k_steps,
+                        stop[3],
+                    )
+                self._staged_decode = {
+                    "fp": self._stage_fingerprint(
+                        seqs, k_next, advance=k_steps),
+                    "handle": self.runner.stage_decode_multi(
+                        [s.num_tokens - 1 + k_steps for s in seqs],
+                        [s.block_table for s in seqs],
+                        [s.num_tokens + k_steps for s in seqs],
+                        k_next, temps, top_ps, top_ks, nk,
+                        min_ps=min_ps, stop=stage_stop,
+                    ),
+                    "chain_tokens": toks_dev[-1],
+                }
+            self._apply_multi_tokens(
+                seqs, np.asarray(toks_dev), k_steps,
+                lps=tuple(np.asarray(a) for a in lps_dev)
+                if lps_dev else None,
+                valid=(
+                    np.asarray(valid_dev)
+                    if valid_dev is not None else None
+                ),
+            )
+            stepped.extend(seqs)
+        else:
+            logits = self.runner.decode(
+                tokens, positions, tables, ctx_lens,
+                lora_slots=[self._lora_slot(s) for s in seqs],
+            )
+            sampled, used_logits = self._sample(
+                seqs, logits[: len(seqs)], return_logits=True
+            )
+            used_logits = np.asarray(used_logits)
+            for i, (seq, token) in enumerate(zip(seqs, sampled)):
+                seq.num_computed_tokens = seq.num_tokens
+                entry = None
+                if seq.sampling_params.logprobs is not None:
+                    entry = self._host_logprob_entry(
+                        used_logits[i], int(token),
+                        seq.sampling_params.logprobs,
+                    )
+                self._append_token(seq, int(token), entry)
+                stepped.append(seq)
+            # adaptive K can size a round down to 1 (single token
+            # left / admission pressure): those rounds belong in the
+            # tpu:decode_k histogram too
+            self._note_decode_round(seqs, 1)
+        return stepped
+
+    # -- unified ragged prefill+decode rounds -------------------------------
+    def _penalty_args(self, seqs: list[Sequence]) -> tuple:
+        """(gen_lists, presence, frequency, repetition) penalty inputs
+        for the fused decode scan — shared by _run_decode_round and the
+        ragged dispatch path."""
+        pres = np.zeros((len(seqs),), np.float32)
+        freq = np.zeros((len(seqs),), np.float32)
+        rep = np.ones((len(seqs),), np.float32)
+        for i, s in enumerate(seqs):
+            pres[i] = s.sampling_params.presence_penalty
+            freq[i] = s.sampling_params.frequency_penalty
+            rep[i] = s.sampling_params.repetition_penalty
+        return (
+            [list(s.generated_token_ids) for s in seqs],
+            pres, freq, rep,
+        )
+
+    def _needs_host_first_sample(self, s: Sequence) -> bool:
+        """A final prefill chunk whose first token cannot be taken from
+        the on-device sample: guided masks, logit_bias, or non-empty
+        penalty state after a preemption recompute."""
+        sp = s.sampling_params
+        if self._is_guided(s):
+            return True  # first token must be masked
+        if sp.logit_bias:
+            return True  # on-device sample knows no bias
+        return bool(s.generated_token_ids) and (
+            sp.presence_penalty != 0.0
+            or sp.frequency_penalty != 0.0
+            or sp.repetition_penalty != 1.0
+        )
+
+    def _ragged_prefill_fusable(self, works: list[PrefillWork]) -> bool:
+        """Prefill lanes the fused ragged program can serve: packed
+        chunks with on-device last-row sampling. prompt_logprobs lanes
+        (per-row host fetches serialize anyway) and finals needing host
+        sampling run the round split instead — same outputs, two
+        dispatches."""
+        for w in works:
+            if w.seq.sampling_params.prompt_logprobs is not None:
+                return False
+            if w.is_last_chunk and self._needs_host_first_sample(w.seq):
+                return False
+        return True
+
+    def _step_ragged(
+        self, works: list[PrefillWork], dwork
+    ) -> list[Sequence]:
+        """Execute one planned lane-typed round: prefill-chunk lanes +
+        the decode batch in ONE device dispatch when every lane is
+        fusable, else split execution of the SAME plan (both halves
+        still run this engine step, so the no-interleave-wait
+        scheduling contract holds either way)."""
+        seqs = dwork.seqs
+        k_steps = dwork.k
+        # decode-half gates mirror _run_decode_round's fused path; the
+        # ragged program additionally fuses k=1 rounds (host sampling
+        # is only needed for near-budget guided steering and
+        # constraints too large to compile)
+        guided_tables = None
+        needs_guided = any(self._is_guided(s) for s in seqs)
+        fusable = True
+        if needs_guided:
+            near_budget = any(
+                self._is_guided(s)
+                and (s.sampling_params.max_tokens
+                     - len(s.generated_token_ids))
+                <= k_steps + self.GUIDED_STEER_BOUND
+                for s in seqs
+            )
+            if near_budget:
+                fusable = False
+            else:
+                guided_tables = self._device_guided_tables(seqs)
+                fusable = guided_tables is not None
+        if fusable:
+            fusable = self._ragged_prefill_fusable(works)
+        if not fusable:
+            self._ragged_split_rounds_total += 1
+            if self._staged_ragged is not None:
+                # the staged buffer expects the fused program: counted
+                # staging miss, never a dispatch error
+                self._ragged_staged_misses_total += 1
+                self._staged_ragged = None
+            stepped = self._run_prefill_works(works)
+            stepped.extend(self._run_decode_round(seqs, k_steps))
+            return stepped
+        return self._dispatch_ragged(works, seqs, k_steps, guided_tables)
+
+    def _dispatch_ragged(
+        self,
+        works: list[PrefillWork],
+        seqs: list[Sequence],
+        k_steps: int,
+        guided_tables: tuple | None,
+    ) -> list[Sequence]:
+        """The fused lane-typed round: one packed h2d buffer, one
+        dispatch, prefill bookkeeping + the shared fused-decode
+        bookkeeping afterwards. The h2d-prefetch stage for the NEXT
+        round starts before any fetch so its upload overlaps."""
+        now = time.time()
+        if self._staged_prefill is not None:
+            # a pure-prefill round staged ahead but the round went
+            # lane-typed instead: the prefill stage cannot be consumed
+            # here — counted miss, fingerprint would refuse it later
+            self._pf_staged_misses_total += 1
+            self._staged_prefill = None
+            self.scheduler.staged_prefill_ready = False
+        for w in works:
+            if w.seq.metrics.first_scheduled_time is None:
+                w.seq.metrics.first_scheduled_time = now
+        phase_snap = (
+            self.runner.phase_snapshot() if self._tl_enabled else None
+        )
+        seqs_w = [w.seq for w in works]
+        pf_sampling = self._sampling_arrays(seqs_w)[:5]
+        pf_chunks = [
+            w.seq.prompt_token_ids[
+                w.chunk_start : w.chunk_start + w.chunk_len
+            ]
+            for w in works
+        ]
+        pf_budgets = [
+            w.seq.num_prompt_tokens - (w.chunk_start + w.chunk_len)
+            for w in works
+        ]
+        temps, top_ps, top_ks, min_ps, keys, needs_pen = (
+            self._sampling_arrays(seqs)
+        )
+        penalties = self._penalty_args(seqs) if needs_pen else None
+        want_lp = any(
+            s.sampling_params.logprobs is not None for s in seqs
+        )
+        bias = self._bias_arrays(seqs)
+        stop = self._stop_arrays(seqs) if self._device_stop else None
+        tokens = [s.all_token_ids[-1] for s in seqs]
+        staged_kw = {}
+        st = self._staged_ragged
+        self._staged_ragged = None
+        if st is not None:
+            if (penalties is None and bias is None
+                    and guided_tables is None
+                    and st["fp"] == self._ragged_fingerprint(
+                        works, seqs, k_steps)):
+                # the prediction held: chain the decode lanes on the
+                # previous round's on-device tokens with the
+                # pre-uploaded lane-typed buffer — zero serial h2d
+                staged_kw = {"staged": st["handle"]}
+                tokens = st["chain_tokens"]
+                self._ragged_staged_hits_total += 1
+            else:
+                # lane-mix / state drift since the stage (and the
+                # runner additionally validates the staged buffer's
+                # total layout length): a counted staging miss — the
+                # dispatch rebuilds + uploads serially, never errors
+                self._ragged_staged_misses_total += 1
+        stop_kw = {"stop": stop} if stop is not None else {}
+        pf_sampled_dev, pf_logits_dev, ys = self.runner.ragged_dispatch(
+            pf_chunks,
+            [w.chunk_start for w in works],
+            [w.seq.block_table for w in works],
+            [w.chunk_start + w.chunk_len for w in works],
+            tokens,
+            [s.num_tokens - 1 for s in seqs],
+            [s.block_table for s in seqs],
+            [s.num_tokens for s in seqs],
+            k_steps,
+            temps, top_ps, top_ks, keys, min_ps=min_ps,
+            pf_sampling=pf_sampling,
+            pf_lora_slots=[self._lora_slot(w.seq) for w in works],
+            lora_slots=[self._lora_slot(s) for s in seqs],
+            penalties=penalties,
+            want_logprobs=want_lp,
+            guided=guided_tables,
+            logit_bias=bias,
+            pf_budgets=pf_budgets,
+            **stop_kw,
+            **staged_kw,
+        )
+        valid_dev = None
+        if stop is not None:
+            toks_dev = ys[0]
+            valid_dev = ys[-1]
+            lps_dev = ys[1:-1] if want_lp else None
+        else:
+            toks_dev, lps_dev = (
+                (ys[0], ys[1:]) if want_lp else (ys, None)
+            )
+        # stage the predicted NEXT ragged round before any fetch below
+        # so its upload overlaps this round's execution + fetch
+        self._maybe_stage_ragged(
+            works, seqs, k_steps, temps, top_ps, top_ks, keys, min_ps,
+            stop, penalties, bias, guided_tables, toks_dev,
+        )
+        stepped: list[Sequence] = []
+        for w in works:
+            w.seq.num_computed_tokens += w.chunk_len
+            self._prompt_tokens_total += w.chunk_len
+        if self._tl_enabled:
+            phases = self.runner.phase_delta(phase_snap)
+            for w in works:
+                self.timeline.event(
+                    w.seq.request_id, "prefill_chunk",
+                    {
+                        "chunk_start": w.chunk_start,
+                        "chunk_len": w.chunk_len,
+                        "last": w.is_last_chunk,
+                        "staged_hit": bool(staged_kw),
+                        "chained": False,
+                        "group_size": len(works),
+                        "ragged": True,
+                        "prefill_lanes": len(works),
+                        "decode_lanes": len(seqs),
+                        **(
+                            {"group_phase_s": phases} if phases else {}
+                        ),
+                    },
+                )
+        finals = [
+            (i, w) for i, w in enumerate(works) if w.is_last_chunk
+        ]
+        if finals:
+            tf = time.perf_counter()
+            toks_np = np.asarray(pf_sampled_dev)
+            self.runner._phase_add("fetch", time.perf_counter() - tf)
+            for i, w in finals:
+                tok = int(toks_np[i])
+                if tok < 0:
+                    # the device pins ONLY non-real lanes to the idle
+                    # sentinel; a real lane yielding it means the lane
+                    # packing drifted — fail this round loudly rather
+                    # than emitting a corrupt stream
+                    raise RuntimeError(
+                        f"ragged dispatch returned the idle-lane "
+                        f"sentinel for real prefill lane {i} "
+                        f"({w.seq.request_id})"
+                    )
+                entry = None
+                n = w.seq.sampling_params.logprobs
+                if n is not None:
+                    entry = self._host_logprob_entry(
+                        np.asarray(pf_logits_dev[i]), tok, n
+                    )
+                self._append_token(w.seq, tok, entry)
+                stepped.append(w.seq)
+        self._apply_multi_tokens(
+            seqs, np.asarray(toks_dev), k_steps,
+            lps=tuple(np.asarray(a) for a in lps_dev)
+            if lps_dev else None,
+            valid=(
+                np.asarray(valid_dev)
+                if valid_dev is not None else None
+            ),
+            round_attrs={
+                "prefill_lanes": len(works),
+                "decode_lanes": len(seqs),
+            },
+        )
+        stepped.extend(seqs)
+        self._note_ragged_round(len(works), len(seqs))
+        return stepped
+
+    def _predict_next_prefill_works(
+        self, works: list[PrefillWork]
+    ) -> list[PrefillWork]:
+        """Predicted chunk set for the round AFTER `works`, computed
+        BEFORE this round's bookkeeping lands (the ragged stage must
+        start while the dispatch is still in flight): each non-final
+        lane advances by its own chunk length."""
+        nxt: list[PrefillWork] = []
+        chunked = self.scheduler.config.enable_chunked_prefill
+        for w in works:
+            s = w.seq
+            if s.sampling_params.prompt_logprobs is not None:
+                continue
+            start = w.chunk_start + w.chunk_len
+            rem = s.num_prompt_tokens - start
+            if rem <= 0:
+                continue
+            clen = (
+                min(rem, self.scheduler.config.max_prefill_chunk)
+                if chunked else rem
+            )
+            nxt.append(PrefillWork(
+                seq=s, chunk_start=start, chunk_len=clen,
+            ))
+        return nxt
+
+    def _ragged_fingerprint(
+        self, works: list[PrefillWork], seqs: list[Sequence], k: int
+    ) -> tuple:
+        """State a staged ragged buffer was built for, as observed at
+        dispatch: the prefill lanes' fingerprint (chunk offsets, table
+        lengths, free epoch) + the decode lanes in order at exact token
+        counts + the round's K. Any lane-mix change — a prefill lane
+        finishing, a new admission, a different adaptive K — breaks
+        it, converting the stage into a counted miss."""
+        return (
+            self._prefill_fingerprint(works),
+            tuple(s.request_id for s in seqs),
+            tuple(s.num_tokens for s in seqs),
+            tuple(len(s.block_table) for s in seqs),
+            self.block_manager.free_epoch,
+            k,
+        )
+
+    def _maybe_stage_ragged(
+        self, works, seqs, k_steps, temps, top_ps, top_ks, keys,
+        min_ps, stop, penalties, bias, guided_tables, toks_dev,
+    ) -> None:
+        """Stage the PREDICTED next lane-typed round (h2d prefetch —
+        the PR 1/PR 5 staging pattern applied to the unified round):
+        prefill lanes advance by their chunk, decode lanes chain on
+        this round's on-device tokens advanced by K. Validated by
+        fingerprint + the runner's total-layout check before use."""
+        if not (self._prefetch_decode and self._prefill_pipeline):
+            return
+        if (penalties is not None or bias is not None
+                or guided_tables is not None):
+            return  # per-round host state does not chain
+        if self.scheduler.waiting:
+            return  # admission will change the lane set
+        if any(w.is_last_chunk for w in works):
+            # a finishing prefill lane migrates to the decode side
+            # next round: the lane mix changes by construction
+            return
+        nxt = self._predict_next_prefill_works(works)
+        if not nxt:
+            return
+        if not self._reserve_next_round(seqs, k_steps):
+            return
+        k_next = min(
+            self.scheduler.pick_decode_k(seqs, advance=k_steps),
+            k_steps,
+        )
+        nk = keys.copy()
+        nk[:, 1] += k_steps
+        stage_stop = None
+        if stop is not None:
+            stage_stop = (
+                stop[0],
+                np.maximum(stop[1] - k_steps, 0),
+                stop[2] - k_steps,
+                stop[3],
+            )
+        seqs_w = [w.seq for w in nxt]
+        pf_sampling = self._sampling_arrays(seqs_w)[:5]
+        handle = self.runner.stage_ragged(
+            [
+                w.seq.prompt_token_ids[
+                    w.chunk_start : w.chunk_start + w.chunk_len
+                ]
+                for w in nxt
+            ],
+            [w.chunk_start for w in nxt],
+            [w.seq.block_table for w in nxt],
+            [w.chunk_start + w.chunk_len for w in nxt],
+            pf_sampling,
+            [s.num_tokens - 1 + k_steps for s in seqs],
+            [s.block_table for s in seqs],
+            [s.num_tokens + k_steps for s in seqs],
+            k_next, temps, top_ps, top_ks, nk,
+            min_ps=min_ps, stop=stage_stop,
+            pf_budgets=[
+                w.seq.num_prompt_tokens
+                - (w.chunk_start + w.chunk_len)
+                for w in nxt
+            ],
+        )
+        self._staged_ragged = {
+            "fp": (
+                self._prefill_fingerprint(nxt),
+                tuple(s.request_id for s in seqs),
+                tuple(s.num_tokens + k_steps for s in seqs),
+                tuple(len(s.block_table) for s in seqs),
+                self.block_manager.free_epoch,
+                k_next,
+            ),
+            "handle": handle,
+            "chain_tokens": toks_dev[-1],
+        }
+
+    def _note_ragged_round(self, n_pf: int, n_dec: int) -> None:
+        """Fused lane-typed round accounting: tpu:ragged_rounds, the
+        lane-mix histogram feed, and the bench detail slot's totals."""
+        self._ragged_rounds_total += 1
+        self._ragged_prefill_lanes_total += n_pf
+        self._ragged_decode_lanes_total += n_dec
+        self._ragged_obs.append(n_pf)
+        key = f"p{n_pf}+d{n_dec}"
+        self._ragged_lane_mix_hist[key] = (
+            self._ragged_lane_mix_hist.get(key, 0) + 1
+        )
+
+    def drain_ragged_observations(self) -> list[int]:
+        """Prefill-lane counts of fused ragged rounds since the last
+        drain — feeds the server's tpu:ragged_lane_mix histogram
+        (deque pops GIL-atomic)."""
+        out: list[int] = []
+        while True:
+            try:
+                out.append(self._ragged_obs.popleft())
+            except IndexError:
+                break
+        return out
 
     # -- pipelined prefill --------------------------------------------------
     def _prefill_fingerprint(self, works: list[PrefillWork]) -> tuple:
@@ -1631,6 +2106,14 @@ class LLMEngine:
             return
         if self.scheduler.waiting:
             return  # the next group will include new admissions: miss
+        if self._ragged_dispatch and any(
+            s.prefill_done and not s.finished
+            for s in self.scheduler.running
+        ):
+            # a decode-ready lane exists (possibly made ready by THIS
+            # round's final chunk): the next round is lane-typed and
+            # consumes the RAGGED stage, never the prefill stage
+            return
         nxt = self._next_prefill_works(works)
         if not nxt:
             return
@@ -1822,6 +2305,11 @@ class LLMEngine:
                         "staged_hit": staged_hit,
                         "chained": chained,
                         "group_size": len(works),
+                        # lane-mix attribution (unified-round contract:
+                        # every prefill event says what rode with it —
+                        # the split path rides alone)
+                        "prefill_lanes": len(works),
+                        "decode_lanes": 0,
                         **(
                             {"group_phase_s": phases} if phases else {}
                         ),
@@ -1836,23 +2324,13 @@ class LLMEngine:
             # (s_pad, vocab) f32 logits. Only a post-preemption
             # sequence with active penalties (its generated history
             # is folded into the prompt, so penalty counts are
-            # non-empty at the "first" token) needs the logits.
-            def _needs_host_sample(s: Sequence) -> bool:
-                sp = s.sampling_params
-                if self._is_guided(s):
-                    return True  # first token must be masked
-                if sp.logit_bias:
-                    return True  # on-device sample knows no bias
-                return bool(s.generated_token_ids) and (
-                    sp.presence_penalty != 0.0
-                    or sp.frequency_penalty != 0.0
-                    or sp.repetition_penalty != 1.0
-                )
-
+            # non-empty at the "first" token) needs the logits
+            # (_needs_host_first_sample — shared with the ragged
+            # round's fusability gate).
             pen = [(i, w) for i, w in finals
-                   if _needs_host_sample(w.seq)]
+                   if self._needs_host_first_sample(w.seq)]
             clean = [(i, w) for i, w in finals
-                     if not _needs_host_sample(w.seq)]
+                     if not self._needs_host_first_sample(w.seq)]
             if clean:
                 for i, w in clean:
                     entry = None
@@ -2840,6 +3318,12 @@ class LLMEngine:
             decode_early_exit_rounds_total=(
                 self._decode_early_exit_rounds_total
             ),
+            ragged_rounds_total=self._ragged_rounds_total,
+            ragged_split_rounds_total=self._ragged_split_rounds_total,
+            ragged_prefill_lanes_total=(
+                self._ragged_prefill_lanes_total
+            ),
+            ragged_decode_lanes_total=self._ragged_decode_lanes_total,
             kv_export_seconds_total=self._kv_export_seconds_total,
             kv_export_blocks_total=self._kv_export_blocks_total,
             kv_export_bytes_total=self._kv_export_bytes_total,
@@ -2955,6 +3439,29 @@ class LLMEngine:
             n += rnr.precompile_decode(
                 [max(1, c - kk + 1) for c in ctxs], kk,
                 chained=chained, stop=stop,
+            )
+        if self._ragged_dispatch:
+            # unified ragged rounds: warm the pow2 lane-mix buckets —
+            # every prefill-lane group size x each fused-K bucket x
+            # each ctx bucket, prefill context matched to the decode
+            # bucket (sessions in one workload share a length regime;
+            # off-diagonal prefill/decode context pairs are
+            # request-dependent and compile on first use, cached by
+            # JAX_COMPILATION_CACHE_DIR across restarts)
+            from production_stack_tpu.engine.scheduler import (
+                decode_k_buckets,
+            )
+
+            n += rnr.precompile_ragged(
+                [max(1, c - cfg.num_scheduler_steps + 1) for c in ctxs],
+                decode_k_buckets(
+                    cfg.num_scheduler_steps,
+                    self.scheduler.config.adaptive_decode_k,
+                ),
+                cfg.max_prefill_seqs,
+                cfg.max_prefill_chunk,
+                stop=self._device_stop,
+                chained=self._prefetch_decode,
             )
         if cfg.num_speculative_tokens > 0:
             n += rnr.precompile_verify(

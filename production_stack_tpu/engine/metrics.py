@@ -201,6 +201,29 @@ class EngineMetrics:
             "trip count because every lane had finished",
             label, registry=reg,
         )
+        # unified ragged dispatch: fused lane-typed rounds and their
+        # lane mix (prefill lanes per fused round — pure rounds are not
+        # observed, so rate(tpu:ragged_rounds) over
+        # rate(tpu:decode_rounds) is the mixed-round share)
+        self.ragged_lane_mix = Histogram(
+            "tpu:ragged_lane_mix",
+            "Prefill-chunk lanes fused into a ragged round (each "
+            "observation is one mixed prefill+decode dispatch)",
+            label, buckets=(1, 2, 4, 8, 16), registry=reg,
+        )
+        self.ragged_rounds = Counter(
+            "tpu:ragged_rounds",
+            "Lane-typed ragged rounds dispatched fused (prefill chunks "
+            "+ decode steps in one device program)",
+            label, registry=reg,
+        )
+        self.ragged_split_rounds = Counter(
+            "tpu:ragged_split_rounds",
+            "Planned mixed rounds executed as split prefill+decode "
+            "dispatches (prompt_logprobs / host-sampled finals / "
+            "near-budget guided lanes)",
+            label, registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -296,6 +319,11 @@ class EngineMetrics:
         self.decode_early_exits.labels(m).inc(max(
             0, s.decode_early_exit_rounds_total
             - prev.decode_early_exit_rounds_total))
+        self.ragged_rounds.labels(m).inc(max(
+            0, s.ragged_rounds_total - prev.ragged_rounds_total))
+        self.ragged_split_rounds.labels(m).inc(max(
+            0, s.ragged_split_rounds_total
+            - prev.ragged_split_rounds_total))
         self.kv_export_blocks.labels(m).inc(max(
             0, s.kv_export_blocks_total - prev.kv_export_blocks_total))
         self.kv_restore_blocks.labels(m).inc(max(
@@ -338,6 +366,14 @@ class EngineMetrics:
         m = self.model_name
         for k in ks:
             self.decode_k.labels(m).observe(k)
+
+    def observe_ragged(self, lane_counts: list[int]) -> None:
+        """Feed drained ragged lane-mix observations (LLMEngine.
+        drain_ragged_observations — prefill lanes per fused round)
+        into the tpu:ragged_lane_mix histogram."""
+        m = self.model_name
+        for n in lane_counts:
+            self.ragged_lane_mix.labels(m).observe(n)
 
     def observe_request(
         self,

@@ -43,6 +43,18 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+# lane-type codes in the ragged pack's lane-meta header (lane_types /
+# lane_lens / lane_budgets — the per-lane fields extending
+# _decode_pack_layout to a lane-typed prefill+decode round). The device
+# reads lane_types to pin idle prefill lanes' sampled slot to
+# sampler.RAGGED_IDLE_TOKEN; lens/budgets make the buffer
+# self-describing (chunk length / this round's K, remaining prompt /
+# remaining token budget).
+RAGGED_LANE_IDLE = 0
+RAGGED_LANE_PREFILL = 1
+RAGGED_LANE_DECODE = 2
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -243,6 +255,9 @@ class ModelRunner:
         self._prefill_batch_fns: dict[tuple[int, int, int], object] = {}
         self._decode_fns: dict[tuple[int, int], object] = {}
         self._decode_multi_fns: dict[tuple[int, int, int], object] = {}
+        # unified ragged rounds, keyed by (s_pad, t_pad, pc_pad, b,
+        # c_pad, k, flags...) — see ragged_dispatch
+        self._ragged_fns: dict[tuple, object] = {}
         self._embed_fns: dict[tuple[int, int], object] = {}
         # donated in-place KV block scatter (offload restore / PD
         # import), keyed by (n_src_pad, n_dst_pad) pow2 buckets
@@ -1013,11 +1028,12 @@ class ModelRunner:
 
         return attn
 
-    def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
-        """Packed cross-sequence prefill: chunks from s_pad sequences run
-        in ONE device program (one dispatch instead of s_pad — burst-TTFT
-        fix; reference capability bar is vLLM's batched chunked prefill,
-        reference: helm/templates/deployment-vllm-multi.yaml:140-146).
+    def _make_prefill_batch_step(self, s_pad: int, t_pad: int):
+        """The raw (un-jitted) packed cross-sequence prefill step: chunks
+        from s_pad sequences run in ONE device program (one dispatch
+        instead of s_pad — burst-TTFT fix; reference capability bar is
+        vLLM's batched chunked prefill, reference:
+        helm/templates/deployment-vllm-multi.yaml:140-146).
 
         The flat token axis carries the s_pad chunks back to back
         (row s*t_pad + r is row r of chunk s): the embedding, projections,
@@ -1026,7 +1042,11 @@ class ModelRunner:
         Pallas path unrolls the hardware-validated single-sequence kernel
         s_pad times inside the jitted step — TPU grid programs run
         sequentially on the core anyway, so this matches a batched-grid
-        kernel's schedule without forking a second Mosaic kernel."""
+        kernel's schedule without forking a second Mosaic kernel.
+
+        Shared by _build_prefill_batch (which jits it) and the ragged
+        dispatch builder (which composes it with the decode scan inside
+        ONE jitted round)."""
         mc = self.model_config
         from production_stack_tpu.engine.sampler import sample_tokens
 
@@ -1055,11 +1075,15 @@ class ModelRunner:
                                     min_p=min_ps)
             return sampled, logits, kc, vc
 
-        jit_kw = self._step_jit_kwargs(2)
-        if not self.prefill_pipeline:
-            return jax.jit(step, donate_argnums=(1, 2), **jit_kw)
+        return step
 
-        # pipelined variant: one fused i32 operand (see _build_prefill)
+    def _make_prefill_batch_packed(self, s_pad: int, t_pad: int,
+                                   c_pad: int):
+        """Fused-buffer wrapper of _make_prefill_batch_step: one i32
+        operand (layout _packed_prefill_pack_layout), unpacked on device
+        (see _build_prefill). Un-jitted — _build_prefill_batch jits it,
+        the ragged builder inlines it."""
+        step = self._make_prefill_batch_step(s_pad, t_pad)
         layout, _size = self._packed_prefill_pack_layout(
             s_pad, t_pad, c_pad
         )
@@ -1091,7 +1115,21 @@ class ModelRunner:
                 lora=lora, lora_slots=lora_slots,
             )
 
-        return jax.jit(packed_step, donate_argnums=(1, 2), **jit_kw)
+        return packed_step
+
+    def _build_prefill_batch(self, s_pad: int, t_pad: int, c_pad: int):
+        """Jitted packed cross-sequence prefill (raw-args variant, or
+        the fused-buffer variant under the prefill pipeline)."""
+        jit_kw = self._step_jit_kwargs(2)
+        if not self.prefill_pipeline:
+            return jax.jit(
+                self._make_prefill_batch_step(s_pad, t_pad),
+                donate_argnums=(1, 2), **jit_kw,
+            )
+        return jax.jit(
+            self._make_prefill_batch_packed(s_pad, t_pad, c_pad),
+            donate_argnums=(1, 2), **jit_kw,
+        )
 
     def _build_decode(self, b: int, c_pad: int):
         mc = self.model_config
@@ -1198,14 +1236,17 @@ class ModelRunner:
             fields.append(("gather_tables", (b, c_pad)))
         return self._layout_of(fields)
 
-    def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
-                            use_penalties: bool = False,
-                            want_logprobs: bool = False,
-                            chained: bool = False,
-                            guided_shapes: tuple | None = None,
-                            bias_cap: int = 0,
-                            stop_cap: int | None = None):
-        """K fused decode+sample iterations per dispatch.
+    def _make_decode_multi_step(self, b: int, c_pad: int, k_steps: int,
+                                use_penalties: bool = False,
+                                want_logprobs: bool = False,
+                                chained: bool = False,
+                                guided_shapes: tuple | None = None,
+                                bias_cap: int = 0,
+                                stop_cap: int | None = None):
+        """K fused decode+sample iterations per dispatch (the raw,
+        un-jitted step — _build_decode_multi jits it; the ragged
+        dispatch builder composes it with the packed prefill step
+        inside ONE jitted round).
 
         The serving loop's per-step cost is dominated by the
         device-to-host fetch of the sampled token (one tunnel/PCIe RTT —
@@ -1513,7 +1554,25 @@ class ModelRunner:
                 ys = (tb, valid)
             return ys, kc, vc  # ys: (toks, [lp arrays,] valid)
 
-        return jax.jit(step, donate_argnums=(1, 2), **self._step_jit_kwargs())
+        return step
+
+    def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
+                            use_penalties: bool = False,
+                            want_logprobs: bool = False,
+                            chained: bool = False,
+                            guided_shapes: tuple | None = None,
+                            bias_cap: int = 0,
+                            stop_cap: int | None = None):
+        """Jitted fused-K decode program (see _make_decode_multi_step)."""
+        return jax.jit(
+            self._make_decode_multi_step(
+                b, c_pad, k_steps, use_penalties=use_penalties,
+                want_logprobs=want_logprobs, chained=chained,
+                guided_shapes=guided_shapes, bias_cap=bias_cap,
+                stop_cap=stop_cap,
+            ),
+            donate_argnums=(1, 2), **self._step_jit_kwargs(),
+        )
 
     # -- host-side helpers -------------------------------------------------
     def _slots_for_positions(
@@ -2216,6 +2275,84 @@ class ModelRunner:
         )
         return (c_pad, jax.device_put(packed))
 
+    def _decode_pen_kwargs(
+        self, penalties: tuple | None, b: int, c_pad: int, b_actual: int
+    ) -> dict:
+        """Device penalty-state args for the fused decode scan, shared
+        by decode_multi and ragged_dispatch."""
+        if penalties is None:
+            return {}
+        gen_lists, presence, frequency, repetition = penalties
+        # pad the generated-id history to c_pad (generated tokens are
+        # part of the context, so it always fits): gen shape then
+        # varies only with the existing ctx bucket — a separate pow2
+        # gen bucket would multiply the compile space mid-serving
+        gen_full = np.full((b, c_pad), -1, np.int32)
+        for i, g in enumerate(gen_lists):
+            gen_full[i, : len(g)] = g
+        pres_full = np.zeros((b,), np.float32)
+        pres_full[:b_actual] = presence
+        freq_full = np.zeros((b,), np.float32)
+        freq_full[:b_actual] = frequency
+        rep_full = np.ones((b,), np.float32)
+        rep_full[:b_actual] = repetition
+        return {
+            "gen_ids": jnp.asarray(gen_full),
+            "presence": jnp.asarray(pres_full),
+            "frequency": jnp.asarray(freq_full),
+            "repetition": jnp.asarray(rep_full),
+        }
+
+    def _decode_guided_kwargs(
+        self, guided: tuple | None
+    ) -> tuple[dict, tuple | None]:
+        """Device TokenDFA-table args (+ static shapes) for the fused
+        decode scan, shared by decode_multi and ragged_dispatch."""
+        if guided is None:
+            return {}, None
+        # per-lane g_state/g_lane were packed by _fill_decode_pack
+        (g_token, init_states, lane_map, token_class, class_mask,
+         class_trans) = guided
+        # device-cache the big tables across dispatches: they change
+        # only when the set of live constraints changes
+        cached = getattr(self, "_guided_dev", None)
+        if cached is None or cached[0] != g_token:
+            self._guided_dev = (
+                g_token,
+                jnp.asarray(token_class),
+                jnp.asarray(class_mask),
+                jnp.asarray(class_trans),
+            )
+        _, tc_dev, mask_dev, trans_dev = self._guided_dev
+        guided_kw = {
+            "g_token_class": tc_dev,
+            "g_class_mask": mask_dev,
+            "g_class_trans": trans_dev,
+        }
+        guided_shapes = (
+            token_class.shape[0], class_mask.shape[0],
+            class_mask.shape[1],
+        )
+        return guided_kw, guided_shapes
+
+    def _decode_bias_kwargs(
+        self, logit_bias: tuple | None, b: int, b_actual: int
+    ) -> tuple[dict, int]:
+        """Dense logit-bias args (+ cap) for the fused decode scan,
+        shared by decode_multi and ragged_dispatch."""
+        if logit_bias is None:
+            return {}, 0
+        lb_ids, lb_vals = logit_bias  # (b_actual, cap) ndarrays
+        bias_cap = int(lb_ids.shape[1])
+        ids_full = np.zeros((b, bias_cap), np.int32)
+        vals_full = np.zeros((b, bias_cap), np.float32)
+        ids_full[:b_actual] = lb_ids
+        vals_full[:b_actual] = lb_vals
+        return {
+            "lb_ids": jnp.asarray(ids_full),
+            "lb_vals": jnp.asarray(vals_full),
+        }, bias_cap
+
     # stackcheck: hot-path — one dispatch, one deferred fetch; a stray
     # sync forcer here costs a full RTT per decode round
     def decode_multi(
@@ -2317,69 +2454,11 @@ class ModelRunner:
                 min_ps=min_ps, guided_lanes=guided_lanes, stop=stop,
             ))
 
-        pen_kw = {}
-        if penalties is not None:
-            gen_lists, presence, frequency, repetition = penalties
-            # pad the generated-id history to c_pad (generated tokens are
-            # part of the context, so it always fits): gen shape then
-            # varies only with the existing ctx bucket — a separate pow2
-            # gen bucket would multiply the compile space mid-serving
-            gen_full = np.full((b, c_pad), -1, np.int32)
-            for i, g in enumerate(gen_lists):
-                gen_full[i, : len(g)] = g
-            pres_full = np.zeros((b,), np.float32)
-            pres_full[:b_actual] = presence
-            freq_full = np.zeros((b,), np.float32)
-            freq_full[:b_actual] = frequency
-            rep_full = np.ones((b,), np.float32)
-            rep_full[:b_actual] = repetition
-            pen_kw = {
-                "gen_ids": jnp.asarray(gen_full),
-                "presence": jnp.asarray(pres_full),
-                "frequency": jnp.asarray(freq_full),
-                "repetition": jnp.asarray(rep_full),
-            }
-
-        guided_kw = {}
-        guided_shapes = None
-        if guided is not None:
-            # per-lane g_state/g_lane were packed by _fill_decode_pack
-            (g_token, init_states, lane_map, token_class, class_mask,
-             class_trans) = guided
-            # device-cache the big tables across dispatches: they change
-            # only when the set of live constraints changes
-            cached = getattr(self, "_guided_dev", None)
-            if cached is None or cached[0] != g_token:
-                self._guided_dev = (
-                    g_token,
-                    jnp.asarray(token_class),
-                    jnp.asarray(class_mask),
-                    jnp.asarray(class_trans),
-                )
-            _, tc_dev, mask_dev, trans_dev = self._guided_dev
-            guided_kw = {
-                "g_token_class": tc_dev,
-                "g_class_mask": mask_dev,
-                "g_class_trans": trans_dev,
-            }
-            guided_shapes = (
-                token_class.shape[0], class_mask.shape[0],
-                class_mask.shape[1],
-            )
-
-        bias_cap = 0
-        bias_kw = {}
-        if logit_bias is not None:
-            lb_ids, lb_vals = logit_bias  # (b_actual, cap) ndarrays
-            bias_cap = int(lb_ids.shape[1])
-            ids_full = np.zeros((b, bias_cap), np.int32)
-            vals_full = np.zeros((b, bias_cap), np.float32)
-            ids_full[:b_actual] = lb_ids
-            vals_full[:b_actual] = lb_vals
-            bias_kw = {
-                "lb_ids": jnp.asarray(ids_full),
-                "lb_vals": jnp.asarray(vals_full),
-            }
+        pen_kw = self._decode_pen_kwargs(penalties, b, c_pad, b_actual)
+        guided_kw, guided_shapes = self._decode_guided_kwargs(guided)
+        bias_kw, bias_cap = self._decode_bias_kwargs(
+            logit_bias, b, b_actual
+        )
         cache_key = (b, c_pad, steps, penalties is not None,
                      want_logprobs, chained, guided_shapes, bias_cap,
                      stop_cap)
@@ -2419,6 +2498,417 @@ class ModelRunner:
             **lora_kw,
         )
         return ys
+
+    # -- unified ragged prefill+decode dispatch ----------------------------
+    # ONE lane-typed engine round: a single packed h2d buffer whose lanes
+    # mix prefill chunks and decode steps (Ragged Paged Attention role,
+    # PAPERS.md), one jitted program that runs the prefill lanes' chunk
+    # attention and the decode lanes' stop-aware scan back to back. The
+    # two lane sets belong to DIFFERENT sequences with disjoint block
+    # tables, so the in-program ordering cannot change any sampled value:
+    # tokens are bit-identical to a split prefill round followed by a
+    # decode round (tests/test_ragged_dispatch.py pins it).
+
+    def _ragged_pack_sizes(
+        self, s_pad: int, t_pad: int, pc_pad: int, b: int, c_pad: int,
+        chained: bool, guided: bool = False, stop_cap: int | None = None,
+    ) -> tuple[int, int, int]:
+        """(meta, prefill, decode) segment lengths of the ONE packed i32
+        buffer a ragged dispatch ships: a lane-meta header (per-lane
+        type/length/budget — the fields extending _decode_pack_layout
+        to a lane-typed round), then the packed prefill pack, then the
+        decode pack, concatenated. The decode segment varies with the
+        stop-id cap and guided fields exactly like _decode_pack_layout,
+        so a staged buffer whose total length mismatches the dispatch's
+        expectation is a STALE STAGE (counted miss), never an error."""
+        meta = 3 * (s_pad + b)
+        _, pf = self._packed_prefill_pack_layout(s_pad, t_pad, pc_pad)
+        _, dec = self._decode_pack_layout(
+            b, c_pad, chained, guided=guided, stop_cap=stop_cap
+        )
+        return meta, pf, dec
+
+    # stackcheck: hot-path — host build of the ragged round's single
+    # h2d buffer, shared by the dispatch and the staging prefetch; one
+    # pass over the lanes, no device fetch
+    def _fill_ragged_pack(
+        self,
+        pf_chunks: list[list[int]],
+        pf_start_positions: list[int],
+        pf_block_tables: list[list[int]],
+        pf_total_lens: list[int],
+        pf_sampling,
+        c_pad: int,
+        chained: bool,
+        token_ids,
+        positions,
+        block_tables,
+        context_lens,
+        steps: int,
+        temps, top_ps, top_ks, keys,
+        min_ps=None,
+        guided_lanes: tuple | None = None,
+        stop: tuple | None = None,
+        pf_budgets: list[int] | None = None,
+        dec_budgets: list[int] | None = None,
+    ) -> tuple[int, int, int, np.ndarray]:
+        """Concatenate lane-meta + prefill pack + decode pack; returns
+        (s_pad, t_pad, pc_pad, packed). Lane order: prefill lanes 0..n_pf
+        (padded to s_pad), then the b decode lanes. `lane_budgets` carry
+        remaining prompt tokens (prefill lanes) / remaining token budget
+        (decode lanes) — self-describing for debugging, and lane_types
+        gates the device-side idle-lane token pinning."""
+        b = self.config.max_num_seqs
+        s_pad, t_pad, pc_pad, pf_packed = self._fill_packed_prefill_pack(
+            pf_chunks, pf_start_positions, pf_block_tables,
+            pf_total_lens, sampling=pf_sampling,
+        )
+        dec_packed = self._fill_decode_pack(
+            c_pad, chained, token_ids, positions, block_tables,
+            context_lens, temps, top_ps, top_ks, keys, min_ps=min_ps,
+            guided_lanes=guided_lanes, stop=stop,
+        )
+        n_pf = len(pf_chunks)
+        n_dec = len(positions)
+        n_lanes = s_pad + b
+        types = np.zeros((n_lanes,), np.int32)
+        types[:n_pf] = RAGGED_LANE_PREFILL
+        types[s_pad:s_pad + n_dec] = RAGGED_LANE_DECODE
+        lens = np.zeros((n_lanes,), np.int32)
+        lens[:n_pf] = [len(c) for c in pf_chunks]
+        lens[s_pad:s_pad + n_dec] = steps
+        budgets = np.zeros((n_lanes,), np.int32)
+        if pf_budgets is not None:
+            budgets[:n_pf] = pf_budgets
+        if dec_budgets is not None:
+            budgets[s_pad:s_pad + n_dec] = dec_budgets
+        elif stop is not None:
+            budgets[s_pad:s_pad + n_dec] = stop[2]
+        packed = np.concatenate([types, lens, budgets, pf_packed,
+                                 dec_packed])
+        return s_pad, t_pad, pc_pad, packed
+
+    def _build_ragged(self, s_pad: int, t_pad: int, pc_pad: int,
+                      b: int, c_pad: int, k_steps: int,
+                      use_penalties: bool = False,
+                      want_logprobs: bool = False,
+                      chained: bool = False,
+                      guided_shapes: tuple | None = None,
+                      bias_cap: int = 0,
+                      stop_cap: int | None = None):
+        """ONE jitted lane-typed round: unpack the fused buffer's three
+        segments, run the packed prefill step over the prefill lanes,
+        then the fused decode scan over the decode lanes — one h2d
+        transfer, one dispatch enqueue, and the decode half's device
+        stop masks / penalties / guided tables unchanged from
+        _make_decode_multi_step. Idle prefill lanes' sampled slots are
+        pinned to sampler.RAGGED_IDLE_TOKEN from the lane-meta header so
+        the host can assert it only consumes real lanes."""
+        from production_stack_tpu.engine.sampler import RAGGED_IDLE_TOKEN
+
+        pf_step = self._make_prefill_batch_packed(s_pad, t_pad, pc_pad)
+        dec_step = self._make_decode_multi_step(
+            b, c_pad, k_steps, use_penalties=use_penalties,
+            want_logprobs=want_logprobs, chained=chained,
+            guided_shapes=guided_shapes, bias_cap=bias_cap,
+            stop_cap=stop_cap,
+        )
+        meta_n, pf_n, _dec_n = self._ragged_pack_sizes(
+            s_pad, t_pad, pc_pad, b, c_pad, chained,
+            guided=guided_shapes is not None, stop_cap=stop_cap,
+        )
+
+        def step(params, kc, vc, packed, chained_tokens=None,
+                 g_token_class=None, g_class_mask=None,
+                 g_class_trans=None, gen_ids=None, presence=None,
+                 frequency=None, repetition=None, lb_ids=None,
+                 lb_vals=None, lora=None, lora_slots=None,
+                 pf_lora_slots=None):
+            lane_types = packed[:s_pad + b]
+            pf_packed = packed[meta_n:meta_n + pf_n]
+            dec_packed = packed[meta_n + pf_n:]
+            # prefill lanes first: their chunk K/V lands before the
+            # decode scan runs, matching the split path's round order
+            # (values are order-independent anyway — disjoint tables)
+            pf_sampled, pf_logits, kc, vc = pf_step(
+                params, kc, vc, pf_packed, lora=lora,
+                lora_slots=pf_lora_slots,
+            )
+            ys, kc, vc = dec_step(
+                params, kc, vc, dec_packed,
+                chained_tokens=chained_tokens,
+                g_token_class=g_token_class, g_class_mask=g_class_mask,
+                g_class_trans=g_class_trans, gen_ids=gen_ids,
+                presence=presence, frequency=frequency,
+                repetition=repetition, lb_ids=lb_ids, lb_vals=lb_vals,
+                lora=lora, lora_slots=lora_slots,
+            )
+            pf_sampled = jnp.where(
+                lane_types[:s_pad] == RAGGED_LANE_PREFILL,
+                pf_sampled, RAGGED_IDLE_TOKEN,
+            )
+            return pf_sampled, pf_logits, ys, kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    # stackcheck: hot-path — speculative h2d prefetch of the NEXT ragged
+    # round's packed buffer: the upload overlaps the in-flight round's
+    # execution and fetch (prefill mirror: stage_prefill_batch; decode
+    # mirror: stage_decode_multi). Enqueue-only, no device fetch.
+    def stage_ragged(
+        self,
+        pf_chunks: list[list[int]],
+        pf_start_positions: list[int],
+        pf_block_tables: list[list[int]],
+        pf_total_lens: list[int],
+        pf_sampling,
+        positions, block_tables, context_lens, steps,
+        temps, top_ps, top_ks, keys,
+        min_ps=None, stop=None,
+        pf_budgets=None, dec_budgets=None,
+    ) -> tuple:
+        """Build + START uploading the predicted next ragged round's
+        packed buffer (decode half chained: its tokens ride on device
+        from the current round). Returns a handle for
+        ragged_dispatch(staged=...); the caller validates its
+        fingerprint — and the dispatch validates the total layout
+        length — before use."""
+        t0 = time.perf_counter()
+        c_pad = self._ctx_bucket(
+            max(context_lens) + max(0, steps - 1)
+        )
+        s_pad, t_pad, pc_pad, packed = self._fill_ragged_pack(
+            pf_chunks, pf_start_positions, pf_block_tables,
+            pf_total_lens, pf_sampling, c_pad, True, None, positions,
+            block_tables, context_lens, steps, temps, top_ps, top_ks,
+            keys, min_ps=min_ps, stop=stop, pf_budgets=pf_budgets,
+            dec_budgets=dec_budgets,
+        )
+        t1 = time.perf_counter()
+        self._phase_add("prep", t1 - t0)
+        handle = (("ragged", s_pad, t_pad, pc_pad, c_pad),
+                  jax.device_put(packed))
+        self._phase_add("h2d", time.perf_counter() - t1)
+        return handle
+
+    # stackcheck: hot-path — ONE dispatch serves the whole lane-typed
+    # round (prefill chunks + decode steps); fetches stay deferred to
+    # the caller, a stray sync forcer here costs a full RTT per round
+    def ragged_dispatch(
+        self,
+        pf_chunks: list[list[int]],
+        pf_start_positions: list[int],
+        pf_block_tables: list[list[int]],
+        pf_total_lens: list[int],
+        token_ids,
+        positions: list[int],
+        block_tables: list[list[int]],
+        context_lens: list[int],
+        steps: int,
+        temps, top_ps, top_ks, keys,
+        min_ps=None,
+        pf_sampling=None,
+        pf_lora_slots: list[int] | None = None,
+        lora_slots: list[int] | None = None,
+        penalties: tuple | None = None,
+        want_logprobs: bool = False,
+        guided: tuple | None = None,
+        logit_bias: tuple | None = None,
+        staged: tuple | None = None,
+        stop: tuple | None = None,
+        pf_budgets: list[int] | None = None,
+        dec_budgets: list[int] | None = None,
+    ) -> tuple:
+        """One lane-typed engine round: prefill chunk lanes + fused
+        decode lanes in a single program. Returns (pf_sampled (s_pad,)
+        i32 device — RAGGED_IDLE_TOKEN on non-real lanes, pf_logits
+        (s_pad, vocab) device, dec_ys) where dec_ys matches
+        decode_multi's return shape for the same flags. `staged` = a
+        stage_ragged handle; used only when its bucket key AND total
+        layout length match (a lane-mix or stop-cap drift between stage
+        and dispatch rebuilds serially — a counted staging miss, never
+        a dispatch error)."""
+        if steps > self.block_size:
+            raise ValueError(
+                f"num_scheduler_steps={steps} > block_size="
+                f"{self.block_size}: idle lanes would overrun the trash "
+                "block"
+            )
+        b = self.config.max_num_seqs
+        chained = isinstance(token_ids, jax.Array)
+        b_actual = len(positions)
+        c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
+        s_pad = next_pow2(max(len(pf_chunks), 1))
+        t_pad = self._prefill_bucket(max(len(c) for c in pf_chunks))
+        pc_pad = max(self._ctx_bucket(tl) for tl in pf_total_lens)
+        guided_lanes = None
+        if guided is not None:
+            guided_lanes = (guided[1], guided[2])
+        stop_cap = None
+        if stop is not None:
+            stop_cap = 0 if stop[3] is None else int(stop[3].shape[1])
+        packed_dev = None
+        if (staged is not None and chained and guided is None
+                and staged[0] == ("ragged", s_pad, t_pad, pc_pad,
+                                  c_pad)):
+            want_total = sum(self._ragged_pack_sizes(
+                s_pad, t_pad, pc_pad, b, c_pad, chained,
+                guided=False, stop_cap=stop_cap,
+            ))
+            if int(staged[1].shape[0]) == want_total:
+                packed_dev = staged[1]
+        if packed_dev is None:
+            t0 = time.perf_counter()
+            _s, _t, _pc, packed = self._fill_ragged_pack(
+                pf_chunks, pf_start_positions, pf_block_tables,
+                pf_total_lens, pf_sampling, c_pad, chained, token_ids,
+                positions, block_tables, context_lens, steps, temps,
+                top_ps, top_ks, keys, min_ps=min_ps,
+                guided_lanes=guided_lanes, stop=stop,
+                pf_budgets=pf_budgets, dec_budgets=dec_budgets,
+            )
+            t1 = time.perf_counter()
+            self._phase_add("prep", t1 - t0)
+            packed_dev = jnp.asarray(packed)
+            self._phase_add("h2d", time.perf_counter() - t1)
+
+        pen_kw = self._decode_pen_kwargs(penalties, b, c_pad, b_actual)
+        guided_kw, guided_shapes = self._decode_guided_kwargs(guided)
+        bias_kw, bias_cap = self._decode_bias_kwargs(
+            logit_bias, b, b_actual
+        )
+        cache_key = (s_pad, t_pad, pc_pad, b, c_pad, steps,
+                     penalties is not None, want_logprobs, chained,
+                     guided_shapes, bias_cap, stop_cap)
+        if cache_key not in self._ragged_fns:
+            logger.info(
+                "compiling ragged round s=%d t=%d pctx=%d b=%d ctx=%d "
+                "k=%d pen=%s lp=%s chained=%s guided=%s bias=%d stop=%s",
+                s_pad, t_pad, pc_pad, b, c_pad, steps,
+                penalties is not None, want_logprobs, chained,
+                guided_shapes, bias_cap, stop_cap,
+            )
+            self._ragged_fns[cache_key] = self._build_ragged(
+                s_pad, t_pad, pc_pad, b, c_pad, steps,
+                use_penalties=penalties is not None,
+                want_logprobs=want_logprobs, chained=chained,
+                guided_shapes=guided_shapes, bias_cap=bias_cap,
+                stop_cap=stop_cap,
+            )
+        fn = self._ragged_fns[cache_key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            slots = np.zeros((b,), dtype=np.int32)
+            if lora_slots is not None:
+                slots[:b_actual] = lora_slots
+            pf_kw = self._packed_lora_kwargs(
+                pf_lora_slots, len(pf_chunks), s_pad, t_pad
+            )
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.asarray(slots),
+                "pf_lora_slots": pf_kw["lora_slots"],
+            }
+        chained_kw = {"chained_tokens": token_ids} if chained else {}
+        t2 = time.perf_counter()
+        pf_sampled, pf_logits, ys, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            packed_dev,
+            **chained_kw,
+            **guided_kw,
+            **pen_kw,
+            **bias_kw,
+            **lora_kw,
+        )
+        self._phase_add("dispatch", time.perf_counter() - t2)
+        return pf_sampled, pf_logits, ys
+
+    def precompile_ragged(
+        self, context_lens: list[int], ks: list[int], max_groups: int,
+        chunk_len: int, stop: bool = False, chained: bool = False,
+    ) -> int:
+        """Warm the ragged round's pow2 lane-mix buckets: every pow2
+        prefill-lane group size up to max_groups x each fused-K bucket x
+        each ctx bucket, prefill lanes' context matched to the decode
+        bucket (the steady-state mixed-round shape: sessions in one
+        workload share a length regime). Trash tables at the top of the
+        pool, same safety contract as precompile_prefill/decode.
+        `chained=True` additionally warms the staged-prefetch variant
+        (device-array decode tokens — a distinct program key)."""
+        b = self.config.max_num_seqs
+        bs = self.block_size
+        nb = self.num_blocks
+        temps = np.zeros((b,), np.float32)
+        top_ps = np.ones((b,), np.float32)
+        top_ks = np.full((b,), -1, np.int32)
+        keys = np.zeros((b, 2), np.uint32)
+        groups: list[int] = []
+        s = 1
+        while s <= max(1, max_groups):
+            groups.append(s)
+            s *= 2
+        seen: set[tuple] = set()
+        n = 0
+        for cl in context_lens:
+            for k in ks:
+                c_pad = self._ctx_bucket(cl + max(0, k - 1))
+                ctx = c_pad - max(0, k - 1)
+                clen = min(chunk_len, c_pad)
+                for s in groups:
+                    key = (s, self._prefill_bucket(clen), c_pad, k)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    npages = c_pad // bs
+                    if nb < 2 * (s + 1) * npages + 64:
+                        logger.warning(
+                            "ragged precompile: skipping s=%d ctx=%d "
+                            "k=%d — pool of %d blocks too small",
+                            s, c_pad, k, nb,
+                        )
+                        continue
+                    # decode lanes share the topmost trash table;
+                    # prefill lanes stack below it, all above live KV
+                    dec_table = list(range(nb - npages, nb))
+                    pf_tabs = [
+                        list(range(nb - (i + 2) * npages,
+                                   nb - (i + 1) * npages))
+                        for i in range(s)
+                    ]
+                    stop_kw = {}
+                    if stop:
+                        # budget == k: nothing freezes, full trip — the
+                        # PROGRAM equals what live batches select
+                        stop_kw = {"stop": (
+                            np.full((b,), -1, np.int32),
+                            np.zeros((b,), np.int32),
+                            np.full((b,), k, np.int32),
+                            None,
+                        )}
+                    out = self.ragged_dispatch(
+                        [[1] * clen] * s, [c_pad - clen] * s, pf_tabs,
+                        [c_pad] * s,
+                        [1] * b, [ctx - 1] * b, [dec_table] * b,
+                        [ctx] * b, k,
+                        temps, top_ps, top_ks, keys, **stop_kw,
+                    )
+                    jax.block_until_ready(out)
+                    n += 1
+                    if chained and k > 1:
+                        ys = out[2]
+                        toks = ys[0] if isinstance(ys, tuple) else ys
+                        out2 = self.ragged_dispatch(
+                            [[1] * clen] * s, [c_pad - clen] * s,
+                            pf_tabs, [c_pad] * s,
+                            toks[-1], [ctx - 1] * b, [dec_table] * b,
+                            [ctx] * b, k,
+                            temps, top_ps, top_ks, keys, **stop_kw,
+                        )
+                        jax.block_until_ready(out2)
+                        n += 1
+        return n
 
     # -- embeddings (stateless, /v1/embeddings) ----------------------------
     def _build_embed(self, t_pad: int, c_pad: int):

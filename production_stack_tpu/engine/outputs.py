@@ -64,6 +64,13 @@ class EngineStatsSnapshot:
     decode_rounds_total: int = 0
     decode_overshoot_tokens_total: int = 0
     decode_early_exit_rounds_total: int = 0
+    # unified ragged dispatch: fused lane-typed rounds, rounds a mixed
+    # plan ran split (exotic lanes), and per-side lane totals —
+    # tpu:ragged_* in /metrics and the bench `ragged_dispatch` slot
+    ragged_rounds_total: int = 0
+    ragged_split_rounds_total: int = 0
+    ragged_prefill_lanes_total: int = 0
+    ragged_decode_lanes_total: int = 0
     # zero-stall KV tiering attribution: deferred-export batches (wall
     # seconds measured ON THE OFFLOAD WORKER — overlapped activity, not
     # step-loop stalls) and staged restores (enqueue -> landed), plus

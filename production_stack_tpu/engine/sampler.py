@@ -94,6 +94,13 @@ def apply_penalties(
 # the per-lane valid counts, never the pinned slots.
 STOP_PAD_TOKEN = 0
 
+# unified ragged dispatch: the sentinel a NON-prefill lane's sampled
+# first-token slot is pinned to inside the lane-typed round (negative —
+# can never collide with a real token id, unlike STOP_PAD_TOKEN whose
+# slots are guarded by valid counts instead). Hosts must only consume
+# rows where the value is >= 0, and the engine asserts exactly that.
+RAGGED_IDLE_TOKEN = -1
+
 
 def stop_hit(
     tokens: jax.Array,  # (b,) int32 just-sampled tokens

@@ -24,6 +24,17 @@ that budget — through a remote chip the dispatch RTT, not the chunk
 count, dominates its wall cost), so the inter-token gap of a running
 stream is bounded by ~K prefill dispatches + one decode step regardless
 of how many new users are admitted.
+
+Unified ragged dispatch (`ragged_dispatch=True`): the alternation above
+disappears entirely. `plan_ragged_round` packs every mid-prefill
+runner's next chunk AND the decode-ready batch into ONE lane-typed
+round (the engine dispatches both halves in a single device program —
+model_runner.ragged_dispatch), so a waiting prefill claims a lane in
+the very next round instead of queueing behind the interleave streak,
+and the admission-K clamp no longer applies to in-round prefill work
+(pick_decode_k's ragged branch). The streak counter, staged-bypass
+accounting, and clamp stay in place for the split path
+(`--no-ragged-dispatch`, multihost, async-chained rounds).
 """
 
 from __future__ import annotations
@@ -86,6 +97,14 @@ class SchedulerOutput:
             and not self.aborted
         )
 
+    @property
+    def is_ragged(self) -> bool:
+        """True for a lane-typed mixed round (unified ragged dispatch):
+        prefill-chunk lanes AND a decode batch planned together. The
+        split path never produces one — prefills and decode are mutually
+        exclusive there."""
+        return bool(self.prefills) and self.decode is not None
+
 
 @dataclass
 class SchedulerConfig:
@@ -124,6 +143,12 @@ class SchedulerConfig:
     # dispatches may bypass starvation before decode gets its turn
     # (bounds worst-case ITL for very long prompts).
     max_staged_prefill_run: int = 8
+    # unified ragged dispatch (EngineConfig.ragged_dispatch, gated by
+    # the engine for multihost/async/mesh): plan ONE lane-typed round
+    # carrying prefill-chunk lanes AND the decode batch together —
+    # dissolves the interleave streak and the admission-K clamp for
+    # in-round prefill work (plan_ragged_round / pick_decode_k)
+    ragged_dispatch: bool = False
 
 
 def decode_k_buckets(cap: int, adaptive: bool) -> list[int]:
@@ -347,6 +372,12 @@ class Scheduler:
                 # loop (and preempts again if more claims remain)
                 return self.schedule_admit_retry(out)
 
+        # unified ragged dispatch: no interleave arbitration — every
+        # mid-prefill runner's next chunk AND the decode-ready batch
+        # share ONE lane-typed round
+        if self.config.ragged_dispatch:
+            return self.plan_ragged_round(out)
+
         # 2) prefill priority: oldest running sequence with prompt left —
         # UNLESS decode-ready sequences have already waited through
         # `decode_interleave` consecutive prefill DISPATCHES (each one
@@ -413,6 +444,20 @@ class Scheduler:
 
         # 3) otherwise decode every decode-ready running sequence (mid-
         # prefill sequences sit out the interleaved decode steps)
+        decode_seqs = self._collect_decode_ready(out)
+        if decode_seqs:
+            out.decode = DecodeWork(
+                seqs=decode_seqs, k=self.pick_decode_k(decode_seqs)
+            )
+        return out
+
+    def _collect_decode_ready(
+        self, out: SchedulerOutput
+    ) -> list[Sequence]:
+        """Capacity-checked decode batch: every decode-ready running
+        sequence whose block table can grow to cover this round's
+        lookahead, preempting (or self-preempting) on exhaustion —
+        shared by the split path's decode step and plan_ragged_round."""
         decode_seqs: list[Sequence] = []
         for seq in list(self.running):
             if seq.finished or seq not in self.running:
@@ -449,7 +494,40 @@ class Scheduler:
                     break
             else:
                 decode_seqs.append(seq)
+        return decode_seqs
 
+    # stackcheck: hot-path — pure host planning of the lane-typed round
+    # on the scheduling path: one pass over running, no device work
+    def plan_ragged_round(self, out: SchedulerOutput) -> SchedulerOutput:
+        """Plan ONE lane-typed round (unified ragged dispatch): the
+        decode-ready batch claims the decode lanes and every mid-prefill
+        runner's next chunk claims a prefill lane IN THE SAME ROUND — a
+        freshly admitted prompt is dispatched on the very next round
+        with no interleave-streak wait, which is the scheduling contract
+        tests/test_ragged_dispatch.py pins. The decode-capacity pass
+        (with its preemption) runs FIRST so a victim never also claims a
+        prefill lane; pick_decode_k's ragged branch drops the
+        admission-K clamp for in-round prefill work (only a
+        capacity-starved waiting queue still clamps)."""
+        decode_seqs = self._collect_decode_ready(out)
+        group_cap = (
+            self.config.max_prefill_seqs
+            if self.config.enable_chunked_prefill
+            else 1
+        )
+        for seq in self.running:
+            if seq.prefill_done or seq.finished:
+                continue
+            if len(out.prefills) >= group_cap:
+                break
+            chunk_len = seq.num_uncomputed_prompt_tokens
+            if self.config.enable_chunked_prefill:
+                chunk_len = min(chunk_len, self.config.max_prefill_chunk)
+            out.prefills.append(PrefillWork(
+                seq=seq,
+                chunk_start=seq.num_computed_tokens,
+                chunk_len=chunk_len,
+            ))
         if decode_seqs:
             out.decode = DecodeWork(
                 seqs=decode_seqs, k=self.pick_decode_k(decode_seqs)
@@ -483,7 +561,16 @@ class Scheduler:
         if not self.config.adaptive_decode_k or cap == 1 or not seqs:
             return cap
         k = cap
-        if self.waiting or any(
+        if self.config.ragged_dispatch:
+            # ragged audit: a mid-prefill runner rides THIS round as a
+            # prefill lane, so it must not clamp K — that was exactly
+            # the interleave-era starvation the unified round dissolves.
+            # Only a capacity-starved waiting queue (admission loop left
+            # it non-empty) still clamps: a shorter round reaches the
+            # next admission/preemption decision sooner.
+            if self.waiting:
+                k = min(k, self.ADMISSION_K_CLAMP)
+        elif self.waiting or any(
             not s.prefill_done for s in self.running
         ):
             k = min(k, self.ADMISSION_K_CLAMP)

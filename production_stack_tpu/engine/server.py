@@ -168,6 +168,9 @@ class EngineServer:
                 self.metrics.observe_decode_k(
                     self.engine.drain_decode_k_observations()
                 )
+                self.metrics.observe_ragged(
+                    self.engine.drain_ragged_observations()
+                )
             except Exception:  # pragma: no cover
                 logger.exception("stats update failed")
             await asyncio.sleep(STATS_UPDATE_INTERVAL_S)
@@ -1201,6 +1204,9 @@ class EngineServer:
         self.metrics.observe_kv(*self.engine.drain_kv_observations())
         self.metrics.observe_decode_k(
             self.engine.drain_decode_k_observations()
+        )
+        self.metrics.observe_ragged(
+            self.engine.drain_ragged_observations()
         )
         return web.Response(
             body=generate_latest(self.registry),

@@ -82,8 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     rout.add_argument("--routing-logic", type=str,
                       choices=["roundrobin", "session", "kvaware",
                                "prefixaware", "disaggregated_prefill",
-                               "ttft"],
-                      help="required: routing algorithm")
+                               "ttft", "latency"],
+                      help="required: routing algorithm (latency = "
+                           "health-aware least-EWMA-latency from the "
+                           "/debug/engines scoreboard)")
     rout.add_argument("--session-key", type=str, default=None,
                       help="header/body key for session affinity")
     rout.add_argument("--tokenizer", type=str, default=None,

@@ -33,6 +33,8 @@ class RoutingLogic(str, enum.Enum):
     PREFIXAWARE = "prefixaware"
     DISAGGREGATED_PREFILL = "disaggregated_prefill"
     TTFT = "ttft"
+    # health-aware least-EWMA-latency (consumes the PR 6 scoreboard)
+    LEAST_LATENCY = "latency"
 
 
 class RoutingInterface(abc.ABC):
@@ -54,6 +56,26 @@ class RoutingInterface(abc.ABC):
 
     def on_endpoint_removed(self, url: str) -> None:
         pass
+
+    # -- shared helper: drop scoreboard-unhealthy backends ---------------
+    @staticmethod
+    def _healthy_endpoints(
+        endpoints: list[EndpointInfo],
+    ) -> list[EndpointInfo]:
+        """Filter out backends the EngineHealthBoard marks unhealthy
+        (a running consecutive-failure streak — dead pod, wedged
+        engine). Degrades to the FULL list when everything looks
+        unhealthy: routing somewhere beats routing nowhere, and the
+        proxy's connect-retry still covers the request. The board
+        auto-creates empty (is_healthy defaults True), so this is safe
+        before any traffic has been observed."""
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        board = get_engine_health_board()
+        healthy = [e for e in endpoints if board.is_healthy(e.url)]
+        return healthy or list(endpoints)
 
     # -- shared helper: least-QPS endpoint (reference: routing_logic.py:88)
     @staticmethod
@@ -316,6 +338,58 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         return decode
 
 
+class LeastLatencyRouter(RoutingInterface):
+    """Health-aware least-latency routing (ROADMAP PR 6 follow-on (a)):
+    the first policy that actually CONSUMES the EngineHealthBoard the
+    proxy hot path feeds. Backends with a running consecutive-failure
+    streak (`is_healthy()` False — dead pod, wedged engine) are skipped
+    outright, and among the healthy rest the lowest EWMA e2e latency
+    wins, scaled by in-flight count so a fast-but-loaded backend does
+    not absorb the whole fleet. A backend with no completed request yet
+    (fresh pod among measured peers) is costed at the FASTEST measured
+    peer's EWMA — it attracts traffic until measured, but its in-flight
+    multiplier still engages so concurrent picks cannot thundering-herd
+    it; an entirely unmeasured fleet ties at 0 and spreads randomly
+    (same cold-start behavior as _qps_routing)."""
+
+    def __init__(self, **kwargs):
+        pass
+
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        board = get_engine_health_board()
+        cands = self._healthy_endpoints(endpoints)
+        rows = {ep.url: board.get(ep.url) for ep in cands}
+        measured = [
+            r.ewma_latency_s for r in rows.values()
+            if r is not None and r.ewma_latency_s >= 0
+        ]
+        # unmeasured backends assume peer speed (TtftRouter's fleet-EWMA
+        # philosophy): the in-flight multiplier then still bites
+        floor = min(measured) if measured else 0.0
+
+        def score(ep: EndpointInfo) -> tuple[float, int]:
+            eng = rows.get(ep.url)
+            if eng is None:
+                return (floor, 0)
+            lat = (
+                eng.ewma_latency_s if eng.ewma_latency_s >= 0 else floor
+            )
+            # expected wait ~ latency x (queue depth + me): prefers an
+            # idle slightly-slower backend over a piled-up fast one
+            return (lat * (1 + eng.in_flight), eng.in_flight)
+
+        best = min(score(ep) for ep in cands)
+        tied = [ep.url for ep in cands if score(ep) == best]
+        return random.choice(tied)
+
+
 class TtftRouter(RoutingInterface):
     """Estimate time-to-first-token per engine and pick the minimum
     (reference: routing_logic.py:475, _estimate_ttft:612, transfer-time
@@ -436,6 +510,10 @@ class TtftRouter(RoutingInterface):
                             request) -> str:
         if not endpoints:
             raise RuntimeError("no available endpoints")
+        # health-aware (ROADMAP PR 6 follow-on (a)): a TTFT estimate is
+        # meaningless for a backend that will refuse the connection —
+        # skip scoreboard-unhealthy backends before estimating
+        endpoints = self._healthy_endpoints(endpoints)
         text = _engine_prompt_text(request, self.tokenizer)
         n_tokens = self._count_tokens(text)
         # self-observed prompt-size EWMA calibrates the queued-request
@@ -494,6 +572,7 @@ _ROUTERS = {
     RoutingLogic.PREFIXAWARE: PrefixAwareRouter,
     RoutingLogic.DISAGGREGATED_PREFILL: DisaggregatedPrefillRouter,
     RoutingLogic.TTFT: TtftRouter,
+    RoutingLogic.LEAST_LATENCY: LeastLatencyRouter,
 }
 
 

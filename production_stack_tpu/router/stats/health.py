@@ -257,6 +257,12 @@ class EngineHealthBoard:
         return evicted
 
     # -- queries -----------------------------------------------------------
+    def get(self, url: str) -> EngineHealth | None:
+        """Public row accessor for scoreboard consumers (routing
+        policies): the row for a backend the proxy/scraper has touched,
+        or None. Callers must treat the row as read-only."""
+        return self._engines.get(url)
+
     def is_healthy(self, url: str, max_streak: int = 3) -> bool:
         """Cheap go/no-go signal for routing policies: a backend with a
         running failure streak is suspect until a request succeeds."""

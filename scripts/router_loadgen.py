@@ -60,7 +60,9 @@ from production_stack_tpu.router.stats.health import (  # noqa: E402
 )
 from tests.fake_engine import FakeEngine  # noqa: E402
 
-DEFAULT_ALGORITHMS = ("roundrobin", "session", "prefixaware", "ttft")
+DEFAULT_ALGORITHMS = (
+    "roundrobin", "session", "prefixaware", "ttft", "latency",
+)
 
 
 def quiet_logs() -> None:
@@ -84,12 +86,20 @@ ERROR_RATE_GATE = 0.01
 
 @dataclass
 class RunConfig:
-    requests: int = 2560          # per algorithm (4 algos -> 10k+ total)
+    requests: int = 2560          # per algorithm (5 algos -> 12k+ total)
     concurrency: int = 1024       # concurrent streaming sessions
     engines: int = 4
     tokens: int = 8               # streamed chunks per request
     tokens_per_sec: float = 2000.0
     engine_ttft_s: float = 0.0
+    # dead-backend scenario: this many ADDITIONAL backends are listed in
+    # static discovery but not listening (connection refused) — the
+    # health-aware algorithms (ttft/latency) should stop routing to them
+    # after the failure streak, while streak-blind ones keep burning a
+    # connect-retry per pick. Requests still succeed either way (the
+    # proxy retries on the remaining candidates), so the A/B shows up in
+    # per-engine requests_total/retries, not the error gate.
+    dead_engines: int = 0
     algorithms: tuple[str, ...] = DEFAULT_ALGORITHMS
     out: str = "ROUTER_BENCH.json"
 
@@ -183,11 +193,27 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
     ]
     for e in engines:
         await e.start()
+    # dead-backend scenario: bind a port but NEVER listen(2) and keep
+    # the socket open for the whole run — every connect is refused
+    # fast (the dead-pod signature the scoreboard keys on) and the
+    # port can never be recycled to a live socket mid-run (a freed
+    # ephemeral port could be re-assigned and turn the "dead" url
+    # intermittently alive)
+    import socket as _socket
 
+    dead_urls: list[str] = []
+    dead_socks: list[_socket.socket] = []
+    for _ in range(cfg.dead_engines):
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        s.bind(("127.0.0.1", 0))
+        dead_socks.append(s)
+        dead_urls.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+
+    backends = [e.url for e in engines] + dead_urls
     argv = [
         "--service-discovery", "static",
-        "--static-backends", ",".join(e.url for e in engines),
-        "--static-models", ",".join("fake-model" for _ in engines),
+        "--static-backends", ",".join(backends),
+        "--static-models", ",".join("fake-model" for _ in backends),
         "--routing-logic", algo,
         "--engine-stats-interval", "0.5",
         # empty url disables the kv-controller handshake for ttft
@@ -232,6 +258,8 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
     await runner.cleanup()
     for e in engines:
         await e.stop()
+    for s in dead_socks:
+        s.close()
     _reset_routing_logic()
     _reset_service_discovery()
 
@@ -240,7 +268,10 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
     router_errors = 0
     retries = sum(row.get("retries_total", 0) for row in scoreboard)
     for s in samples:
-        if not s["ok"]:
+        if not s["ok"] and s["url"] not in dead_urls:
+            # failed attempts against DEAD backends are the scenario's
+            # own signal (reported under dead_backends below, compared
+            # per algorithm); the error gate guards LIVE backends
             router_errors += 1
         for name, v in s["phases"].items():
             phase_vals.setdefault(name, []).append(v)
@@ -277,6 +308,21 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
         "metrics_exported": metrics_ok,
         "per_engine": scoreboard,
     }
+    if dead_urls:
+        # dead-backend attribution: how much traffic each view of the
+        # scenario burned on the dead urls (health-aware algorithms
+        # should show a small, streak-bounded count; streak-blind ones
+        # pay ~requests/engines in connect-retries)
+        dead_rows = [r for r in scoreboard if r["url"] in dead_urls]
+        result["dead_backends"] = {
+            "urls": dead_urls,
+            "requests_total": sum(
+                r.get("requests_total", 0) for r in dead_rows
+            ),
+            "retries_total": sum(
+                r.get("retries_total", 0) for r in dead_rows
+            ),
+        }
     return result
 
 
@@ -345,6 +391,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="requests per algorithm")
     ap.add_argument("--concurrency", type=int, default=None)
     ap.add_argument("--engines", type=int, default=None)
+    ap.add_argument("--dead-engines", type=int, default=None,
+                    help="additional listed-but-not-listening backends "
+                         "(dead-pod scenario: health-aware algorithms "
+                         "should stop routing to them)")
     ap.add_argument("--tokens", type=int, default=None)
     ap.add_argument("--tokens-per-sec", type=float, default=None)
     ap.add_argument("--engine-ttft-s", type=float, default=None)
@@ -355,8 +405,8 @@ def main(argv: list[str] | None = None) -> int:
     ns = ap.parse_args(argv)
 
     cfg = smoke_config() if ns.smoke else RunConfig()
-    for name in ("requests", "concurrency", "engines", "tokens",
-                 "tokens_per_sec", "engine_ttft_s", "out"):
+    for name in ("requests", "concurrency", "engines", "dead_engines",
+                 "tokens", "tokens_per_sec", "engine_ttft_s", "out"):
         val = getattr(ns, name)
         if val is not None:
             setattr(cfg, name, val)

@@ -248,6 +248,17 @@ def test_elastic_sweep_modifiers_parse():
     assert off[4] == {"PST_BENCH_ELASTIC": "0"}
 
 
+def test_ragged_sweep_modifiers_parse():
+    """@ragged / @noragged drive the unified-ragged-dispatch A/B
+    (lane-typed mixed rounds vs the split alternating control —
+    BENCH_SWEEP_ragged.json, PERF.md chip-queue item 6)."""
+    bench = _load_bench()
+    (on,) = bench._parse_sweep_labels("k8-sync-packed@ragged")
+    assert on[4] == {"PST_BENCH_RAGGED": "1"}
+    (off,) = bench._parse_sweep_labels("k8-sync-packed@noragged")
+    assert off[4] == {"PST_BENCH_RAGGED": "0"}
+
+
 def test_sweep_continues_past_watchdog_config(tmp_path, monkeypatch):
     """Regression (the K=16 wedge, PERF.md round 5 window 2): a config
     whose child hits the 1200 s run watchdog is recorded in the sweep

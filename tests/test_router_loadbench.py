@@ -133,6 +133,96 @@ def test_loadbench_smoke_gate(
     assert json.loads(out.read_text())["algorithms"]["roundrobin"]
 
 
+def test_dead_backend_health_aware_routing(
+    reset_singletons, quiet_router_logs
+):
+    """ROADMAP PR 6 follow-on (a), measured in the harness's
+    per-algorithm comparison: with one listed-but-dead backend, every
+    client request still succeeds under BOTH policies (the proxy's
+    connect-retry covers each bad pick), but the health-aware latency
+    policy stops routing to the dead url once its failure streak trips
+    `is_healthy`, while streak-blind roundrobin burns a connect-retry
+    every cycle."""
+    cfg = loadgen.RunConfig(
+        requests=192, concurrency=48, engines=3, dead_engines=1,
+        tokens=2, tokens_per_sec=8000.0,
+        algorithms=("roundrobin", "latency"),
+    )
+    results = asyncio.run(loadgen.run_suite(cfg))
+    rr = results["algorithms"]["roundrobin"]
+    lat = results["algorithms"]["latency"]
+    for r in (rr, lat):
+        assert r["requests"] == 192
+        assert r["errors"] == 0, "clients must never see the dead pod"
+        assert r["router_errors"] == 0, "live backends must not error"
+        assert loadgen.gates_pass(r) == []
+    # the comparison the scenario exists for: streak-blind routing
+    # keeps paying the dead backend (~requests/backends attempts),
+    # health-aware routing's attempts are bounded by the failure
+    # streak plus in-flight picks racing the first observations
+    dead_rr = rr["dead_backends"]["requests_total"]
+    dead_lat = lat["dead_backends"]["requests_total"]
+    assert dead_rr >= 192 // 4 - 4
+    assert dead_lat <= cfg.concurrency + 3
+    assert dead_lat < dead_rr / 2
+
+
+def test_ttft_and_latency_policies_skip_unhealthy(reset_singletons):
+    """Unit-level: both health-aware policies consult the scoreboard —
+    a backend with a running failure streak is never picked while a
+    healthy candidate exists, and an all-unhealthy fleet degrades to
+    routing (not erroring)."""
+    from production_stack_tpu.router.protocols import (
+        EndpointInfo,
+        RouterRequest,
+    )
+    from production_stack_tpu.router.routing_logic import (
+        LeastLatencyRouter,
+        TtftRouter,
+    )
+    from production_stack_tpu.router.stats.health import (
+        get_engine_health_board,
+    )
+
+    dead, live1, live2 = (
+        "http://e0:8000", "http://e1:8000", "http://e2:8000"
+    )
+    board = get_engine_health_board()
+    for _ in range(4):  # past the is_healthy streak bound
+        board.on_request_start(dead)
+        board.observe(dead, {}, 0.01, ok=False, error_kind="connect")
+    for url, lat_s in ((live1, 0.05), (live2, 0.2)):
+        board.on_request_start(url)
+        board.observe(url, {}, lat_s, ok=True, ttft_s=lat_s / 2)
+    eps = [EndpointInfo(url=u, model_names=["m"])
+           for u in (dead, live1, live2)]
+    req = RouterRequest(
+        headers={}, body={"model": "m", "prompt": "hi"},
+        endpoint="/v1/completions",
+    )
+
+    async def picks(router, n=16):
+        return {
+            await router.route_request(eps, {}, {}, req)
+            for _ in range(n)
+        }
+
+    chosen = asyncio.run(picks(LeastLatencyRouter()))
+    assert dead not in chosen
+    # lowest EWMA latency wins among the healthy
+    assert chosen == {live1}
+    chosen = asyncio.run(picks(TtftRouter()))
+    assert dead not in chosen
+    # all-unhealthy fleet: degrade to the full list, still route
+    for _ in range(4):
+        for u in (live1, live2):
+            board.on_request_start(u)
+            board.observe(u, {}, 0.01, ok=False, error_kind="connect")
+    assert asyncio.run(picks(LeastLatencyRouter())) <= {
+        dead, live1, live2
+    }
+
+
 def test_bench_json_ci_gate():
     """Gate a previously-written ROUTER_BENCH.json (the CI
     router-loadbench job runs the full --smoke profile first, then this

@@ -594,6 +594,49 @@ def test_elastic_decode_hot_marks_present():
         assert not missing, f"{fname}: unmarked hot paths {missing}"
 
 
+def test_ragged_dispatch_stays_off_hot_paths():
+    """Unified ragged dispatch (PR 7): the lane-typed round's host
+    build/stage/dispatch (model_runner._fill_ragged_pack /
+    stage_ragged / ragged_dispatch) and the scheduler's lane planner
+    (plan_ragged_round) run once per engine round — zero unsuppressed
+    device-sync-hot + blocking-async findings over engine/ (the one
+    sanctioned fetch set lives in the UNMARKED bookkeeping helpers,
+    same split as the decode path's step/_resolve_pending)."""
+    report = analyze_paths(
+        [str(PACKAGE / "engine")],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    assert report.files_scanned >= 20
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_ragged_dispatch_hot_marks_present():
+    """The sweep above only bites while the ragged build/stage/plan
+    functions carry the hot-path mark — a dropped mark would pass
+    silently."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    want = {
+        "model_runner.py": {
+            "ragged_dispatch", "stage_ragged", "_fill_ragged_pack",
+        },
+        "scheduler.py": {"plan_ragged_round"},
+    }
+    for fname, funcs in want.items():
+        path = PACKAGE / "engine" / fname
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {
+            f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)
+        }
+        missing = funcs - hot
+        assert not missing, f"{fname}: unmarked hot paths {missing}"
+
+
 def test_router_proxy_stays_off_blocking_paths():
     """Router data plane (PR 6): the proxy hot path
     (route_general_request / process_request) relays every chunk of
