@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 os.environ.setdefault("PST_LOG_LEVEL", "WARNING")  # keep stdout JSON-only
@@ -112,6 +113,19 @@ RAGGED = os.environ.get("PST_BENCH_RAGGED", "1") == "1"
 # BENCH_SWEEP_kvoff_sync.json (@synckv -> --sync-kv-offload control)
 KV_OFFLOAD = os.environ.get("PST_BENCH_KV_OFFLOAD", "0") == "1"
 KV_BLOCKS = int(os.environ.get("PST_BENCH_KV_BLOCKS", "0"))
+# disaggregated prefill/decode (@pd): round-1 prompts prefill on a
+# SEPARATE prefill-role engine (own step thread, in-process
+# KVTransferServer) and the measured decode engine pulls the chain
+# through its PeerTier staged restore before decoding — the PD data
+# plane end to end, colocated on ONE chip (both engines share the
+# device, so weights sit in HBM twice and device work serializes;
+# this measures the transfer machinery's cost/win shape, it
+# UNDERSTATES the multi-chip win where prefill compute is genuinely
+# offloaded — run it with the small-model configs). Rounds 2+ resume
+# directly on the decode engine (prefix-affine, the router pd
+# policy's PPD behavior). @nopd pins the single-engine control.
+# Slots: BENCH_SWEEP_pd.json vs the matching @nopd control (PERF.md)
+PD = os.environ.get("PST_BENCH_PD", "0") == "1"
 SYNC_KV = os.environ.get("PST_BENCH_SYNC_KV", "0") == "1"
 CPU_OFFLOAD_MB = int(os.environ.get("PST_BENCH_CPU_OFFLOAD_MB", "2048"))
 DISK_OFFLOAD_DIR = os.environ.get(
@@ -249,12 +263,16 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_KV_OFFLOAD"] = "1"
             elif m == "synckv":
                 overrides["PST_BENCH_SYNC_KV"] = "1"
+            elif m == "pd":
+                overrides["PST_BENCH_PD"] = "1"
+            elif m == "nopd":
+                overrides["PST_BENCH_PD"] = "0"
             else:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
                     "| trace | elastic | noelastic | ragged | noragged "
-                    "| kvoff | synckv"
+                    "| kvoff | synckv | pd | nopd"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -274,7 +292,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
                 "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
-                "|@noelastic|@ragged|@noragged|@kvoff|@synckv]"
+                "|@noelastic|@ragged|@noragged|@kvoff|@synckv|@pd|@nopd]"
             )
         configs.append((
             label,
@@ -478,6 +496,140 @@ def _arm_watchdog(seconds: float, label: str):
     return t
 
 
+class _PDPrefiller:
+    """@pd bench mode: a colocated prefill-role engine with its own
+    step thread and an in-process KVTransferServer, so the measured
+    decode engine exercises the REAL PD data plane (phase-1 prefill
+    here, chain pull through the decode engine's PeerTier staged
+    restore). Both engines share the one chip — device work serializes
+    and weights sit in HBM twice, which understates the multi-chip win
+    but measures the transfer machinery honestly."""
+
+    def __init__(self, config):
+        import queue as _queue
+
+        from production_stack_tpu.engine.llm_engine import LLMEngine
+        from production_stack_tpu.engine.sampling_params import (
+            SamplingParams,
+        )
+        from production_stack_tpu.kv.transfer import KVTransferServer
+
+        self.engine = LLMEngine(config)
+        self._lock = threading.Lock()
+        self._sp1 = SamplingParams(
+            max_tokens=1, temperature=0.0, ignore_eos=True
+        )
+        self._stop = threading.Event()
+        self._finished: _queue.Queue = _queue.Queue()
+        self._prompts: dict[str, list[int]] = {}
+        self._inflight = 0  # guarded by: self._lock
+        self.submitted = 0
+
+        # the transfer server wants an AsyncLLMEngine-alike: .engine +
+        # ._lock (the lock our step thread holds per step)
+        holder: dict = {"ready": threading.Event()}
+        outer = self
+
+        class _FakeAsync:
+            engine = self.engine
+            _lock = outer._lock
+
+        def serve():
+            async_mod = __import__("asyncio")
+
+            async def run():
+                srv = KVTransferServer(_FakeAsync())
+                await srv.start("127.0.0.1", 0)
+                holder["srv"] = srv
+                holder["port"] = srv.port
+                holder["loop"] = async_mod.get_running_loop()
+                holder["stop"] = async_mod.Event()
+                holder["ready"].set()
+                await holder["stop"].wait()
+                await srv.stop()
+
+            async_mod.run(run())
+
+        self._srv_thread = threading.Thread(target=serve, daemon=True)
+        self._srv_thread.start()
+        assert holder["ready"].wait(10), "kv transfer server stalled"
+        self._holder = holder
+        self.port = holder["port"]
+        self.server = holder["srv"]
+        self._step_thread = threading.Thread(
+            target=self._run, name="pd-prefill-step", daemon=True
+        )
+        self._step_thread.start()
+
+    def warmup(self, prompts) -> None:
+        from production_stack_tpu.engine.sampling_params import (
+            SamplingParams,
+        )
+
+        with self._lock:
+            self.engine.generate(
+                prompts,
+                SamplingParams(
+                    max_tokens=1, temperature=0.0, ignore_eos=True
+                ),
+            )
+
+    def submit(self, rid: str, tokens: list[int]) -> None:
+        with self._lock:
+            self._prompts[rid] = tokens
+            self.engine.add_request(
+                rid, prompt_token_ids=tokens, sampling_params=self._sp1
+            )
+            self._inflight += 1
+            self.submitted += 1
+
+    def drain(self) -> list[tuple[str, list[int]]]:
+        """Finished phase-1 requests, ready for the decode engine."""
+        import queue as _queue
+
+        out = []
+        while True:
+            try:
+                out.append(self._finished.get_nowait())
+            except _queue.Empty:
+                return out
+
+    def busy(self) -> bool:
+        """True while phase-1 work is in flight OR finished results
+        await drain — _inflight decrements at the same moment the
+        result is enqueued, so checking it alone would let the bench
+        loop exit with undrained requests (dropping them, and every
+        later round of their sessions, from the measurement)."""
+        with self._lock:
+            if self._inflight > 0:
+                return True
+        return not self._finished.empty()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                busy = self.engine.has_unfinished()
+                outs = self.engine.step() if busy else []
+                for o in outs:
+                    if o.finished:
+                        self._inflight -= 1
+                        self._finished.put(
+                            (o.request_id,
+                             self._prompts.pop(o.request_id))
+                        )
+            if not busy:
+                self._stop.wait(0.002)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._step_thread.join(timeout=5)
+        self._holder["loop"].call_soon_threadsafe(
+            self._holder["stop"].set
+        )
+        self._srv_thread.join(timeout=5)
+        self.engine.shutdown()
+
+
 def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                label: str) -> dict:
     import gc
@@ -550,6 +702,38 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         tracing_exporter="memory" if TRACE else "none",
         seed=0,
     )
+    pd_prefiller = None
+    if PD:
+        import dataclasses as _dc
+
+        # @pd: a separate prefill-role engine (own step thread + KV
+        # transfer server) takes every round-1 prompt at max_tokens=1;
+        # the measured decode engine pulls the chain through its
+        # PeerTier staged restore. Colocated on the one chip: size the
+        # prefill engine's pool small (it only holds in-flight phase-1
+        # chains until they are pulled) and leave the decode engine
+        # the rest. The prefill engine needs no offload tiers.
+        pf_blocks = 4 * max(
+            1, -(-(SYSTEM_PROMPT_TOK + HISTORY_TOK) // 32)
+        ) * max(2, min(8, NUM_USERS))
+        pd_prefiller = _PDPrefiller(_dc.replace(
+            config,
+            kv_role="prefill",
+            hbm_utilization=0.2,
+            num_kv_blocks=pf_blocks,
+            cpu_offload_bytes=0,
+            disk_offload_dir=None,
+            request_timeline=False,
+            tracing_exporter="none",
+        ))
+        config = _dc.replace(
+            config,
+            kv_role="decode",
+            kv_transfer_config={
+                "peer": f"127.0.0.1:{pd_prefiller.port}"
+            },
+            hbm_utilization=0.6,
+        )
     engine = LLMEngine(config)
     mc = engine.runner.model_config
     print(
@@ -580,6 +764,12 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     # compile lands inside the measurement: full-length prompts select the
     # same prefill/decode ctx buckets as the real pass
     t0 = time.time()
+    if pd_prefiller is not None:
+        # compile the prefill engine's full-prompt buckets FIRST, so
+        # the decode engine's warmup below pulls real chains — warming
+        # the transfer link and the staged-import scatter compile
+        # before the timed run
+        pd_prefiller.warmup(prompts[:2])
     engine.generate(
         prompts[:2],
         SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
@@ -725,18 +915,39 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     decode_time = 0.0
     last_token_t: dict[str, float] = {}
     itls: list[float] = []  # inter-token gaps across all streams
-    while pending or engine.has_unfinished():
+    while (pending or engine.has_unfinished()
+           or (pd_prefiller is not None and pd_prefiller.busy())):
         now = time.time()
         while pending and pending[0][1] <= now:
             rid, due, p = pending.pop(0)
-            engine.add_request(rid, prompt_token_ids=p, sampling_params=sp)
+            if pd_prefiller is not None:
+                # @pd: the cold prompt's phase 1 runs on the prefill
+                # engine; the decode engine admits it after the chain
+                # pull (TTFT still counts from the scheduled arrival —
+                # the whole disaggregated path is the measurement)
+                pd_prefiller.submit(rid, p)
+            else:
+                engine.add_request(
+                    rid, prompt_token_ids=p, sampling_params=sp
+                )
             # TTFT counts from the SCHEDULED arrival: admission delay past
             # `due` is queueing the system caused and must stay in the
             # measurement (avoiding coordinated omission)
             submit_t[rid] = due
+        if pd_prefiller is not None:
+            for rid, toks in pd_prefiller.drain():
+                engine.add_request(
+                    rid, prompt_token_ids=toks, sampling_params=sp
+                )
         if not engine.has_unfinished():
             if pending:
-                time.sleep(max(0.0, pending[0][1] - time.time()))
+                time.sleep(
+                    max(0.0, min(0.002, pending[0][1] - time.time()))
+                    if pd_prefiller is not None
+                    else max(0.0, pending[0][1] - time.time())
+                )
+            elif pd_prefiller is not None:
+                time.sleep(0.001)  # phase-1 in flight on the prefiller
             continue
         st = time.time()
         outs = engine.step()
@@ -894,6 +1105,25 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             # offload-worker wall (overlapped), restore time is
             # enqueue->landed (overlaps queue wait); tier counters show
             # which tier actually served the resumes
+            # disaggregated prefill/decode attribution (@pd): phase-1
+            # count on the prefill engine, peer pull counters on the
+            # decode engine (hits = blocks transferred, fallbacks =
+            # failed pulls), staged-restore landings, and what the
+            # transfer server actually served
+            **({
+                "pd_transfer": {
+                    "colocated_same_chip": True,
+                    "phase1_requests": pd_prefiller.submitted,
+                    "peer": engine.kv_peer.counters(),
+                    "restore_blocks": engine._kv_restore_blocks_total,
+                    "restore_fallbacks":
+                        engine._kv_restore_fallbacks_total,
+                    "transfer_server": {
+                        "chains": pd_prefiller.server.chains_served,
+                        "blocks": pd_prefiller.server.blocks_served,
+                    },
+                },
+            } if PD else {}),
             **({
                 "kv_offload": {
                     "kv_blocks": kv_blocks,
@@ -941,6 +1171,9 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     teardown_guard.start()
     # free the engine (params + KV cache) before the next sweep config
     # allocates its own — two live engines would OOM the chip's HBM
+    if pd_prefiller is not None:
+        pd_prefiller.close()
+        del pd_prefiller
     engine.shutdown()
     del engine
     gc.collect()

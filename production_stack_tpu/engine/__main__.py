@@ -166,12 +166,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "via the propagated traceparent header)")
     # disaggregated prefill / KV transfer
     p.add_argument("--kv-role", default=None,
-                   choices=[None, "kv_producer", "kv_consumer"],
-                   help="disaggregated prefill role")
+                   choices=[None, "prefill", "decode", "both",
+                            "kv_producer", "kv_consumer"],
+                   help="disaggregated prefill/decode role (advertised "
+                        "to the router's `pd` policy via /v1/models; "
+                        "kv_producer/kv_consumer are vLLM-flag-compat "
+                        "aliases for prefill/decode)")
     p.add_argument("--kv-transfer-listen", default=None,
-                   help="host:port to serve KV blocks on (producer)")
+                   help="host:port to serve KV block chains on "
+                        "(prefill/both roles)")
     p.add_argument("--kv-peer", default=None,
-                   help="producer host:port to pull KV from (consumer)")
+                   help="comma list of peer addresses to pull KV from "
+                        "(decode/both roles): prefill engines' "
+                        "--kv-transfer-listen addresses or a "
+                        "kv.cache_server, address-interchangeably")
     # KV offload (LMCache-equivalent)
     p.add_argument("--max-lora-rank", type=int, default=16)
     p.add_argument("--cpu-offload-gb", type=float, default=0.0)
@@ -205,11 +213,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> EngineConfig:
-    role = None
-    if args.kv_role == "kv_producer":
-        role = "prefill"
-    elif args.kv_role == "kv_consumer":
-        role = "decode"
+    # vLLM-flag-compat aliases; prefill/decode/both pass through
+    role = {
+        "kv_producer": "prefill", "kv_consumer": "decode",
+    }.get(args.kv_role, args.kv_role)
     return EngineConfig(
         model=args.model,
         tokenizer=args.tokenizer,

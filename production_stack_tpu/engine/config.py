@@ -172,9 +172,31 @@ class EngineConfig:
     # attention implementation: "auto" | "xla" | "pallas"
     attention_impl: str = "auto"
 
-    # disaggregated prefill role: None | "prefill" | "decode"
+    # disaggregated prefill/decode role: None (undeclared) | "prefill"
+    # | "decode" | "both". Prefill/both engines serve KV chains over
+    # kv_transfer_config["listen"] (kv/transfer.py); decode/both engines
+    # pull through a PeerTier at kv_transfer_config["peer"] (comma list
+    # of peer addresses — a prefill engine or a cache server, address-
+    # interchangeably). The role is advertised on the /v1/models card so
+    # the router's `pd` policy can split the fleet.
     kv_role: str | None = None
     kv_transfer_config: dict = field(default_factory=dict)
+
+    def pd_role(self) -> str | None:
+        """Resolved PD role for discovery: the explicit kv_role, else
+        inferred from the transfer config ('both' when an engine both
+        serves and pulls), else None (not PD-configured)."""
+        if self.kv_role in ("prefill", "decode", "both"):
+            return self.kv_role
+        cfg = self.kv_transfer_config or {}
+        listen, peer = cfg.get("listen"), cfg.get("peer")
+        if listen and peer:
+            return "both"
+        if listen:
+            return "prefill"
+        if peer:
+            return "decode"
+        return None
 
     # -- observability ------------------------------------------------
     # per-request lifecycle timeline (tracing/timeline.py): enqueue ->
@@ -218,6 +240,11 @@ class EngineConfig:
         if self.scheduling_policy not in ("fcfs", "priority"):
             raise ValueError(
                 "scheduling_policy must be 'fcfs' or 'priority'"
+            )
+        if self.kv_role not in (None, "prefill", "decode", "both"):
+            raise ValueError(
+                "kv_role must be one of None/'prefill'/'decode'/'both',"
+                f" got {self.kv_role!r}"
             )
         # n=0 would make the prompt-lookup window match every position
         # (arr[-0:] is the whole context), degenerating drafts to noise.

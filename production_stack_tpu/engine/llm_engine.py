@@ -243,7 +243,22 @@ class LLMEngine:
             )
         from production_stack_tpu.kv.offload import build_offload_manager
 
-        self.offload = build_offload_manager(config, self.kv_reporter)
+        # -- disaggregated-prefill consumer side (reference capability:
+        # decode pod pulls KV produced by the prefill pod via NIXL; ours
+        # pulls content-addressed chains through a PeerTier that rides
+        # the offload manager's pending-READ map — the transport-
+        # agnostic fetch interface — so the staged-restore path below
+        # handles peer pulls with ZERO blocking socket IO on the
+        # scheduler thread, kv/peer.py) -----------------------------------
+        self.kv_peer = None
+        _peer_spec = (config.kv_transfer_config or {}).get("peer")
+        if _peer_spec and config.kv_role != "prefill":
+            from production_stack_tpu.kv.peer import PeerTier
+
+            self.kv_peer = PeerTier(_peer_spec)
+        self.offload = build_offload_manager(
+            config, self.kv_reporter, peer=self.kv_peer
+        )
         if self.kv_reporter is not None:
             bm = self.block_manager
             bm.on_admit = lambda hs: self.kv_reporter.admit("hbm", hs)
@@ -287,7 +302,10 @@ class LLMEngine:
         self._kv_restore_bytes_total = 0
         self._kv_restore_fallbacks_total = 0
         self._kv_export_sync_fallbacks_total = 0
-        if self.offload is not None:
+        if self.offload is not None and self.offload.tiers:
+            # export hooks only where there is somewhere to export TO: a
+            # peer-only manager (pure PD decode engine) must not pin and
+            # d2h-snapshot freed blocks into an empty cascade
             if self._kv_async:
                 self.block_manager.on_freed_cached = (
                     self._queue_freed_exports
@@ -298,20 +316,7 @@ class LLMEngine:
                     self._offload_freed_blocks
                 )
 
-        # -- disaggregated-prefill consumer side (reference capability:
-        # decode pod pulls KV produced by the prefill pod via NIXL; ours
-        # pulls content-addressed blocks over TCP, kv/transfer.py) --------
-        self.kv_transfer_client = None
-        peer = (config.kv_transfer_config or {}).get("peer")
-        if config.kv_role == "decode" and peer:
-            from production_stack_tpu.kv import transfer
-            from production_stack_tpu.kv.wire import parse_addr
-
-            self.kv_transfer_client = transfer.KVTransferClient(
-                *parse_addr(peer, transfer.DEFAULT_PORT)
-            )
-
-        if self.offload is not None or self.kv_transfer_client is not None:
+        if self.offload is not None:
             self.scheduler.kv_restore = self._restore_from_offload
 
     # -- KV offload integration -------------------------------------------
@@ -470,24 +475,45 @@ class LLMEngine:
             bm.num_blocks - 1,
             self.scheduler.config.max_model_len // bm.block_size,
         )
+        has_peer = self.offload.peer is not None
         i = 0
-        want: list[int] = []
+        want: list[int] = []   # ordered fetch list (local + peer)
+        local: list[int] = []  # hashes a local tier claims to hold
+        remote: list[int] = []  # tail the PD peer may hold (one pull)
         while i < len(hashes) and len(want) < cap:
             h = hashes[i]
             if bm.contains_hash(h):
                 i += 1  # already resident: nothing to fetch
                 continue
-            if not self.offload.contains(h):
+            if self.offload.contains(h):
+                want.append(h)
+                local.append(h)
+            elif has_peer:
+                # past the local continuation the PD peer may still
+                # hold the chain (it just prefilled this prompt, or a
+                # shared cache server has it) — the whole tail rides
+                # ONE get_chain pull on the offload worker
+                want.append(h)
+                remote.append(h)
+            else:
                 break  # chain continuation ends here
-            want.append(h)
             i += 1
         if not want:
             return None, hashes
-        self.offload.request_reads(want)
+        if local:
+            self.offload.request_reads(local)
+        if remote:
+            self.offload.request_chain_reads(remote)
         rec = {
             "rid": seq.request_id,
             "hashes": hashes,
             "want": want,
+            # pure-peer records (no local tier claimed anything) that
+            # come back empty are COLD PROMPTS the peer never
+            # prefilled (e.g. a resume's new tail) — finalize must not
+            # count them as restore fallbacks (kv_peer_misses already
+            # carries that signal)
+            "peer_only": bool(remote) and not local,
             "state": "fetching",
             "t0": time.monotonic(),
             "handle": None,
@@ -556,6 +582,7 @@ class LLMEngine:
         # references and starve it)
         rec["state"] = "failed"
         if not usable:
+            rec["nothing_fetched"] = True
             return
         data = np.stack([a for _, a, _ in usable], axis=2)
         rec["handle"] = self.runner.stage_import_blocks(data)
@@ -575,7 +602,13 @@ class LLMEngine:
         the adopted blocks in place via the donated import."""
         self._kv_restores.pop(rec["rid"], None)
         if rec["state"] != "staged":
-            self._kv_restore_fallbacks_total += 1
+            if not (rec.get("peer_only") and rec.get("nothing_fetched")):
+                # an empty PURE-PEER fetch is a cold prompt the peer
+                # never held, not a failed restore (kv_peer_misses /
+                # kv_peer_fallbacks carry that signal); everything
+                # else — local chain break, staging error, timeout —
+                # still counts
+                self._kv_restore_fallbacks_total += 1
             return
         bm = self.block_manager
         if self._kv_export_pending:
@@ -695,14 +728,13 @@ class LLMEngine:
         if rec is None:
             # no record (preempted requeue, fetch-cap skip, or blocks
             # offloaded after enqueue): begin the ASYNC fetch now —
-            # still no tier IO on this thread (satellite: fallback
-            # paths go through the worker's pending-read map too).
+            # still no tier IO on this thread (fallback paths go
+            # through the worker's pending-read map too, and PD peer
+            # pulls ride the same staged restore as chain reads).
             # _kv_async guarantees self.offload is set here.
-            rec, hashes = self._begin_kv_restore(seq, force=True)
+            rec, _hashes = self._begin_kv_restore(seq, force=True)
             if rec is None:
-                self._pd_transfer_restore(seq, hashes)
                 return True
-        hashes = rec["hashes"]
         try:
             self._advance_kv_restore(rec)
         except Exception:  # noqa: BLE001 — staging failure (device_put
@@ -738,20 +770,17 @@ class LLMEngine:
             rec["last_defer"] = now
             if rec.get("held_s", 0.0) < self.config.kv_restore_wait_s:
                 return False
-            # wedged/slow tier: recompute rather than stall admission
+            # wedged/slow tier or dead PD peer: recompute rather than
+            # stall admission (the peer pull already rode the staged
+            # fetch — no second, blocking pull happens here)
             logger.warning(
                 "kv restore for %s held admission %.1fs; recomputing",
                 seq.request_id, self.config.kv_restore_wait_s,
             )
             self._drop_kv_restore(seq.request_id)
             self._kv_restore_fallbacks_total += 1
-            self._pd_transfer_restore(seq, hashes)
             return True
         self._finalize_kv_restore(seq, rec)
-        if not rec.get("hbm_full"):
-            # with the pool exhausted a peer pull is pointless (the old
-            # sync path's hbm_full gate): nothing could be adopted
-            self._pd_transfer_restore(seq, hashes)
         return True
 
     def _restore_sync(self, seq: Sequence) -> None:
@@ -792,14 +821,16 @@ class LLMEngine:
     def _pd_transfer_restore(
         self, seq: Sequence, hashes: list[int] | None = None
     ) -> None:
-        """Disaggregated-prefill consumer pull (NIXL-receive role): one
-        batched TCP round-trip from the prefill peer for whatever the
-        local tiers could not supply. Stays synchronous — it is the PD
-        handoff path, not the tier path (the decode pod has nothing to
-        run before its prefill peer's KV arrives anyway). `hashes` is
-        the precomputed chain when the caller already has it (one
-        hashing pass per admission)."""
-        if self.kv_transfer_client is None:
+        """SYNC-MODE disaggregated-prefill consumer pull: one batched
+        blocking round-trip from the PD peer for whatever the local
+        tiers could not supply. Only reachable from _restore_sync
+        (--sync-kv-offload attribution control and multihost engines) —
+        the zero-stall async path routes peer pulls through the staged
+        restore's pending-READ map instead (request_chain_reads), so no
+        socket ever runs on the scheduler thread there. `hashes` is the
+        precomputed chain when the caller already has it (one hashing
+        pass per admission)."""
+        if self.kv_peer is None:
             return
         bm = self.block_manager
         if hashes is None:
@@ -811,18 +842,18 @@ class LLMEngine:
             i += 1
         if i >= len(hashes):
             return
-        data = self.kv_transfer_client.get_chain(hashes[i:])
-        if data is None:
+        blocks, _peer = self.kv_peer.get_chain(hashes[i:])
+        if not blocks:
             return
         restore: list[tuple[int, np.ndarray]] = []
         adopted: list[int] = []
-        for j in range(data.shape[2]):
+        for j, arr in enumerate(blocks):
             if not bm.can_adopt_another(len(restore)):
                 break  # see can_adopt_another
             bid = bm.adopt_cached_block(hashes[i + j])
             if bid is None:
                 break
-            restore.append((bid, data[:, :, j]))
+            restore.append((bid, arr))
             adopted.append(hashes[i + j])
         self._import_restored_host(restore, adopted)
 
@@ -3254,11 +3285,9 @@ class LLMEngine:
         if hasattr(self.runner, "shutdown_followers"):
             self.runner.shutdown_followers()
         if self.offload is not None:
-            self.offload.close()
+            self.offload.close()  # also closes the PD PeerTier
         if self.kv_reporter is not None:
             self.kv_reporter.close()
-        if self.kv_transfer_client is not None:
-            self.kv_transfer_client.close()
 
     # -- embeddings (stateless one-shots, /v1/embeddings) -------------------
     def embed_one(
@@ -3337,6 +3366,20 @@ class LLMEngine:
             kv_tier_counters=(
                 self.offload.counters()
                 if self.offload is not None else {}
+            ),
+            kv_peer_hits_total=(
+                self.kv_peer.hits if self.kv_peer is not None else 0
+            ),
+            kv_peer_misses_total=(
+                self.kv_peer.misses if self.kv_peer is not None else 0
+            ),
+            kv_peer_read_bytes_total=(
+                self.kv_peer.read_bytes
+                if self.kv_peer is not None else 0
+            ),
+            kv_peer_fallbacks_total=(
+                self.kv_peer.fallbacks
+                if self.kv_peer is not None else 0
             ),
         )
 
@@ -3467,9 +3510,10 @@ class LLMEngine:
             n += rnr.precompile_verify(
                 ctxs, cfg.num_speculative_tokens + 1, cfg.max_num_seqs
             )
-        if self.offload is not None or self.kv_transfer_client is not None:
-            # staged restores dispatch the donated import scatter; warm
-            # its pow2 buckets so no XLA compile lands inside a live
-            # admission (a restore chain is at most max_model_len blocks)
+        if self.offload is not None:
+            # staged restores (tier AND PD peer pulls) dispatch the
+            # donated import scatter; warm its pow2 buckets so no XLA
+            # compile lands inside a live admission (a restore chain is
+            # at most max_model_len blocks)
             n += rnr.precompile_kv_import(cap // bs)
         return n

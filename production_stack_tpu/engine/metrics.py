@@ -174,6 +174,31 @@ class EngineMetrics:
             "backlog cap (tier IO slower than eviction churn)",
             label, registry=reg,
         )
+        # disaggregated prefill/decode transfer (PeerTier pulls):
+        # blocks the PD peer served / could not serve, bytes over the
+        # transfer link, and failed pulls (dead peer, corrupt frame)
+        self.kv_peer_hits = Counter(
+            "tpu:kv_peer_hits",
+            "KV blocks pulled from the disaggregated-prefill peer",
+            label, registry=reg,
+        )
+        self.kv_peer_misses = Counter(
+            "tpu:kv_peer_misses",
+            "KV blocks requested from the PD peer but not served "
+            "(chain evicted or never prefilled there)",
+            label, registry=reg,
+        )
+        self.kv_peer_read_bytes = Counter(
+            "tpu:kv_peer_read_bytes",
+            "Bytes pulled over the inter-engine KV transfer link",
+            label, registry=reg,
+        )
+        self.kv_peer_fallbacks = Counter(
+            "tpu:kv_peer_fallbacks",
+            "Failed PD peer pulls (dead peer / mid-frame death / "
+            "corrupt payload) that degraded to local recompute",
+            label, registry=reg,
+        )
         # elastic fused decode: per-round chosen K (adaptive sizing in
         # pow2 buckets up to num_scheduler_steps), host-discarded
         # overshoot tokens (the K=32 waste mode — ~0 under device
@@ -334,6 +359,16 @@ class EngineMetrics:
         self.kv_export_sync_fallbacks.labels(m).inc(max(
             0, s.kv_export_sync_fallbacks_total
             - prev.kv_export_sync_fallbacks_total))
+        self.kv_peer_hits.labels(m).inc(max(
+            0, s.kv_peer_hits_total - prev.kv_peer_hits_total))
+        self.kv_peer_misses.labels(m).inc(max(
+            0, s.kv_peer_misses_total - prev.kv_peer_misses_total))
+        self.kv_peer_read_bytes.labels(m).inc(max(
+            0, s.kv_peer_read_bytes_total
+            - prev.kv_peer_read_bytes_total))
+        self.kv_peer_fallbacks.labels(m).inc(max(
+            0, s.kv_peer_fallbacks_total
+            - prev.kv_peer_fallbacks_total))
         for tier, c in (s.kv_tier_counters or {}).items():
             pc = (prev.kv_tier_counters or {}).get(tier, {})
             self.kv_tier_hits.labels(m, tier).inc(

@@ -88,6 +88,14 @@ class EngineStatsSnapshot:
     kv_export_sync_fallbacks_total: int = 0
     # tier name -> {hits, misses, read_bytes, write_bytes}
     kv_tier_counters: dict = field(default_factory=dict)
+    # disaggregated-prefill peer pulls (PeerTier): blocks served by /
+    # missing from the PD peer, bytes pulled over the transfer link,
+    # and failed pulls (dead peer, corrupt frame) — tpu:kv_peer_* in
+    # /metrics and the bench `pd_transfer` detail slot
+    kv_peer_hits_total: int = 0
+    kv_peer_misses_total: int = 0
+    kv_peer_read_bytes_total: int = 0
+    kv_peer_fallbacks_total: int = 0
 
     @property
     def prefix_cache_hit_rate(self) -> float:

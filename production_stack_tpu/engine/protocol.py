@@ -276,6 +276,7 @@ def multi_choice_response(
 def model_card(
     name: str, root: str | None = None,
     kv_instance_id: str | None = None,
+    kv_role: str | None = None,
 ) -> dict:
     card = {
         "id": name,
@@ -293,4 +294,8 @@ def model_card(
         # id == host:port convention (reference role:
         # src/gateway_inference_extension/kv_aware_picker.go:90-131)
         card["kv_instance_id"] = kv_instance_id
+    if kv_role is not None:
+        # PD role (prefill/decode/both) for the router's `pd` policy —
+        # discovery labels this endpoint without k8s label plumbing
+        card["kv_role"] = kv_role
     return card

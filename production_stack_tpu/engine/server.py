@@ -135,10 +135,12 @@ class EngineServer:
             self._trace_flush_task = spawn_watched(
                 otlp_flush_loop(self.engine.tracer), "engine-trace-flush"
             )
-        # disaggregated prefill producer: serve KV blocks to decode peers
-        # (reference: NIXL sender role, LMCACHE_NIXL_ROLE=sender)
+        # disaggregated prefill producer: serve KV block chains to
+        # decode peers (reference: NIXL sender role,
+        # LMCACHE_NIXL_ROLE=sender). prefill AND both roles serve —
+        # a both-role engine can hand its chains to any peer.
         listen = (self.config.kv_transfer_config or {}).get("listen")
-        if self.config.kv_role == "prefill" and listen:
+        if listen and self.config.pd_role() in ("prefill", "both"):
             from production_stack_tpu.kv import transfer
             from production_stack_tpu.kv.wire import parse_addr
 
@@ -1157,6 +1159,7 @@ class EngineServer:
         cards = [proto.model_card(
             self.model_name,
             kv_instance_id=self.config.kv_instance_id,
+            kv_role=self.config.pd_role(),
         )]
         cards += [
             proto.model_card(name, root=path)

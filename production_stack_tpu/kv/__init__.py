@@ -16,4 +16,6 @@ Modules:
   controller    KVController server, KVControllerClient, ControllerReporter
   offload       CpuTier / DiskTier / RemoteTier + KVOffloadManager
   cache_server  standalone remote KV cache server process + client
+  transfer      disaggregated-prefill producer (KVTransferServer)
+  peer          PeerTier — zero-stall inter-engine chain pulls (consumer)
 """

@@ -70,6 +70,23 @@ class KVCacheServer:
                     return arr
         return None
 
+    def get_chain(self, hashes: list[int]) -> np.ndarray | None:
+        """Longest stored run of `hashes` -> (2, L, n, nkv, bs, d) or
+        None — the same chain semantics as the prefill engine's
+        KVTransferServer, so a decode engine's PeerTier can point at a
+        shared cache server address-interchangeably with a prefill
+        peer (and a multi-engine fleet can hand off KV through the
+        cache instead of engine-to-engine sockets)."""
+        out: list[np.ndarray] = []
+        for h in hashes:
+            arr = self.get(h)
+            if arr is None:
+                break
+            out.append(arr)
+        if not out:
+            return None
+        return np.stack(out, axis=2)
+
     def exists(self, h: int) -> bool:
         with self._lock:
             return any(t.contains(h) for t in self.tiers)
@@ -119,6 +136,17 @@ class KVCacheServer:
                         await wire.send_msg(
                             writer, {"ok": True, "found": True},
                             serialize_block(arr),
+                        )
+                elif t == "get_chain":
+                    data = await asyncio.get_running_loop().run_in_executor(
+                        None, self.get_chain, msg["hashes"]
+                    )
+                    if data is None:
+                        await wire.send_msg(writer, {"ok": True, "n": 0})
+                    else:
+                        await wire.send_msg(
+                            writer, {"ok": True, "n": int(data.shape[2])},
+                            serialize_block(data),
                         )
                 elif t == "exists":
                     await wire.send_msg(

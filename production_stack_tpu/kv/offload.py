@@ -47,13 +47,32 @@ def _nbytes(arr: np.ndarray) -> int:
     return int(arr.nbytes)
 
 
+#: wire tag for bfloat16 payloads (np.save's own frames start with
+#: \x93NUMPY, so the tag is unambiguous). np.save/np.load round-trip
+#: only builtin dtypes: an ml_dtypes.bfloat16 array saves as raw void
+#: ('|V2') and loads back un-importable — bf16 caches (the production
+#: default) would silently lose every disk-tier and inter-engine
+#: restore to the import-time dtype error.
+_BF16_TAG = b"KVBF16\x00\x00"
+
+
 def serialize_block(arr: np.ndarray) -> bytes:
     buf = io.BytesIO()
+    if arr.dtype.name == "bfloat16":
+        buf.write(_BF16_TAG)
+        arr = np.ascontiguousarray(arr).view(np.uint16)
     np.save(buf, arr, allow_pickle=False)
     return buf.getvalue()
 
 
 def deserialize_block(data: bytes) -> np.ndarray:
+    if data[: len(_BF16_TAG)] == _BF16_TAG:
+        import ml_dtypes
+
+        bits = np.load(
+            io.BytesIO(data[len(_BF16_TAG):]), allow_pickle=False
+        )
+        return bits.view(ml_dtypes.bfloat16)
     return np.load(io.BytesIO(data), allow_pickle=False)
 
 
@@ -328,8 +347,14 @@ class KVOffloadManager:
     (--sync-kv-offload and unit tests).
     """
 
-    def __init__(self, tiers: list[KVTier], reporter=None):
+    def __init__(self, tiers: list[KVTier], reporter=None, peer=None):
         self.tiers = tiers
+        # optional kv.peer.PeerTier (disaggregated prefill): NOT part of
+        # the cascade — evictions never push to a peer and contains()
+        # never asks the network. Peers are consulted only through
+        # request_chain_reads (one chain pull per restore, on the
+        # worker) and the --sync-kv-offload control path.
+        self.peer = peer
         self.reporter = reporter
         # guards the pending-write/pending-read maps and the per-tier
         # counters; tiers are internally locked so the worker thread's
@@ -442,6 +467,36 @@ class KVOffloadManager:
         for h in enq:
             self._q.put(("read", h))
 
+    # stackcheck: hot-path — called at add_request on the scheduler
+    # thread: refcount + queue bookkeeping only; the peer's blocking
+    # socket round-trip runs on the worker (_do_chain_read)
+    def request_chain_reads(self, hashes: list[int]) -> None:
+        """Queue ONE peer chain pull for `hashes` (staged restore over
+        the inter-engine transfer). Same refcount contract as
+        request_reads; hashes already fetching/fetched ride the
+        existing entry, the rest travel as a single get_chain
+        round-trip (the chain hash is the address — no per-block
+        requests). Without a peer, the hashes park as misses so the
+        caller's poll/take flow needs no special case."""
+        enq: list[int] = []
+        with self._lock:
+            for h in hashes:
+                self._read_refs[h] = self._read_refs.get(h, 0) + 1
+                if (h not in self._pending_reads
+                        and h not in self._requested_reads):
+                    self._requested_reads.add(h)
+                    enq.append(h)
+        if not enq:
+            return
+        if self.peer is None:
+            with self._lock:
+                for h in enq:
+                    self._requested_reads.discard(h)
+                    if self._read_refs.get(h, 0) > 0:
+                        self._pending_reads[h] = (None, None)
+            return
+        self._q.put(("chain", enq))
+
     def poll_reads(self, hashes: list[int]) -> dict[int, tuple]:
         """Completed subset of `hashes`: h -> (arr | None, tier_name)."""
         with self._lock:
@@ -532,14 +587,19 @@ class KVOffloadManager:
     def stats(self) -> list[dict]:
         with self._lock:
             n_pending = len(self._pending)
-        return [t.stats() for t in self.tiers] + [
+        out = [t.stats() for t in self.tiers] + [
             {"tier": "pending", "blocks": n_pending,
              "hits": self.hits, "misses": self.misses}
         ]
+        if self.peer is not None:
+            out.append(self.peer.stats())
+        return out
 
     def close(self) -> None:
         self._stop.set()
         self._worker.join(timeout=2.0)
+        if self.peer is not None:
+            self.peer.close()
 
     # -- worker thread -----------------------------------------------------
     def _run(self) -> None:
@@ -554,6 +614,8 @@ class KVOffloadManager:
                     self._do_write(job[1], job[2])
                 elif kind == "export":
                     self._do_export(job[1], job[2], job[3], job[4])
+                elif kind == "chain":
+                    self._do_chain_read(job[1])
                 else:
                     self._do_read(job[1])
             except Exception:  # noqa: BLE001 — one bad block/file must
@@ -564,14 +626,16 @@ class KVOffloadManager:
                         for h in job[1]:
                             if self._pending.get(h) is _EXPORT_PENDING:
                                 self._pending.pop(h, None)
-                elif kind == "read":
+                elif kind in ("read", "chain"):
+                    failed = job[1] if kind == "chain" else [job[1]]
                     with self._lock:
-                        self._requested_reads.discard(job[1])
-                        if self._read_refs.get(job[1], 0) > 0:
-                            # same refcount guard as _do_read: parking
-                            # an unowned failure entry would block the
-                            # NEXT restore's fresh fetch of this hash
-                            self._pending_reads[job[1]] = (None, None)
+                        for h in failed:
+                            self._requested_reads.discard(h)
+                            if self._read_refs.get(h, 0) > 0:
+                                # same refcount guard as _do_read:
+                                # parking an unowned failure entry would
+                                # block the NEXT restore's fresh fetch
+                                self._pending_reads[h] = (None, None)
 
     def _do_write(self, h: int, arr: np.ndarray) -> None:
         try:
@@ -622,6 +686,33 @@ class KVOffloadManager:
                 # requesters all dropped (abort/timeout) is garbage
                 self._pending_reads[h] = (arr, tier_name)
 
+    def _do_chain_read(self, hashes: list[int]) -> None:
+        """Peer-chain-pull body: ONE blocking get_chain round-trip on
+        this worker thread, per-block results parked for the
+        requester(s) exactly like local tier reads (the pending-READ
+        map is the transport-agnostic fetch interface). The served
+        prefix parks as tier 'peer'; the unserved tail parks as misses
+        so the owning restore truncates at the break and recomputes."""
+        blocks, _ = self.peer.get_chain(hashes)
+        counts: dict[str, int] = {}
+        if blocks:
+            counts = {
+                "hits": len(blocks),
+                "read_bytes": sum(int(b.nbytes) for b in blocks),
+            }
+        if len(blocks) < len(hashes):
+            counts["misses"] = len(hashes) - len(blocks)
+        with self._lock:
+            for i, h in enumerate(hashes):
+                self._requested_reads.discard(h)
+                if self._read_refs.get(h, 0) > 0:
+                    if i < len(blocks):
+                        self._pending_reads[h] = (blocks[i], "peer")
+                    else:
+                        self._pending_reads[h] = (None, None)
+        if counts:
+            self._count_all({"peer": counts})
+
     def _store(self, h: int, arr: np.ndarray) -> None:
         cascade = [(h, arr)]
         for tier in self.tiers:
@@ -654,8 +745,13 @@ class KVOffloadManager:
         # fell off the last tier: gone for good (controller already told)
 
 
-def build_offload_manager(config, reporter=None) -> KVOffloadManager | None:
-    """Construct tiers from EngineConfig (cpu/disk/remote settings)."""
+def build_offload_manager(
+    config, reporter=None, peer=None
+) -> KVOffloadManager | None:
+    """Construct tiers from EngineConfig (cpu/disk/remote settings).
+    `peer` is an optional kv.peer.PeerTier: a peer-only manager (no
+    local tiers) is valid — disaggregated decode engines restore
+    through the same pending-READ map without any offload tier."""
     tiers: list[KVTier] = []
     if config.cpu_offload_bytes:
         tiers.append(CpuTier(config.cpu_offload_bytes))
@@ -668,6 +764,6 @@ def build_offload_manager(config, reporter=None) -> KVOffloadManager | None:
         tiers.append(
             RemoteTier(RemoteCacheClient(host or "127.0.0.1", int(port)))
         )
-    if not tiers:
+    if not tiers and peer is None:
         return None
-    return KVOffloadManager(tiers, reporter)
+    return KVOffloadManager(tiers, reporter, peer=peer)

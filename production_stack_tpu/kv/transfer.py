@@ -1,34 +1,36 @@
-"""Disaggregated-prefill KV transfer: prefiller serves KV blocks, decoder
-pulls them by content hash.
+"""Disaggregated-prefill KV transfer: the prefill engine serves KV
+block chains, decode engines pull them by content hash.
 
 Replaces the reference's NIXL/UCX side channel (reference: helm env
 LMCACHE_NIXL_ROLE/PEER/BUFFER + UCX_TLS, deployment-vllm-multi.yaml:273-305;
 examples/disaggregated_prefill/pd.yaml) with a TPU-native design: KV blocks
 are content-addressed by the same chained block hash the prefix cache and
 KV controller use, so the decoder simply asks the prefiller "give me the
-longest run of this hash chain" in ONE round-trip, then imports the blocks
-into its own HBM cache via a single host->device copy. No rendezvous or
-transfer-id plumbing: the prompt itself is the address. If the prefiller
-has already evicted the blocks, the decoder recomputes the prefill locally
-— graceful degradation, never a stall.
+longest run of this hash chain" in ONE round-trip, then lands the blocks
+through its staged-restore path. No rendezvous or transfer-id plumbing:
+the prompt itself is the address. If the prefiller has already evicted
+the blocks, the decoder recomputes the prefill locally — graceful
+degradation, never a stall.
 
-Producer side runs inside the prefill engine's aiohttp process; the
-device->host export takes the engine step-loop lock briefly (one batched
-gather per pull). Consumer side is a blocking client called from the
-decode engine's admission path (Scheduler.kv_restore), bounded by a short
-timeout so a dead prefiller cannot stall decode admission.
+Producer side runs inside the prefill engine's aiohttp process and uses
+the PR 4 export primitives end to end: a pull takes the engine step-loop
+lock ONLY for the cheap host-map resolve + `pin_for_export` +
+`stage_export_blocks` ENQUEUE (microseconds — device ops execute in
+enqueue order, so later dispatches cannot overwrite the snapshot), then
+releases it before the blocking d2h materialization runs on the
+executor thread. The pre-PR-8 version held the lock across the whole
+d2h gather, stalling the prefill engine's step loop for every pull.
+
+Consumer side is `kv.peer.PeerTier`, driven through the offload
+manager's pending-READ map — see peer.py for the zero-stall contract.
 """
 
 from __future__ import annotations
 
 import asyncio
-import socket
-import threading
-
-import numpy as np
 
 from production_stack_tpu.kv import wire
-from production_stack_tpu.kv.offload import deserialize_block, serialize_block
+from production_stack_tpu.kv.offload import serialize_block
 from production_stack_tpu.utils.log import init_logger
 
 logger = init_logger(__name__)
@@ -40,15 +42,26 @@ class KVTransferServer:
     """Serves `get_chain` requests from the prefill engine's KV cache."""
 
     def __init__(self, async_engine):
-        # async_engine: engine.async_engine.AsyncLLMEngine — we need its
-        # step-loop lock to read block state + export device blocks safely
+        # async_engine: engine.async_engine.AsyncLLMEngine — its _lock is
+        # the engine state lock (held by the step loop per step and by
+        # add_request); we take it only for resolve/pin/enqueue
         self.async_engine = async_engine
         self._server: asyncio.AbstractServer | None = None
         self.chains_served = 0
         self.blocks_served = 0
 
-    def _export_chain(self, hashes: list[int]) -> np.ndarray | None:
-        """Longest available run of `hashes` -> (2, L, n, nkv, bs, d)."""
+    # stackcheck: hot-path — runs UNDER the engine step-loop lock (the
+    # step thread is excluded while we hold it): cheap host-map walk +
+    # pin + gather ENQUEUE only; the blocking d2h materialization
+    # happens in _export_chain AFTER the lock is released
+    def _snapshot_chain(self, hashes: list[int]):
+        """Resolve the longest resident run of `hashes` and enqueue its
+        device-side snapshot. Returns (n_blocks, handle) or None.
+
+        Pin + unpin bracket the gather enqueue exactly like
+        `LLMEngine._flush_kv_exports`: once the gather is enqueued,
+        device-op ordering protects the snapshot, so the pins release
+        before the lock does."""
         eng = self.async_engine.engine
         with self.async_engine._lock:
             bm = eng.block_manager
@@ -60,15 +73,37 @@ class KVTransferServer:
                 bids.append(bid)
             if not bids:
                 return None
-            data = eng.runner.export_blocks(bids)
+            bm.pin_for_export(bids)
+            try:
+                handle = eng.runner.stage_export_blocks(bids)
+            finally:
+                bm.unpin_exported(bids)
+        return len(bids), handle
+
+    def _export_chain(self, hashes: list[int]):
+        """Executor-thread body of one pull: snapshot under the lock,
+        materialize (blocking d2h) outside it."""
+        snap = self._snapshot_chain(hashes)
+        if snap is None:
+            return None
+        n, handle = snap
+        # the d2h fetch + wire relayout run WITHOUT the engine lock —
+        # the prefill engine keeps stepping while the pull drains
+        data = self.async_engine.engine.runner.materialize_export(handle)
         self.chains_served += 1
-        self.blocks_served += len(bids)
+        self.blocks_served += n
         return data
 
     async def start(self, host: str = "0.0.0.0",
                     port: int = DEFAULT_PORT) -> None:
         self._server = await asyncio.start_server(self._handle, host, port)
         logger.info("kv-transfer server (prefill role) on %s:%d", host, port)
+
+    @property
+    def port(self) -> int | None:
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -104,59 +139,3 @@ class KVTransferServer:
                     )
         finally:
             writer.close()
-
-
-class KVTransferClient:
-    """Decode-side blocking puller (runs on the engine step-loop thread)."""
-
-    def __init__(self, host: str, port: int, timeout: float = 5.0):
-        self.host, self.port, self.timeout = host, port, timeout
-        self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
-        self.pulls = 0
-        self.blocks_pulled = 0
-
-    def _ensure(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = socket.create_connection(
-                (self.host, self.port), timeout=self.timeout
-            )
-            self._sock.settimeout(self.timeout)
-        return self._sock
-
-    def get_chain(self, hashes: list[int]) -> np.ndarray | None:
-        """Longest run of `hashes` the peer holds, or None.
-
-        Returns (2, L, n, nkv, bs, d) with n <= len(hashes)."""
-        if not hashes:
-            return None
-        with self._lock:
-            try:
-                s = self._ensure()
-                wire.sync_send(s, {"type": "get_chain", "hashes": hashes})
-                reply, payload = wire.sync_recv(s)
-            except (OSError, RuntimeError, ValueError) as e:
-                # OSError: network; WireError(RuntimeError): peer died
-                # mid-frame; ValueError: corrupt frame — all must degrade
-                # to a local prefill, never escape into the step loop
-                self.close()
-                logger.warning("kv-transfer pull failed: %s", e)
-                return None
-        if not reply.get("ok") or reply.get("n", 0) == 0:
-            return None
-        try:
-            data = deserialize_block(payload)
-        except ValueError as e:
-            logger.warning("kv-transfer payload corrupt: %s", e)
-            return None
-        self.pulls += 1
-        self.blocks_pulled += int(data.shape[2])
-        return data
-
-    def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None

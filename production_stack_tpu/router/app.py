@@ -379,6 +379,7 @@ class RouterApp:
                 "url": ep.url,
                 "models": ep.model_names,
                 "model_label": ep.model_label,
+                "role": ep.role,
                 "sleep": ep.sleep,
                 "engine_stats": dataclasses.asdict(es) if es else None,
                 "request_stats": dataclasses.asdict(rs) if rs else None,
@@ -397,13 +398,18 @@ class RouterApp:
         board = get_engine_health_board()
         health = board.snapshot()
         engine_stats = get_engine_stats_scraper().get_engine_stats()
-        known = {ep.url for ep in
-                 get_service_discovery().get_endpoint_info()}
+        known = {
+            ep.url: ep
+            for ep in get_service_discovery().get_endpoint_info()
+        }
         out = []
-        for url in sorted(set(health) | known):
+        for url in sorted(set(health) | set(known)):
             es = engine_stats.get(url)
             row = health.get(url) or {"url": url}
             row["discovered"] = url in known
+            # PD role (prefill/decode/both) so operators can see which
+            # side of the disaggregated split a backend serves
+            row["role"] = known[url].role if url in known else None
             row["healthy"] = board.is_healthy(url)
             row["engine_stats"] = (
                 dataclasses.asdict(es) if es else None

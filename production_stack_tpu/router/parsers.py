@@ -82,10 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
     rout.add_argument("--routing-logic", type=str,
                       choices=["roundrobin", "session", "kvaware",
                                "prefixaware", "disaggregated_prefill",
-                               "ttft", "latency"],
+                               "ttft", "latency", "pd"],
                       help="required: routing algorithm (latency = "
                            "health-aware least-EWMA-latency from the "
-                           "/debug/engines scoreboard)")
+                           "/debug/engines scoreboard; pd = PD-role, "
+                           "prefix-affine disaggregated prefill/decode "
+                           "— cold prompts split across prefill-/"
+                           "decode-role engines, multi-turn resumes go "
+                           "to the engine holding the session chain)")
     rout.add_argument("--session-key", type=str, default=None,
                       help="header/body key for session affinity")
     rout.add_argument("--tokenizer", type=str, default=None,

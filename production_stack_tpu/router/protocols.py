@@ -51,6 +51,10 @@ class EndpointInfo:
     model_names: list[str] = field(default_factory=list)
     model_info: dict[str, ModelInfo] = field(default_factory=dict)
     model_label: str | None = None  # helm modelSpec label (PD roles use it)
+    # engine-advertised PD role ("prefill" / "decode" / "both") from the
+    # /v1/models card metadata (--kv-role); discovery labels are the
+    # deployment-side fallback (see `role`)
+    pd_role: str | None = None
     # the engine's --kv-instance-id, advertised via /v1/models metadata;
     # kvaware/ttft routing match KV controller results on it (falling
     # back to the id == host:port convention when absent)
@@ -64,6 +68,21 @@ class EndpointInfo:
 
     def serves_model(self, model: str) -> bool:
         return model in self.model_names or model in self.aliases
+
+    @property
+    def role(self) -> str:
+        """Resolved PD role: the engine-advertised card role wins, then
+        the deployment label convention (model_label prefixed
+        prefill/decode — helm modelSpec / k8s `model` label), else
+        "both" (an unlabeled engine can serve either phase)."""
+        if self.pd_role in ("prefill", "decode", "both"):
+            return self.pd_role
+        lbl = self.model_label or ""
+        if lbl.startswith("prefill"):
+            return "prefill"
+        if lbl.startswith("decode"):
+            return "decode"
+        return "both"
 
 
 @dataclass

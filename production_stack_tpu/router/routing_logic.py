@@ -35,6 +35,11 @@ class RoutingLogic(str, enum.Enum):
     TTFT = "ttft"
     # health-aware least-EWMA-latency (consumes the PR 6 scoreboard)
     LEAST_LATENCY = "latency"
+    # PD-role, prefix-affine data plane: cold prompts split across
+    # prefill-/decode-role engines (health-scoreboard load-aware),
+    # multi-turn resumes go to the engine already holding the session
+    # chain (PPD) — see PDRouter
+    PD = "pd"
 
 
 class RoutingInterface(abc.ABC):
@@ -338,19 +343,55 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         return decode
 
 
+def _health_scored_pick(endpoints: list[EndpointInfo]) -> str:
+    """Health-gated, load-aware pick off the PR 6 scoreboard: backends
+    with a running consecutive-failure streak (`is_healthy()` False —
+    dead pod, wedged engine) are skipped outright, and among the
+    healthy rest the lowest EWMA e2e latency wins, scaled by in-flight
+    count so a fast-but-loaded backend does not absorb the whole fleet.
+    A backend with no completed request yet (fresh pod among measured
+    peers) is costed at the FASTEST measured peer's EWMA — it attracts
+    traffic until measured, but its in-flight multiplier still engages
+    so concurrent picks cannot thundering-herd it; an entirely
+    unmeasured fleet ties at 0 and spreads randomly (same cold-start
+    behavior as _qps_routing). Shared by the `latency` policy and the
+    `pd` policy's per-role pool picks (FlowKV-style load-aware
+    scheduling)."""
+    from production_stack_tpu.router.stats.health import (
+        get_engine_health_board,
+    )
+
+    board = get_engine_health_board()
+    cands = RoutingInterface._healthy_endpoints(endpoints)
+    rows = {ep.url: board.get(ep.url) for ep in cands}
+    measured = [
+        r.ewma_latency_s for r in rows.values()
+        if r is not None and r.ewma_latency_s >= 0
+    ]
+    # unmeasured backends assume peer speed (TtftRouter's fleet-EWMA
+    # philosophy): the in-flight multiplier then still bites
+    floor = min(measured) if measured else 0.0
+
+    def score(ep: EndpointInfo) -> tuple[float, int]:
+        eng = rows.get(ep.url)
+        if eng is None:
+            return (floor, 0)
+        lat = (
+            eng.ewma_latency_s if eng.ewma_latency_s >= 0 else floor
+        )
+        # expected wait ~ latency x (queue depth + me): prefers an
+        # idle slightly-slower backend over a piled-up fast one
+        return (lat * (1 + eng.in_flight), eng.in_flight)
+
+    best = min(score(ep) for ep in cands)
+    tied = [ep.url for ep in cands if score(ep) == best]
+    return random.choice(tied)
+
+
 class LeastLatencyRouter(RoutingInterface):
     """Health-aware least-latency routing (ROADMAP PR 6 follow-on (a)):
     the first policy that actually CONSUMES the EngineHealthBoard the
-    proxy hot path feeds. Backends with a running consecutive-failure
-    streak (`is_healthy()` False — dead pod, wedged engine) are skipped
-    outright, and among the healthy rest the lowest EWMA e2e latency
-    wins, scaled by in-flight count so a fast-but-loaded backend does
-    not absorb the whole fleet. A backend with no completed request yet
-    (fresh pod among measured peers) is costed at the FASTEST measured
-    peer's EWMA — it attracts traffic until measured, but its in-flight
-    multiplier still engages so concurrent picks cannot thundering-herd
-    it; an entirely unmeasured fleet ties at 0 and spreads randomly
-    (same cold-start behavior as _qps_routing)."""
+    proxy hot path feeds — see _health_scored_pick for the scoring."""
 
     def __init__(self, **kwargs):
         pass
@@ -359,35 +400,83 @@ class LeastLatencyRouter(RoutingInterface):
                             request) -> str:
         if not endpoints:
             raise RuntimeError("no available endpoints")
-        from production_stack_tpu.router.stats.health import (
-            get_engine_health_board,
+        return _health_scored_pick(endpoints)
+
+
+class PDRouter(RoutingInterface):
+    """PD-role, prefix-affine data plane ("pd" policy).
+
+    Three routing regimes, per request:
+
+    - **Multi-turn resume (PPD):** the request's text shares a trie
+      prefix with an earlier request — the engine that served (and
+      therefore holds the session's KV chain in its prefix cache /
+      tiers) gets the WHOLE request, single-phase. Its resume prefill
+      is a prefix-cache hit, so splitting it across a prefill engine
+      would pay a transfer for KV the decode engine already has.
+    - **Cold prompt, split fleet:** prefill goes to a prefill-role
+      engine, the decode phase to a decode-role engine — each pool
+      picked load-aware off the health scoreboard (FlowKV). The decode
+      engine pulls the chain from its PD peer via the zero-stall
+      PeerTier restore (kv/peer.py).
+    - **Cold prompt, degenerate fleet:** when both picks land on the
+      same engine (everything "both"-role, or a one-engine pool), the
+      handoff is a no-op — serve single-phase.
+
+    The trie maps session text to the engine that ends the turn holding
+    the FULL chain (prompt + generated tokens): the decode engine on a
+    split, the serving engine otherwise. Roles come from
+    EndpointInfo.role (engine-advertised --kv-role, falling back to
+    prefill*/decode* model labels)."""
+
+    def __init__(self, prefix_chunk_size: int = 128, **kwargs):
+        self.trie = HashTrie(chunk_size=prefix_chunk_size)
+
+    @staticmethod
+    def _pool(
+        endpoints: list[EndpointInfo], role: str
+    ) -> list[EndpointInfo]:
+        """Endpoints that can run `role` ("both" engines qualify for
+        either); degrades to the full list when nothing is labeled for
+        the role — routing somewhere beats routing nowhere."""
+        pool = [e for e in endpoints if e.role in (role, "both")]
+        return pool or list(endpoints)
+
+    async def plan(
+        self, endpoints: list[EndpointInfo], request: RouterRequest
+    ) -> tuple[str | None, str]:
+        """-> (prefill_url | None, serve_url). None prefill means
+        single-phase: serve_url takes the whole request."""
+        if not endpoints:
+            raise RuntimeError("no available endpoints")
+        text = request.request_text()
+        available = {e.url for e in endpoints}
+        matched, cands = await self.trie.longest_prefix_match(
+            text, available
         )
+        if matched > 0 and cands:
+            # PPD resume: prefix-affine, single-phase (load-aware only
+            # among the engines that actually hold the chain)
+            aff = [e for e in endpoints if e.url in cands]
+            url = _health_scored_pick(aff)
+            await self.trie.insert(text, url)
+            return None, url
+        prefill = _health_scored_pick(self._pool(endpoints, "prefill"))
+        decode = _health_scored_pick(self._pool(endpoints, "decode"))
+        await self.trie.insert(text, decode)
+        if prefill == decode:
+            return None, decode
+        return prefill, decode
 
-        board = get_engine_health_board()
-        cands = self._healthy_endpoints(endpoints)
-        rows = {ep.url: board.get(ep.url) for ep in cands}
-        measured = [
-            r.ewma_latency_s for r in rows.values()
-            if r is not None and r.ewma_latency_s >= 0
-        ]
-        # unmeasured backends assume peer speed (TtftRouter's fleet-EWMA
-        # philosophy): the in-flight multiplier then still bites
-        floor = min(measured) if measured else 0.0
+    async def route_request(self, endpoints, engine_stats, request_stats,
+                            request) -> str:
+        # non-PD-aware callers (execute_internal, tests) get the engine
+        # that would serve the decode phase
+        _, serve = await self.plan(endpoints, request)
+        return serve
 
-        def score(ep: EndpointInfo) -> tuple[float, int]:
-            eng = rows.get(ep.url)
-            if eng is None:
-                return (floor, 0)
-            lat = (
-                eng.ewma_latency_s if eng.ewma_latency_s >= 0 else floor
-            )
-            # expected wait ~ latency x (queue depth + me): prefers an
-            # idle slightly-slower backend over a piled-up fast one
-            return (lat * (1 + eng.in_flight), eng.in_flight)
-
-        best = min(score(ep) for ep in cands)
-        tied = [ep.url for ep in cands if score(ep) == best]
-        return random.choice(tied)
+    def on_endpoint_removed(self, url: str) -> None:
+        self.trie.remove_endpoint(url)
 
 
 class TtftRouter(RoutingInterface):
@@ -573,6 +662,7 @@ _ROUTERS = {
     RoutingLogic.DISAGGREGATED_PREFILL: DisaggregatedPrefillRouter,
     RoutingLogic.TTFT: TtftRouter,
     RoutingLogic.LEAST_LATENCY: LeastLatencyRouter,
+    RoutingLogic.PD: PDRouter,
 }
 
 

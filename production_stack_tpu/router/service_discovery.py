@@ -48,29 +48,33 @@ class ServiceDiscovery(abc.ABC):
     def get_unhealthy_endpoint_hashes(self) -> list[str]:
         return []
 
-    # PD helpers: prefiller/decoder endpoints by model label convention
+    # PD helpers: role resolution order is engine-advertised card role
+    # (--kv-role) first, then the model-label convention, then "both"
+    # (EndpointInfo.role). A "both" engine serves either phase.
     def get_prefill_endpoints(self) -> list[EndpointInfo]:
         return [
             e
             for e in self.get_endpoint_info()
-            if (e.model_label or "").startswith("prefill")
+            if e.role in ("prefill", "both")
         ]
 
     def get_decode_endpoints(self) -> list[EndpointInfo]:
         return [
             e
             for e in self.get_endpoint_info()
-            if (e.model_label or "").startswith("decode")
+            if e.role in ("decode", "both")
         ]
 
 
 async def _probe_endpoint(
     url: str, timeout_s: float = 5.0
-) -> tuple[list[str], dict[str, ModelInfo], str | None] | None:
+) -> tuple[list[str], dict[str, ModelInfo], str | None, str | None] | None:
     """GET <url>/v1/models; returns (model_names, model_info,
-    kv_instance_id) or None. The kv instance id is the engine-advertised
-    card metadata that lets kvaware routing map controller matches to
-    this endpoint without the id == host:port convention."""
+    kv_instance_id, kv_role) or None. The kv instance id is the
+    engine-advertised card metadata that lets kvaware routing map
+    controller matches to this endpoint without the id == host:port
+    convention; kv_role (prefill/decode/both) labels the endpoint for
+    the `pd` routing policy without k8s label plumbing."""
     try:
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
@@ -82,14 +86,18 @@ async def _probe_endpoint(
     except Exception as e:  # noqa: BLE001 — a down endpoint is expected
         logger.debug("model probe failed for %s: %s", url, e)
         return None
-    names, info, kv_iid = [], {}, None
+    names, info, kv_iid, kv_role = [], {}, None, None
     for card in data.get("data", []):
         mi = ModelInfo.from_dict(card)
         names.append(mi.id)
         info[mi.id] = mi
         if kv_iid is None:
             kv_iid = card.get("kv_instance_id")
-    return names, info, kv_iid
+        if kv_role is None and card.get("kv_role") in (
+            "prefill", "decode", "both"
+        ):
+            kv_role = card["kv_role"]
+    return names, info, kv_iid, kv_role
 
 
 async def _probe_sleep(url: str, timeout_s: float = 3.0) -> bool:
@@ -156,16 +164,27 @@ class StaticServiceDiscovery(ServiceDiscovery):
             )
 
     async def start(self) -> None:
-        # discover models for endpoints with no static names
-        # endpoints with preset names skip the probe (hermetic static
-        # configs must start without live backends); their kv instance id
-        # stays None and kvaware matching uses the host:port convention
-        for ep in self._endpoints:
+        # discover models for endpoints with no static names.
+        # Endpoints WITH preset names keep them (hermetic static
+        # configs must start without live backends — a failed probe
+        # changes nothing), but still get a best-effort metadata probe
+        # for the card fields flags cannot carry: the kv instance id
+        # (kvaware matching without the id == host:port convention)
+        # and the PD role (`pd` policy on static discovery). Probes
+        # run concurrently so a dead backend costs one timeout, not
+        # one per endpoint.
+        async def _probe_into(ep: EndpointInfo) -> None:
+            probed = await _probe_endpoint(ep.url)
+            if probed is None:
+                return
             if not ep.model_names:
-                probed = await _probe_endpoint(ep.url)
-                if probed:
-                    ep.model_names, ep.model_info = probed[0], probed[1]
-                    ep.kv_instance_id = probed[2]
+                ep.model_names, ep.model_info = probed[0], probed[1]
+            ep.kv_instance_id = probed[2]
+            ep.pd_role = probed[3]
+
+        await asyncio.gather(
+            *(_probe_into(ep) for ep in self._endpoints)
+        )
         if self.health_checks:
             self._task = spawn_watched(
                 self._health_loop(), "static-discovery-health"
@@ -298,7 +317,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         probed = await _probe_endpoint(url)
         if probed is None:
             return
-        names, info, kv_iid = probed
+        names, info, kv_iid, kv_role = probed
         sleeping = await _probe_sleep(url)
         async with self._lock:
             self._endpoints[pod_name] = EndpointInfo(
@@ -306,6 +325,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 model_names=names,
                 model_info=info,
                 model_label=model_label,
+                pd_role=kv_role,
                 kv_instance_id=kv_iid,
                 sleep=sleeping,
                 pod_name=pod_name,
@@ -336,6 +356,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                         e = self._endpoints[pod_name]
                         e.model_names, e.model_info = probed[0], probed[1]
                         e.kv_instance_id = probed[2]
+                        e.pd_role = probed[3]
                         e.sleep = sleeping
 
     def get_endpoint_info(self) -> list[EndpointInfo]:
@@ -402,13 +423,13 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
             probed = await _probe_endpoint(url)
             if probed is None:
                 continue
-            names, info, kv_iid = probed
+            names, info, kv_iid, kv_role = probed
             label = (
                 svc.get("metadata", {}).get("labels", {}).get("model")
             )
             self._endpoints[name] = EndpointInfo(
                 url=url, model_names=names, model_info=info,
-                model_label=label, pod_name=name,
+                model_label=label, pd_role=kv_role, pod_name=name,
                 namespace=self.namespace, kv_instance_id=kv_iid,
             )
 

@@ -36,6 +36,7 @@ from aiohttp import web
 from production_stack_tpu.router.protocols import EndpointInfo, RouterRequest
 from production_stack_tpu.router.routing_logic import (
     DisaggregatedPrefillRouter,
+    PDRouter,
     get_routing_logic,
 )
 from production_stack_tpu.router.service_discovery import (
@@ -209,9 +210,11 @@ class RequestService:
             "x-request-id", uuid.uuid4().hex
         )
 
-        # PD branch (reference: request.py:159-163)
+        # PD branch (reference: request.py:159-163). PDRouter requests
+        # may still serve single-phase (prefix-affine resume / degenerate
+        # fleet) — route_disaggregated_prefill_request decides.
         router = get_routing_logic()
-        if isinstance(router, DisaggregatedPrefillRouter):
+        if isinstance(router, (DisaggregatedPrefillRouter, PDRouter)):
             return await self.route_disaggregated_prefill_request(
                 request, endpoint_path, body, request_id
             )
@@ -671,13 +674,28 @@ class RequestService:
         request_id: str,
     ) -> web.StreamResponse:
         router = get_routing_logic()
-        assert isinstance(router, DisaggregatedPrefillRouter)
+        assert isinstance(router, (DisaggregatedPrefillRouter, PDRouter))
         endpoints = get_service_discovery().get_endpoint_info()
         endpoints = [e for e in endpoints if not e.sleep]
         try:
-            prefill_url, decode_url = await router.route_prefill_decode(
-                endpoints
-            )
+            if isinstance(router, PDRouter):
+                rr = RouterRequest(
+                    headers=dict(request.headers), body=body,
+                    endpoint=endpoint_path,
+                )
+                prefill_url, decode_url = await router.plan(endpoints, rr)
+                if prefill_url is None:
+                    # prefix-affine resume (PPD) or degenerate fleet:
+                    # the serving engine already holds / will hold the
+                    # whole chain — no handoff, one phase
+                    return await self.process_request(
+                        request, body, decode_url, endpoint_path,
+                        request_id,
+                    )
+            else:
+                prefill_url, decode_url = (
+                    await router.route_prefill_decode(endpoints)
+                )
         except RuntimeError as e:
             return web.json_response(
                 {"error": {"message": str(e),
@@ -704,6 +722,15 @@ class RequestService:
             prefill_url, f"{request_id}-prefill",
             num_prompt_tokens=_estimate_prompt_tokens(body),
         )
+        # the phase-1 POST must feed the health scoreboard like every
+        # other upstream attempt: PDRouter's prefill-pool pick is
+        # health-gated + in-flight-weighted, and a dead prefill engine
+        # can only trip is_healthy() (and fail over on the next cold
+        # prompt) if its failures are OBSERVED here. record_sample=False
+        # keeps these whole-body reads out of the streaming sample ring
+        # (they carry no tiled phase decomposition).
+        board = get_engine_health_board()
+        board.on_request_start(prefill_url)
         try:
             async with self.session.post(
                 f"{prefill_url}{endpoint_path}",
@@ -714,6 +741,12 @@ class RequestService:
                     monitor.on_request_complete(
                         prefill_url, f"{request_id}-prefill"
                     )
+                    board.observe(
+                        prefill_url, {}, time.monotonic() - t0,
+                        ok=pr.status < 500,
+                        error_kind=f"http_{pr.status}",
+                        record_sample=False,
+                    )
                     return web.json_response(
                         {"error": {"message":
                                    f"prefiller error: {detail[:500]}",
@@ -721,9 +754,14 @@ class RequestService:
                         status=502,
                     )
                 await pr.read()
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, ConnectionResetError,
+                asyncio.TimeoutError) as e:
             monitor.on_request_complete(
                 prefill_url, f"{request_id}-prefill"
+            )
+            board.observe(
+                prefill_url, {}, time.monotonic() - t0,
+                ok=False, error_kind="connect", record_sample=False,
             )
             return web.json_response(
                 {"error": {"message": f"prefiller unreachable: {e}",
@@ -735,6 +773,10 @@ class RequestService:
         )
         monitor.on_request_complete(
             prefill_url, f"{request_id}-prefill"
+        )
+        board.observe(
+            prefill_url, {}, time.monotonic() - t0, ok=True,
+            record_sample=False,
         )
         logger.info(
             "PD request %s: prefill on %s took %.3fs; decoding on %s",

@@ -100,6 +100,13 @@ class RunConfig:
     # proxy retries on the remaining candidates), so the A/B shows up in
     # per-engine requests_total/retries, not the error gate.
     dead_engines: int = 0
+    # two-role PD scenario (--pd): half the stub engines are labeled
+    # prefill, half decode, and the run drives the `pd` routing policy —
+    # each session's cold turn splits two-phase (1-token prefill on a
+    # prefill-role engine, the stream on a decode-role engine) and
+    # later turns route prefix-affine single-phase to the decode engine
+    # holding the session. Attribution + gates land under result["pd"].
+    pd: bool = False
     algorithms: tuple[str, ...] = DEFAULT_ALGORITHMS
     out: str = "ROUTER_BENCH.json"
 
@@ -139,7 +146,13 @@ async def _worker(
     """One streaming session: issues requests until the shared budget
     is spent. Session-affine headers + a per-session prompt prefix give
     the session/prefixaware algorithms something real to chew on."""
-    prefix = f"session-{wid} shared history preamble. "
+    if cfg.pd:
+        # the PD policy's prefix affinity is trie-chunk (128 chars)
+        # granular: pad the session preamble past one whole chunk so
+        # turn 2+ routes single-phase to the session's decode engine
+        prefix = f"session-{wid} " + "history " * 20
+    else:
+        prefix = f"session-{wid} shared history preamble. "
     while True:
         i = counter["next"]
         if i >= cfg.requests:
@@ -151,20 +164,37 @@ async def _worker(
             "max_tokens": cfg.tokens,
             "stream": True,
         }
-        t0 = time.monotonic()
         ttft = None
-        try:
-            async with client.post(
-                f"{base}/v1/completions", json=body,
-                headers={"x-user-id": f"user-{wid}"},
-            ) as r:
-                async for _chunk in r.content.iter_any():
-                    if ttft is None:
-                        ttft = time.monotonic() - t0
-                if r.status != 200:
-                    out["client_errors"] += 1
-                    continue
-        except (aiohttp.ClientError, asyncio.TimeoutError):
+        status = None
+        # a 512-session burst against one listener can overflow the
+        # kernel accept queue on a fast box — a CONNECT-stage reset is
+        # the client's socket churn, not a router failure, so retry it
+        # a couple of times before charging an error (anything after
+        # bytes flowed still counts: the router owned the stream).
+        # t0 resets per attempt for the same reason: the failed
+        # connect + backoff are the client's time, and folding them
+        # into ttft/e2e would skew the very tails the gates measure.
+        for attempt in range(3):
+            t0 = time.monotonic()
+            try:
+                async with client.post(
+                    f"{base}/v1/completions", json=body,
+                    headers={"x-user-id": f"user-{wid}"},
+                ) as r:
+                    status = r.status
+                    async for _chunk in r.content.iter_any():
+                        if ttft is None:
+                            ttft = time.monotonic() - t0
+                break
+            except aiohttp.ClientConnectionError:
+                if ttft is not None or attempt == 2:
+                    status = None
+                    break
+                await asyncio.sleep(0.005 * (attempt + 1))
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                status = None
+                break
+        if status != 200:
             out["client_errors"] += 1
             continue
         out["e2e"].append(time.monotonic() - t0)
@@ -182,14 +212,26 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
     _reset_service_discovery()
     _reset_engine_health_board()
 
+    labels: list[str | None] = [None] * cfg.engines
+    if cfg.pd:
+        if cfg.dead_engines:
+            raise ValueError(
+                "--pd and --dead-engines are separate scenarios"
+            )
+        n_prefill = max(1, cfg.engines // 2)
+        labels = (
+            ["prefill"] * n_prefill
+            + ["decode"] * (cfg.engines - n_prefill)
+        )
     engines = [
         FakeEngine(
             model="fake-model",
             tokens_per_sec=cfg.tokens_per_sec,
             ttft_s=cfg.engine_ttft_s,
             num_tokens=cfg.tokens,
+            model_label=labels[i],
         )
-        for _ in range(cfg.engines)
+        for i in range(cfg.engines)
     ]
     for e in engines:
         await e.start()
@@ -222,6 +264,11 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
     ]
     if algo == "session":
         argv += ["--session-key", "x-user-id"]
+    if cfg.pd:
+        # role labels ride static discovery (the stub engines don't run
+        # the real /v1/models card-role handshake)
+        argv += ["--static-model-labels",
+                 ",".join(lbl or "" for lbl in labels)]
     args = parsers.parse_args(argv)
     router_app = build_app(args)
 
@@ -308,6 +355,31 @@ async def run_algorithm(algo: str, cfg: RunConfig) -> dict:
         "metrics_exported": metrics_ok,
         "per_engine": scoreboard,
     }
+    if cfg.pd:
+        # PD attribution from the stub engines' own request logs: the
+        # two-phase split must put EXACTLY the 1-token non-streaming
+        # prefill phases on prefill-role engines and every stream on a
+        # decode-role engine; later session turns skip phase 1
+        # entirely (prefix-affine single-phase resumes)
+        pf_engines = [e for e in engines if e.model_label == "prefill"]
+        dc_engines = [e for e in engines if e.model_label == "decode"]
+        phase1 = [b for e in pf_engines for b in e.requests_seen]
+        dc_reqs = [b for e in dc_engines for b in e.requests_seen]
+        result["pd"] = {
+            "prefill_backends": [e.url for e in pf_engines],
+            "decode_backends": [e.url for e in dc_engines],
+            "prefill_requests": len(phase1),
+            "decode_requests": len(dc_reqs),
+            "phase1_single_token": all(
+                b.get("max_tokens") == 1 and not b.get("stream")
+                for b in phase1
+            ),
+            "misrouted_streams": sum(
+                1 for b in phase1 if b.get("stream")
+            ),
+            # requests that skipped the split (prefix-affine resumes)
+            "resume_single_phase": max(0, len(dc_reqs) - len(phase1)),
+        }
     if dead_urls:
         # dead-backend attribution: how much traffic each view of the
         # scenario burned on the dead urls (health-aware algorithms
@@ -346,6 +418,27 @@ def gates_pass(algo_result: dict) -> list[str]:
         bad.append(f"error rate {err_rate:.4f} > {ERROR_RATE_GATE}")
     if not algo_result["metrics_exported"]:
         bad.append("tpu_router:* metrics missing from /metrics")
+    pd = algo_result.get("pd")
+    if pd:
+        if pd["prefill_requests"] < 1:
+            bad.append("pd: no prefill phases reached a prefill engine")
+        if not pd["phase1_single_token"]:
+            bad.append("pd: prefill-role engines saw non-phase-1 bodies")
+        if pd["misrouted_streams"]:
+            bad.append(
+                f"pd: {pd['misrouted_streams']} streams hit a "
+                "prefill-role engine"
+            )
+        if pd["decode_requests"] < algo_result["requests"]:
+            bad.append(
+                "pd: decode-role engines served fewer streams than "
+                "completed requests"
+            )
+        if pd["resume_single_phase"] < 1:
+            bad.append(
+                "pd: no prefix-affine single-phase resume observed "
+                "(PPD affinity broken)"
+            )
     return bad
 
 
@@ -395,6 +488,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="additional listed-but-not-listening backends "
                          "(dead-pod scenario: health-aware algorithms "
                          "should stop routing to them)")
+    ap.add_argument("--pd", action="store_true",
+                    help="two-role PD scenario: half the stub engines "
+                         "labeled prefill, half decode, driven through "
+                         "the `pd` policy (cold turns split two-phase, "
+                         "session resumes route prefix-affine)")
     ap.add_argument("--tokens", type=int, default=None)
     ap.add_argument("--tokens-per-sec", type=float, default=None)
     ap.add_argument("--engine-ttft-s", type=float, default=None)
@@ -414,6 +512,12 @@ def main(argv: list[str] | None = None) -> int:
         cfg.algorithms = tuple(
             a.strip() for a in ns.algorithms.split(",") if a.strip()
         )
+    if ns.pd:
+        cfg.pd = True
+        if not ns.algorithms:
+            cfg.algorithms = ("pd",)
+        if ns.out is None:
+            cfg.out = "ROUTER_BENCH_pd.json"
 
     quiet_logs()
     results = asyncio.run(run_suite(cfg))

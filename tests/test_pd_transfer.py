@@ -1,19 +1,28 @@
-"""Disaggregated-prefill KV transfer tests (reference capability:
-prefiller computes KV, decoder pulls it before decoding — reference
-request flow request.py:349-441, NIXL transfer configured at
-deployment-vllm-multi.yaml:273-305; ours is content-addressed pull over
-TCP, production_stack_tpu/kv/transfer.py)."""
+"""Disaggregated prefill/decode KV transfer tests.
+
+Reference capability: the prefill pod computes KV, the decode pod pulls
+it before decoding (reference request flow request.py:349-441, NIXL
+transfer configured at deployment-vllm-multi.yaml:273-305). Ours is a
+content-addressed chain pull over TCP (kv/transfer.py producer,
+kv/peer.py PeerTier consumer) that rides the zero-stall staged-restore
+path: the pull starts at add_request through the offload manager's
+pending-READ map, lands via stage_import_blocks/import_staged_blocks,
+and every failure mode (dead peer, mid-chain eviction, corrupt frame)
+falls back to local recompute with bit-identical outputs.
+"""
 
 import asyncio
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.llm_engine import LLMEngine
 from production_stack_tpu.engine.sampling_params import SamplingParams
-from production_stack_tpu.kv.transfer import KVTransferClient, KVTransferServer
+from production_stack_tpu.kv.peer import PeerTier
+from production_stack_tpu.kv.transfer import KVTransferServer
 
 
 def make_cfg(**kw):
@@ -46,12 +55,14 @@ class _ServerHarness:
         self.thread.start()
         assert self.holder["ready"].wait(5)
         self.port = self.holder["port"]
+        self.server = self.holder["server"]
 
     def _serve(self):
         async def run():
             srv = KVTransferServer(self.fake)
             await srv.start("127.0.0.1", 0)
-            self.holder["port"] = srv._server.sockets[0].getsockname()[1]
+            self.holder["port"] = srv.port
+            self.holder["server"] = srv
             self.holder["loop"] = asyncio.get_running_loop()
             self.holder["stop"] = asyncio.Event()
             self.holder["ready"].set()
@@ -68,9 +79,11 @@ class _ServerHarness:
 PROMPT = "here is a long shared prompt that fills multiple kv blocks!!"
 
 
-def test_decode_pulls_kv_from_prefiller():
-    # identical seed -> identical weights on both engines, so transferred
-    # KV must reproduce exactly what decode would have computed itself
+def test_decode_pulls_kv_from_prefiller_staged():
+    """The zero-stall consumer path: the decode engine's PeerTier pull
+    rides the staged restore (request_chain_reads -> pending-READ map
+    -> stage/import), admission defers until the chain lands, and the
+    decoded tokens are bit-identical to a monolithic engine."""
     prefill = LLMEngine(make_cfg(kv_role="prefill"))
     baseline = LLMEngine(make_cfg())
     sp1 = SamplingParams(max_tokens=1, temperature=0.0)
@@ -85,14 +98,20 @@ def test_decode_pulls_kv_from_prefiller():
             kv_transfer_config={"peer": f"127.0.0.1:{harness.port}"},
         ))
         try:
+            # peer-configured engines take the async staged-restore
+            # path (no local tiers needed, no sync pull anywhere)
+            assert decode._kv_async
+            assert decode.offload is not None
+            assert decode.offload.peer is decode.kv_peer
             out_pd = decode.generate([PROMPT], spN)[0]
             # the decoder must have pulled blocks, not recomputed
-            assert decode.kv_transfer_client.pulls == 1
             n_full = len(
                 decode.tokenizer.encode(PROMPT)
             ) // decode.config.block_size
-            assert decode.kv_transfer_client.blocks_pulled == n_full
+            assert decode.kv_peer.hits == n_full
+            assert decode.kv_peer.fallbacks == 0
             assert decode.block_manager.prefix_hits >= n_full * 4
+            assert decode._kv_restore_blocks_total == n_full
             # and produce exactly the tokens a monolithic engine produces
             out_ref = baseline.generate([PROMPT], spN)[0]
             assert out_pd.token_ids == out_ref.token_ids
@@ -102,6 +121,41 @@ def test_decode_pulls_kv_from_prefiller():
         harness.close()
         prefill.shutdown()
         baseline.shutdown()
+
+
+def test_peer_restore_attributed_in_timeline():
+    """The kv_restore timeline event carries tier='peer' attribution
+    for the pulled blocks (observability satellite)."""
+    prefill = LLMEngine(make_cfg(kv_role="prefill"))
+    prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
+    harness = _ServerHarness(prefill)
+    try:
+        decode = LLMEngine(make_cfg(
+            kv_role="decode",
+            kv_transfer_config={"peer": f"127.0.0.1:{harness.port}"},
+            request_timeline=True,
+        ))
+        try:
+            decode.generate(
+                [PROMPT], SamplingParams(max_tokens=2, temperature=0.0)
+            )
+            n_full = len(
+                decode.tokenizer.encode(PROMPT)
+            ) // decode.config.block_size
+            events = [
+                ev["attributes"]
+                for tl in decode.timeline.snapshot()
+                for ev in tl["events"]
+                if ev["name"] == "kv_restore"
+            ]
+            assert events, "kv_restore event missing from timeline"
+            assert events[0]["tiers"] == {"peer": n_full}
+            assert events[0]["blocks"] == n_full
+        finally:
+            decode.shutdown()
+    finally:
+        harness.close()
+        prefill.shutdown()
 
 
 def test_decode_degrades_gracefully_without_peer():
@@ -118,9 +172,47 @@ def test_decode_degrades_gracefully_without_peer():
         assert time.time() - t0 < 30  # connect fails fast, no stall
         ref = baseline.generate([PROMPT], sp)[0]
         assert out.token_ids == ref.token_ids
-        assert decode.kv_transfer_client.pulls == 0
+        assert decode.kv_peer.hits == 0
+        assert decode.kv_peer.fallbacks >= 1
     finally:
         decode.shutdown()
+        baseline.shutdown()
+
+
+def test_midchain_peer_eviction_falls_back():
+    """Acceptance case: the prefill peer evicted a MID-CHAIN block
+    between prefill and pull — the decoder adopts the served prefix,
+    recomputes from the break, and stays bit-identical."""
+    prefill = LLMEngine(make_cfg(kv_role="prefill"))
+    baseline = LLMEngine(make_cfg())
+    prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
+    toks = prefill.tokenizer.encode(PROMPT)
+    hashes = prefill.block_manager.block_hashes_for(toks)
+    assert len(hashes) >= 3
+    # evict the middle block from the prefiller's cache: the chain the
+    # transfer server can serve now ends right before it
+    cut = len(hashes) // 2
+    prefill.block_manager.drop_cached_block(hashes[cut])
+    harness = _ServerHarness(prefill)
+    try:
+        decode = LLMEngine(make_cfg(
+            kv_role="decode",
+            kv_transfer_config={"peer": f"127.0.0.1:{harness.port}"},
+        ))
+        try:
+            sp = SamplingParams(max_tokens=6, temperature=0.0)
+            out = decode.generate([PROMPT], sp)[0]
+            ref = baseline.generate([PROMPT], sp)[0]
+            assert out.token_ids == ref.token_ids
+            # only the pre-break prefix transferred; the tail recomputed
+            assert decode.kv_peer.hits == cut
+            assert decode.kv_peer.misses >= 1
+            assert decode._kv_restore_blocks_total == cut
+        finally:
+            decode.shutdown()
+    finally:
+        harness.close()
+        prefill.shutdown()
         baseline.shutdown()
 
 
@@ -129,17 +221,317 @@ def test_transfer_server_chain_semantics():
     prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
     harness = _ServerHarness(prefill)
     try:
-        client = KVTransferClient("127.0.0.1", harness.port)
+        peer = PeerTier(f"127.0.0.1:{harness.port}")
         toks = prefill.tokenizer.encode(PROMPT)
         hashes = prefill.block_manager.block_hashes_for(toks)
-        data = client.get_chain(hashes)
-        assert data is not None and data.shape[2] == len(hashes)
+        blocks, addr = peer.get_chain(hashes)
+        assert len(blocks) == len(hashes)
+        assert addr == f"127.0.0.1:{harness.port}"
         # unknown chain head -> nothing
-        assert client.get_chain([123456789]) is None
+        assert peer.get_chain([123456789]) == ([], None)
         # chain with an unknown tail -> truncated run
-        data = client.get_chain(hashes + [987654321])
-        assert data.shape[2] == len(hashes)
-        client.close()
+        blocks, _ = peer.get_chain(hashes + [987654321])
+        assert len(blocks) == len(hashes)
+        peer.close()
     finally:
         harness.close()
         prefill.shutdown()
+
+
+def test_transfer_server_snapshot_outside_step_lock():
+    """The producer's d2h gather must NOT hold the engine step-loop
+    lock: with the lock already held by a fake 'step thread', the pull
+    must still complete (snapshot enqueue waits for the lock briefly;
+    materialization happens after release) — and a pull issued while
+    the lock is held for a BOUNDED time must not dead-stall."""
+    prefill = LLMEngine(make_cfg(kv_role="prefill"))
+    prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
+    harness = _ServerHarness(prefill)
+    try:
+        toks = prefill.tokenizer.encode(PROMPT)
+        hashes = prefill.block_manager.block_hashes_for(toks)
+        # hold the engine lock for 0.3 s while a pull is in flight: the
+        # pull's snapshot waits for the lock, then the d2h runs OUTSIDE
+        # it — total stall must be ~the hold, never a timeout
+        release = threading.Event()
+
+        def hold():
+            with harness.fake._lock:
+                release.wait(0.3)
+
+        t = threading.Thread(target=hold)
+        t.start()
+        peer = PeerTier(f"127.0.0.1:{harness.port}", timeout=10.0)
+        blocks, _ = peer.get_chain(hashes)
+        t.join()
+        assert len(blocks) == len(hashes)
+        peer.close()
+    finally:
+        harness.close()
+        prefill.shutdown()
+
+
+def test_peer_speaks_to_cache_server():
+    """Address-interchangeability: the same PeerTier pulls chains from
+    a standalone kv.cache_server (shared-cache handoff) exactly like
+    from a prefill engine's transfer server."""
+    from production_stack_tpu.kv.cache_server import KVCacheServer
+
+    holder = {"ready": threading.Event()}
+
+    def serve():
+        async def run():
+            srv = KVCacheServer(capacity_bytes=1 << 24)
+            await srv.start("127.0.0.1", 0)
+            holder["srv"] = srv
+            holder["port"] = srv._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            holder["ready"].set()
+            await holder["stop"].wait()
+            await srv.stop()
+
+        asyncio.run(run())
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert holder["ready"].wait(5)
+    try:
+        srv = holder["srv"]
+        rng = np.random.default_rng(0)
+        blocks = {
+            h: rng.standard_normal((2, 2, 3, 4, 5)).astype(np.float32)
+            for h in (11, 22, 33)
+        }
+        for h, arr in blocks.items():
+            srv.put(h, arr)
+        peer = PeerTier(f"127.0.0.1:{holder['port']}")
+        got, addr = peer.get_chain([11, 22, 33, 44])
+        assert len(got) == 3  # truncated at the unknown tail
+        for h, arr in zip((11, 22, 33), got):
+            np.testing.assert_array_equal(arr, blocks[h])
+        peer.close()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=5)
+
+
+def test_multi_peer_failover():
+    """A dead first peer degrades to the next address in the list —
+    the chain hash is the address, so the walk costs one failed
+    connect, not a lost restore."""
+    prefill = LLMEngine(make_cfg(kv_role="prefill"))
+    prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
+    harness = _ServerHarness(prefill)
+    try:
+        peer = PeerTier(f"127.0.0.1:1,127.0.0.1:{harness.port}")
+        toks = prefill.tokenizer.encode(PROMPT)
+        hashes = prefill.block_manager.block_hashes_for(toks)
+        blocks, addr = peer.get_chain(hashes)
+        assert len(blocks) == len(hashes)
+        assert addr == f"127.0.0.1:{harness.port}"
+        assert peer.fallbacks == 1  # the dead peer
+        peer.close()
+    finally:
+        harness.close()
+        prefill.shutdown()
+
+
+def test_sync_mode_still_pulls_blocking():
+    """--sync-kv-offload keeps the pre-PR-8 synchronous pull as the
+    attribution control (and the multihost path): same tokens, same
+    peer counters, but through _pd_transfer_restore."""
+    prefill = LLMEngine(make_cfg(kv_role="prefill"))
+    baseline = LLMEngine(make_cfg())
+    prefill.generate([PROMPT], SamplingParams(max_tokens=1, temperature=0.0))
+    harness = _ServerHarness(prefill)
+    try:
+        decode = LLMEngine(make_cfg(
+            kv_role="decode",
+            kv_transfer_config={"peer": f"127.0.0.1:{harness.port}"},
+            sync_kv_offload=True,
+        ))
+        try:
+            assert not decode._kv_async
+            sp = SamplingParams(max_tokens=4, temperature=0.0)
+            out = decode.generate([PROMPT], sp)[0]
+            ref = baseline.generate([PROMPT], sp)[0]
+            assert out.token_ids == ref.token_ids
+            n_full = len(
+                decode.tokenizer.encode(PROMPT)
+            ) // decode.config.block_size
+            assert decode.kv_peer.hits == n_full
+        finally:
+            decode.shutdown()
+    finally:
+        harness.close()
+        prefill.shutdown()
+        baseline.shutdown()
+
+
+# -- CPU e2e: prefill engine + decode engine + router (pd policy) ----------
+def test_pd_router_e2e_bit_identical():
+    """The full disaggregated data plane on CPU: two real EngineServers
+    (prefill role serving KV, decode role pulling through its PeerTier)
+    behind the real router running the `pd` policy. The cold prompt
+    splits (phase 1 prefill on the prefill engine, streaming decode on
+    the decode engine), the decode-side restore pulls the chain over
+    the transfer link, and the final text is bit-identical to a
+    single-engine recompute."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.server import EngineServer
+    from production_stack_tpu.router import parsers
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.routing_logic import (
+        _reset_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        _reset_service_discovery,
+    )
+    from production_stack_tpu.router.stats.health import (
+        _reset_engine_health_board,
+    )
+
+    # long enough that a follow-up turn shares a whole 128-char trie
+    # chunk with it (the pd policy's prefix-affinity granularity)
+    prompt = (PROMPT + " and even more shared context to transfer!!") * 2
+
+    async def run():
+        _reset_routing_logic()
+        _reset_service_discovery()
+        _reset_engine_health_board()
+        # single-engine control first (its own engine, same seed)
+        control = EngineServer(make_cfg())
+        ctrl_client = TestClient(TestServer(control.app))
+        await ctrl_client.start_server()
+        body = {"prompt": prompt, "max_tokens": 6, "temperature": 0.0}
+        r = await ctrl_client.post("/v1/completions", json=body)
+        assert r.status == 200
+        want_text = (await r.json())["choices"][0]["text"]
+        await ctrl_client.close()
+
+        prefill_srv = EngineServer(make_cfg(
+            kv_role="prefill",
+            kv_transfer_config={"listen": "127.0.0.1:0"},
+        ))
+        pf_client = TestClient(TestServer(prefill_srv.app))
+        await pf_client.start_server()
+        kv_port = prefill_srv._kv_transfer_server.port
+        assert kv_port, "prefill engine must be serving KV"
+
+        decode_srv = EngineServer(make_cfg(
+            kv_role="decode",
+            kv_transfer_config={"peer": f"127.0.0.1:{kv_port}"},
+        ))
+        dc_client = TestClient(TestServer(decode_srv.app))
+        await dc_client.start_server()
+
+        pf_url = f"http://127.0.0.1:{pf_client.port}"
+        dc_url = f"http://127.0.0.1:{dc_client.port}"
+        # the engines ALSO advertise their role on the /v1/models card
+        # (k8s/probing discovery picks it up from there)
+        from production_stack_tpu.router.service_discovery import (
+            _probe_endpoint,
+        )
+
+        probed = await _probe_endpoint(pf_url)
+        assert probed is not None and probed[3] == "prefill"
+        probed = await _probe_endpoint(dc_url)
+        assert probed is not None and probed[3] == "decode"
+
+        args = parsers.parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"{pf_url},{dc_url}",
+            "--static-models", "pst-tiny-debug,pst-tiny-debug",
+            "--static-model-labels", "prefill,decode",
+            "--routing-logic", "pd",
+            "--engine-stats-interval", "30",
+            "--kv-controller-url", "",
+        ])
+        router_app = build_app(args)
+        rclient = TestClient(TestServer(router_app.app))
+        await rclient.start_server()
+        try:
+            from production_stack_tpu.router.service_discovery import (
+                get_service_discovery,
+            )
+
+            roles = {
+                e.url: e.role
+                for e in get_service_discovery().get_endpoint_info()
+            }
+            assert roles == {pf_url: "prefill", dc_url: "decode"}
+
+            r = await rclient.post("/v1/completions", json=body)
+            assert r.status == 200
+            got = await r.json()
+            assert got["choices"][0]["text"] == want_text
+
+            # the split actually happened: prefill engine ran the
+            # 1-token phase, decode engine pulled the chain
+            pf_eng = prefill_srv.engine.engine
+            dc_eng = decode_srv.engine.engine
+            assert pf_eng._finished_total == 1
+            n_full = len(dc_eng.tokenizer.encode(prompt)) \
+                // dc_eng.config.block_size
+            assert dc_eng.kv_peer is not None
+            assert dc_eng.kv_peer.hits == n_full
+            assert dc_eng.kv_peer.fallbacks == 0
+
+            # /debug/engines surfaces the roles
+            dbg = await (await rclient.get("/debug/engines")).json()
+            by_url = {row["url"]: row for row in dbg["engines"]}
+            assert by_url[pf_url]["role"] == "prefill"
+            assert by_url[dc_url]["role"] == "decode"
+
+            # a resume sharing the session prefix routes prefix-affine
+            # to the decode engine (PPD), single-phase: the prefill
+            # engine sees NO second request
+            body2 = dict(body)
+            body2["prompt"] = prompt + " tok0 follow-up question"
+            r2 = await rclient.post("/v1/completions", json=body2)
+            assert r2.status == 200
+            assert pf_eng._finished_total == 1  # still just phase 1
+            assert dc_eng._finished_total >= 2
+        finally:
+            await rclient.close()
+            await dc_client.close()
+            await pf_client.close()
+            _reset_routing_logic()
+            _reset_service_discovery()
+            _reset_engine_health_board()
+
+    asyncio.run(run())
+
+
+def test_peer_only_engine_has_no_export_hooks():
+    """A pure PD decode engine (peer, no local tiers) must not pin and
+    snapshot freed blocks into an empty cascade."""
+    decode = LLMEngine(make_cfg(
+        kv_role="decode",
+        kv_transfer_config={"peer": "127.0.0.1:1"},
+    ))
+    try:
+        assert decode.offload is not None
+        assert decode.offload.tiers == []
+        assert decode.block_manager.on_freed_cached is None
+        assert decode.scheduler.kv_flush is None
+    finally:
+        decode.shutdown()
+
+
+def test_pd_config_role_validation():
+    with pytest.raises(ValueError, match="kv_role"):
+        make_cfg(kv_role="producer")
+    assert make_cfg(kv_role="both").pd_role() == "both"
+    assert make_cfg(
+        kv_transfer_config={"listen": ":8200"}
+    ).pd_role() == "prefill"
+    assert make_cfg(
+        kv_transfer_config={"peer": "h:8200"}
+    ).pd_role() == "decode"
+    assert make_cfg(
+        kv_transfer_config={"listen": ":8200", "peer": "h:8200"}
+    ).pd_role() == "both"
+    assert make_cfg().pd_role() is None

@@ -863,3 +863,229 @@ def test_ttft_queued_cost_derived_from_measurements():
     # from the old 0.05 s constant
     per_queued = (est_queued - est_idle) / 4
     assert abs(per_queued - 2.0) < 0.01
+
+
+# -- unit: pd (PD-role, prefix-affine disaggregated) routing ----------------
+class TestPDRouter:
+    """PDRouter: cold prompts split across prefill-/decode-role pools
+    (health-scoreboard load-aware), multi-turn resumes route
+    prefix-affine to the engine holding the session chain (PPD)."""
+
+    @staticmethod
+    def _fresh_board():
+        from production_stack_tpu.router.stats.health import (
+            _reset_engine_health_board,
+        )
+
+        _reset_engine_health_board()
+
+    @staticmethod
+    def _eps():
+        return [
+            EndpointInfo(url="http://pf0:8000", model_names=["m"],
+                         pd_role="prefill"),
+            EndpointInfo(url="http://pf1:8000", model_names=["m"],
+                         model_label="prefill2"),  # label fallback
+            EndpointInfo(url="http://dc0:8000", model_names=["m"],
+                         pd_role="decode"),
+            EndpointInfo(url="http://dc1:8000", model_names=["m"],
+                         model_label="decode2"),
+        ]
+
+    def test_role_resolution_order(self):
+        # card role wins over label; label prefix is the fallback;
+        # unlabeled engines serve both phases
+        assert EndpointInfo(url="u", pd_role="decode",
+                            model_label="prefill").role == "decode"
+        assert EndpointInfo(url="u", model_label="prefill-l40").role \
+            == "prefill"
+        assert EndpointInfo(url="u", model_label="decode-a").role \
+            == "decode"
+        assert EndpointInfo(url="u").role == "both"
+        assert EndpointInfo(url="u", pd_role="both",
+                            model_label="prefill").role == "both"
+
+    def test_cold_prompt_splits_across_role_pools(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+
+        self._fresh_board()
+        router = PDRouter()
+        pf, dc = asyncio.run(
+            router.plan(self._eps(), make_request(
+                body={"prompt": "cold " * 64}
+            ))
+        )
+        assert pf in ("http://pf0:8000", "http://pf1:8000")
+        assert dc in ("http://dc0:8000", "http://dc1:8000")
+
+    def test_resume_routes_prefix_affine_single_phase(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+
+        self._fresh_board()
+        router = PDRouter()
+        turn1 = "s" * 300  # > 2 whole trie chunks
+        pf, dc = asyncio.run(
+            router.plan(self._eps(), make_request(body={"prompt": turn1}))
+        )
+        assert pf is not None
+        # turn 2 extends the session: the decode engine (which ended
+        # turn 1 holding the full chain) serves it single-phase
+        pf2, dc2 = asyncio.run(
+            router.plan(self._eps(), make_request(
+                body={"prompt": turn1 + " follow-up"}
+            ))
+        )
+        assert pf2 is None
+        assert dc2 == dc
+
+    def test_resume_affinity_survives_other_engine_departure(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+
+        self._fresh_board()
+        router = PDRouter()
+        turn1 = "t" * 300
+        _, dc = asyncio.run(
+            router.plan(self._eps(), make_request(body={"prompt": turn1}))
+        )
+        # the chain holder left the fleet: the resume must re-plan like
+        # a cold prompt instead of routing to a gone backend
+        router.on_endpoint_removed(dc)
+        eps = [e for e in self._eps() if e.url != dc]
+        pf2, dc2 = asyncio.run(
+            router.plan(eps, make_request(
+                body={"prompt": turn1 + " next"}
+            ))
+        )
+        assert dc2 != dc
+        assert pf2 in (None, "http://pf0:8000", "http://pf1:8000")
+
+    def test_unhealthy_prefill_engine_skipped(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        self._fresh_board()
+        board = get_engine_health_board()
+        for _ in range(3):  # is_healthy streak threshold
+            board.on_request_start("http://pf0:8000")
+            board.observe("http://pf0:8000", {}, 0.0, ok=False,
+                          error_kind="connect")
+        router = PDRouter()
+        for i in range(8):
+            pf, _ = asyncio.run(
+                router.plan(self._eps(), make_request(
+                    body={"prompt": f"cold-{i} " * 40}
+                ))
+            )
+            assert pf == "http://pf1:8000"
+
+    def test_degenerate_fleet_serves_single_phase(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+
+        self._fresh_board()
+        router = PDRouter()
+        eps = [EndpointInfo(url="http://only:8000", model_names=["m"])]
+        pf, dc = asyncio.run(
+            router.plan(eps, make_request(body={"prompt": "hello"}))
+        )
+        assert pf is None
+        assert dc == "http://only:8000"
+
+    def test_route_request_returns_serving_engine(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+
+        self._fresh_board()
+        router = PDRouter()
+        url = asyncio.run(router.route_request(
+            self._eps(), {}, {}, make_request(body={"prompt": "x"})
+        ))
+        assert url in ("http://dc0:8000", "http://dc1:8000")
+
+    def test_load_aware_decode_pick_prefers_idle_engine(self):
+        from production_stack_tpu.router.routing_logic import PDRouter
+        from production_stack_tpu.router.stats.health import (
+            get_engine_health_board,
+        )
+
+        self._fresh_board()
+        board = get_engine_health_board()
+        # dc0: fast but piled up; dc1: measured equal and idle
+        for url, inflight in (("http://dc0:8000", 6),
+                              ("http://dc1:8000", 0)):
+            board.on_request_start(url)
+            board.observe(url, {}, 0.1, ok=True)
+            for _ in range(inflight):
+                board.on_request_start(url)
+        router = PDRouter()
+        for i in range(8):
+            _, dc = asyncio.run(
+                router.plan(self._eps(), make_request(
+                    body={"prompt": f"fresh-{i} " * 40}
+                ))
+            )
+            assert dc == "http://dc1:8000"
+
+
+def test_pd_phase1_failures_trip_prefill_failover(reset_singletons):
+    """The phase-1 prefill POST must FEED the health scoreboard: with a
+    dead prefill-role backend in the pool, the first few cold prompts
+    502 (bounded by the is_healthy failure streak), after which the
+    `pd` policy's health-gated prefill pick fails over to the live
+    prefill engine and every later request succeeds."""
+    import socket as _socket
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.stats.health import (
+        get_engine_health_board,
+    )
+
+    async def run():
+        # bound-but-never-listening: every connect is refused fast and
+        # the port cannot be recycled mid-test
+        dead = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        dead.bind(("127.0.0.1", 0))
+        dead_url = f"http://127.0.0.1:{dead.getsockname()[1]}"
+        pf = FakeEngine(model="fake-model")
+        dc = FakeEngine(model="fake-model")
+        await pf.start()
+        await dc.start()
+        args = parsers.parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"{dead_url},{pf.url},{dc.url}",
+            "--static-models", "fake-model,fake-model,fake-model",
+            "--static-model-labels", "prefill,prefill,decode",
+            "--routing-logic", "pd",
+            "--engine-stats-interval", "30",
+            "--kv-controller-url", "",
+        ])
+        client = TestClient(TestServer(build_app(args).app))
+        await client.start_server()
+        try:
+            ok = errors = 0
+            for i in range(16):
+                r = await client.post("/v1/completions", json={
+                    "model": "fake-model",
+                    "prompt": f"cold-{i} payload " * 16,  # distinct
+                    "max_tokens": 2,
+                })
+                if r.status == 200:
+                    ok += 1
+                else:
+                    errors += 1
+            # sequential requests: exactly the streak's worth of 502s
+            # before is_healthy trips and the pick fails over
+            assert errors <= 4, f"dead prefill never failed over ({errors})"
+            assert ok >= 12
+            assert not get_engine_health_board().is_healthy(dead_url)
+            # the live prefill engine took every later phase-1
+            assert len(pf.requests_seen) == ok
+        finally:
+            await client.close()
+            await pf.stop()
+            await dc.stop()
+            dead.close()
+
+    asyncio.run(run())

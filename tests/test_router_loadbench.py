@@ -223,6 +223,54 @@ def test_ttft_and_latency_policies_skip_unhealthy(reset_singletons):
     }
 
 
+def test_pd_two_role_smoke(reset_singletons, quiet_router_logs):
+    """PD-role, prefix-affine routing under load (chip-free): half the
+    stub engines labeled prefill, half decode, through the `pd` policy.
+    Contracts pinned: every session's COLD turn splits (exactly one
+    1-token non-streaming phase-1 per session on a prefill-role
+    engine), every stream lands on a decode-role engine, later turns
+    route prefix-affine single-phase (no phase-1), zero errors, and
+    the phase accounting still closes.
+
+    When ROUTER_BENCH_PD_PATH points at a bench file the CI job just
+    wrote (`router_loadgen.py --pd --smoke`), that run is gated
+    instead of re-running the whole scenario in-process — one load
+    run per CI job, and the uploaded artifact IS the gated evidence."""
+    bench_path = os.environ.get("ROUTER_BENCH_PD_PATH")
+    if bench_path and Path(bench_path).exists():
+        data = json.loads(Path(bench_path).read_text())
+        r = data["algorithms"]["pd"]
+        expected = data["config"]["requests_per_algorithm"]
+        concurrency = data["config"]["concurrency"]
+    else:
+        cfg = loadgen.RunConfig(
+            requests=512, concurrency=128, engines=4,
+            tokens=4, tokens_per_sec=8000.0,
+            pd=True, algorithms=("pd",),
+        )
+        results = asyncio.run(loadgen.run_suite(cfg))
+        r = results["algorithms"]["pd"]
+        expected, concurrency = cfg.requests, cfg.concurrency
+
+    assert r["requests"] == expected
+    assert r["errors"] == 0 and r["router_errors"] == 0
+    assert r["phase_closure"]["max_rel_err"] <= 0.05
+    assert loadgen.gates_pass(r) == []
+
+    pd = r["pd"]
+    # one cold split per session — not per request (PPD affinity), and
+    # a small slack for same-session turns racing the first turn's
+    # trie insert
+    assert pd["prefill_requests"] >= 1
+    assert pd["prefill_requests"] <= concurrency + 8
+    assert pd["phase1_single_token"]
+    assert pd["misrouted_streams"] == 0
+    # every completed request streamed from a decode-role engine
+    assert pd["decode_requests"] >= expected
+    # the overwhelming majority of turns resumed single-phase
+    assert pd["resume_single_phase"] >= expected - concurrency - 8
+
+
 def test_bench_json_ci_gate():
     """Gate a previously-written ROUTER_BENCH.json (the CI
     router-loadbench job runs the full --smoke profile first, then this

@@ -511,13 +511,15 @@ def test_repo_self_scan_is_clean_cli():
 
 
 def test_kv_tiering_stays_off_hot_paths():
-    """Zero-stall KV tiering (PR 4): the deferred-export staging
-    (LLMEngine._flush_kv_exports, ModelRunner.stage_export_blocks), the
-    staged-restore staging/landing (_advance_kv_restore,
-    stage_import_blocks, import_staged_blocks), and everything else in
+    """Zero-stall KV tiering (PR 4) + disaggregated PD transfer (PR 8):
+    the deferred-export staging (LLMEngine._flush_kv_exports,
+    ModelRunner.stage_export_blocks), the staged-restore staging/landing
+    (_advance_kv_restore, stage_import_blocks, import_staged_blocks),
+    the PD pull/serve paths (offload.request_chain_reads,
+    transfer.KVTransferServer._snapshot_chain), and everything else in
     engine/ + kv/ must keep device syncs and event-loop stalls off the
-    marked hot paths — the blocking d2h/tier IO belongs to the offload
-    worker thread."""
+    marked hot paths — the blocking d2h / tier IO / peer sockets belong
+    to the offload worker thread (or the executor, producer side)."""
     report = analyze_paths(
         [
             str(PACKAGE / "engine"),
@@ -525,27 +527,40 @@ def test_kv_tiering_stays_off_hot_paths():
         ],
         select=["device-sync-hot", "blocking-async"],
     )
-    assert report.files_scanned >= 25
+    assert report.files_scanned >= 26
     assert report.unsuppressed == [], "\n".join(
         f.format() for f in report.unsuppressed
     )
+    # the transfer/cache-server/peer modules must actually be INSIDE
+    # the sweep — a rename or move dropping them out would pass the
+    # zero-findings assertion silently
+    kv_report = analyze_paths(
+        [str(PACKAGE / "kv")],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    assert kv_report.files_scanned >= 7  # __init__, wire, controller,
+    # offload, cache_server, transfer, peer
 
 
 def test_kv_tiering_hot_marks_present():
     """The gate above is only meaningful while the staging functions
     actually carry the hot-path mark — a dropped mark would pass
-    silently. Parse the sources and assert each is marked."""
+    silently. Parse the sources and assert each is marked (including
+    the PD transfer pull/serve paths: the producer's under-lock
+    snapshot and the consumer's enqueue-only chain-read request)."""
     from production_stack_tpu.analysis.core import ModuleContext, iter_functions
 
     want = {
-        "llm_engine.py": {"_flush_kv_exports", "step"},
-        "model_runner.py": {
+        ("engine", "llm_engine.py"): {"_flush_kv_exports", "step"},
+        ("engine", "model_runner.py"): {
             "stage_export_blocks", "stage_import_blocks",
             "import_staged_blocks",
         },
+        ("kv", "transfer.py"): {"_snapshot_chain"},
+        ("kv", "offload.py"): {"request_chain_reads"},
     }
-    for fname, funcs in want.items():
-        path = PACKAGE / "engine" / fname
+    for (sub, fname), funcs in want.items():
+        path = PACKAGE / sub / fname
         ctx = ModuleContext(str(path), path.read_text())
         hot = {
             f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)

@@ -1,0 +1,233 @@
+"""kv/wire.py framing edge cases: the length-prefixed JSON+payload
+protocol under every KV TCP surface (controller, cache server, PD
+transfer). A framing bug here corrupts cross-engine KV silently, so the
+edge cases — truncated headers, oversize frames, address parsing, and
+multi-MB payload integrity — are pinned on BOTH the asyncio and the
+blocking-socket implementations."""
+
+import asyncio
+import socket
+import struct
+import threading
+
+import pytest
+
+from production_stack_tpu.kv import wire
+
+
+# -- parse_addr -------------------------------------------------------------
+@pytest.mark.parametrize(
+    ("spec", "want"),
+    [
+        ("host", ("host", 9000)),            # bare host -> default port
+        ("host:8123", ("host", 8123)),       # full host:port
+        (":8123", ("127.0.0.1", 8123)),      # bare port -> localhost
+        ("", ("127.0.0.1", 9000)),           # empty -> all defaults
+        ("10.0.0.5:80", ("10.0.0.5", 80)),
+    ],
+)
+def test_parse_addr_variants(spec, want):
+    assert wire.parse_addr(spec, 9000) == want
+
+
+def test_parse_addr_bad_port_raises():
+    with pytest.raises(ValueError):
+        wire.parse_addr("host:notaport", 9000)
+
+
+# -- encode/decode round trips ---------------------------------------------
+def _sync_pair():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+def test_sync_roundtrip_multi_mb_payload():
+    """A multi-MB payload (a realistic KV block batch) survives the
+    sync send/recv pair bit-exact — chunked socket reads must
+    reassemble exactly."""
+    a, b = _sync_pair()
+    try:
+        payload = bytes(range(256)) * (8 * 1024 * 5)  # ~10 MiB
+        meta = {"type": "get_chain", "hashes": [1, 2, 3]}
+        t = threading.Thread(
+            target=wire.sync_send, args=(a, meta, payload)
+        )
+        t.start()
+        got_meta, got_payload = wire.sync_recv(b)
+        t.join(timeout=10)
+        assert got_meta == meta
+        assert got_payload == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_async_roundtrip_multi_mb_payload():
+    payload = b"\xab\xcd" * (3 * 1024 * 1024)  # 6 MiB
+    meta = {"ok": True, "n": 7}
+
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(wire.encode_msg(meta, payload))
+        reader.feed_eof()
+        return await wire.recv_msg(reader)
+
+    got_meta, got_payload = asyncio.run(run())
+    assert got_meta == meta
+    assert got_payload == payload
+
+
+def test_empty_payload_roundtrip():
+    a, b = _sync_pair()
+    try:
+        wire.sync_send(a, {"type": "ping"})
+        meta, payload = wire.sync_recv(b)
+        assert meta == {"type": "ping"}
+        assert payload == b""
+    finally:
+        a.close()
+        b.close()
+
+
+# -- truncated frames -------------------------------------------------------
+def test_sync_truncated_header_raises_wire_error():
+    """A peer dying mid-header must surface as WireError (callers
+    degrade to recompute), never a hang or a silent short read."""
+    a, b = _sync_pair()
+    try:
+        a.sendall(b"\x00\x00\x00")  # 3 of 8 header bytes, then FIN
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.sync_recv(b)
+    finally:
+        b.close()
+
+
+def test_sync_truncated_payload_raises_wire_error():
+    a, b = _sync_pair()
+    try:
+        frame = wire.encode_msg({"x": 1}, b"payload-that-gets-cut")
+        a.sendall(frame[:-5])  # drop the payload tail
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.sync_recv(b)
+    finally:
+        b.close()
+
+
+def test_async_truncated_header_raises_incomplete_read():
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(b"\x00\x00")
+        reader.feed_eof()
+        with pytest.raises(asyncio.IncompleteReadError):
+            await wire.recv_msg(reader)
+
+    asyncio.run(run())
+
+
+def test_async_truncated_meta_raises_incomplete_read():
+    async def run():
+        reader = asyncio.StreamReader()
+        frame = wire.encode_msg({"type": "get_chain", "hashes": [1]})
+        reader.feed_data(frame[: wire._HDR.size + 4])  # cut inside meta
+        reader.feed_eof()
+        with pytest.raises(asyncio.IncompleteReadError):
+            await wire.recv_msg(reader)
+
+    asyncio.run(run())
+
+
+# -- oversize rejection -----------------------------------------------------
+def _oversize_header(meta_len: int, payload_len: int) -> bytes:
+    return struct.pack(">II", meta_len, payload_len)
+
+
+@pytest.mark.parametrize(
+    ("meta_len", "payload_len"),
+    [
+        (wire.MAX_META + 1, 0),          # oversize META
+        (8, wire.MAX_PAYLOAD + 1),       # oversize PAYLOAD
+    ],
+)
+def test_sync_oversize_frame_rejected(meta_len, payload_len):
+    """Oversize frames are rejected FROM THE HEADER ALONE — the
+    defensive cap must fire before any attempt to allocate/read the
+    advertised body (a hostile or corrupt peer must not make the
+    engine buffer gigabytes)."""
+    a, b = _sync_pair()
+    try:
+        a.sendall(_oversize_header(meta_len, payload_len))
+        with pytest.raises(wire.WireError, match="oversized"):
+            wire.sync_recv(b)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize(
+    ("meta_len", "payload_len"),
+    [
+        (wire.MAX_META + 1, 0),
+        (8, wire.MAX_PAYLOAD + 1),
+    ],
+)
+def test_async_oversize_frame_rejected(meta_len, payload_len):
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(_oversize_header(meta_len, payload_len))
+        # no body follows: the cap must trip on the header, not wait
+        # for unreadable bytes
+        with pytest.raises(wire.WireError, match="oversized"):
+            await wire.recv_msg(reader)
+
+    asyncio.run(run())
+
+
+def test_max_sized_header_fields_not_rejected_early():
+    """The caps are exclusive: exactly-MAX lengths pass header
+    validation (the read then waits for the body) — an off-by-one here
+    would reject legitimate 1 GiB block batches."""
+
+    async def run():
+        reader = asyncio.StreamReader()
+        meta = b"x" * 16
+        reader.feed_data(struct.pack(">II", len(meta), 0) + meta)
+        reader.feed_eof()
+        got, payload = None, None
+        try:
+            got, payload = await wire.recv_msg(reader)
+        except Exception as e:  # noqa: BLE001 — meta is not JSON here
+            assert isinstance(e, ValueError)
+        return got
+
+    asyncio.run(run())
+
+
+def test_bf16_block_payload_roundtrips():
+    """bf16 KV payloads (the production cache dtype) must round-trip
+    the wire/disk serialization as bfloat16 — np.save alone degrades
+    ml_dtypes arrays to raw void ('|V2'), which the import path then
+    rejects, silently losing every bf16 restore."""
+    import ml_dtypes
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import (
+        deserialize_block,
+        serialize_block,
+    )
+
+    arr = (np.arange(48, dtype=np.float32)
+           .reshape(2, 2, 3, 4) / 7.0).astype(ml_dtypes.bfloat16)
+    got = deserialize_block(serialize_block(arr))
+    assert got.dtype == ml_dtypes.bfloat16
+    assert got.shape == arr.shape
+    np.testing.assert_array_equal(
+        got.view(np.uint16), arr.view(np.uint16)
+    )
+    # builtin dtypes keep the plain np.save path
+    f32 = np.ones((2, 3), np.float32)
+    got = deserialize_block(serialize_block(f32))
+    assert got.dtype == np.float32
