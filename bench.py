@@ -127,6 +127,15 @@ KV_BLOCKS = int(os.environ.get("PST_BENCH_KV_BLOCKS", "0"))
 # Slots: BENCH_SWEEP_pd.json vs the matching @nopd control (PERF.md)
 PD = os.environ.get("PST_BENCH_PD", "0") == "1"
 SYNC_KV = os.environ.get("PST_BENCH_SYNC_KV", "0") == "1"
+# shared KV cache server (@remotekv, requires @kvoff): run an
+# in-process kv.cache_server and wire the engine's RemoteTier at it —
+# the LMCache-like topology (small host RAM buffer + cluster cache, NO
+# local disk tier): exports write through as write-behind batched PUT
+# frames, and resumes whose prefix aged out of the cpu buffer restore
+# over the wire as ONE get_chain pull instead of recomputing.
+# @noremotekv pins the local-tiers-only control (the @kvoff default).
+# Slots: BENCH_SWEEP_kvremote.json vs the matching @noremotekv control
+KV_REMOTE = os.environ.get("PST_BENCH_KV_REMOTE", "0") == "1"
 CPU_OFFLOAD_MB = int(os.environ.get("PST_BENCH_CPU_OFFLOAD_MB", "2048"))
 DISK_OFFLOAD_DIR = os.environ.get(
     "PST_BENCH_DISK_DIR", "/tmp/pst-bench-kv"
@@ -241,6 +250,10 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_RAGGED"] = "1"
             elif m == "noragged":
                 overrides["PST_BENCH_RAGGED"] = "0"
+            elif m == "remotekv":  # before the r<N> rounds prefix rule
+                overrides["PST_BENCH_KV_REMOTE"] = "1"
+            elif m == "noremotekv":
+                overrides["PST_BENCH_KV_REMOTE"] = "0"
             elif m.startswith("qps"):
                 overrides["PST_BENCH_QPS"] = str(float(m[3:]))
             elif m.startswith("chunk"):
@@ -272,7 +285,7 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
                     "| trace | elastic | noelastic | ragged | noragged "
-                    "| kvoff | synckv | pd | nopd"
+                    "| kvoff | synckv | remotekv | noremotekv | pd | nopd"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -283,6 +296,14 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 f"{label!r}: @synckv requires @kvoff (the sync path "
                 "only differs once the KV tiers are enabled)"
             )
+        if (overrides.get("PST_BENCH_KV_REMOTE") == "1"
+                and "PST_BENCH_KV_OFFLOAD" not in overrides):
+            # same honesty gate: the remote tier only sees traffic once
+            # the capped-HBM eviction workload is on
+            raise ValueError(
+                f"{label!r}: @remotekv requires @kvoff (shared-cache "
+                "traffic only exists under the capped-HBM workload)"
+            )
         kpart, mode, pack = base.split("-")
         # fail fast on typos: a scarce chip window must not silently run
         # the sync path under an "asynch" label
@@ -292,7 +313,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
                 "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
-                "|@noelastic|@ragged|@noragged|@kvoff|@synckv|@pd|@nopd]"
+                "|@noelastic|@ragged|@noragged|@kvoff|@synckv"
+                "|@remotekv|@noremotekv|@pd|@nopd]"
             )
         configs.append((
             label,
@@ -496,6 +518,17 @@ def _arm_watchdog(seconds: float, label: str):
     return t
 
 
+def _cache_server_box():
+    """@remotekv bench mode: an in-process `kv.cache_server` standing
+    in for the cluster's shared cache pod (colocated on this host — the
+    wire cost is loopback, so the A/B measures the
+    framing/serialization machinery, understating a real network's
+    latency but not its protocol overhead)."""
+    from production_stack_tpu.kv.cache_server import InProcessCacheServer
+
+    return InProcessCacheServer(capacity_bytes=8 * 2**30)
+
+
 class _PDPrefiller:
     """@pd bench mode: a colocated prefill-role engine with its own
     step thread and an in-process KVTransferServer, so the measured
@@ -658,6 +691,7 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
     # +1 generation block and pinned-export transients)
     kv_blocks = None
     kv_kwargs: dict = {}
+    cache_server_box = None
     if KV_OFFLOAD:
         kv_blocks = KV_BLOCKS or int(
             1.15 * NUM_USERS * -(-final_len // 32)
@@ -671,6 +705,16 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
             disk_offload_dir=DISK_OFFLOAD_DIR,
             sync_kv_offload=SYNC_KV,
         )
+        if KV_REMOTE:
+            # @remotekv: LMCache-like topology — capped cpu buffer +
+            # in-process shared cache server, NO local disk tier
+            # (overflow past host RAM restores over the wire as ONE
+            # chain pull; write-behind batched PUTs ship every export)
+            cache_server_box = _cache_server_box()
+            kv_kwargs["disk_offload_dir"] = None
+            kv_kwargs["remote_cache_url"] = (
+                f"127.0.0.1:{cache_server_box.port}"
+            )
     config = EngineConfig(
         model=MODEL,
         tokenizer="byte",
@@ -1142,6 +1186,18 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                     if engine.offload is not None else {},
                 },
             } if KV_OFFLOAD else {}),
+            # shared-cache attribution (@remotekv): engine-side
+            # RemoteTier counters (write-behind frames shipped, chain
+            # pull hits/misses, wire bytes) + the server's own
+            # occupancy/hit-rate stats
+            **({
+                "kv_remote": {
+                    "remote": engine.offload.remote.counters()
+                    if engine.offload is not None
+                    and engine.offload.remote is not None else {},
+                    "server": cache_server_box.stats(),
+                },
+            } if KV_REMOTE and cache_server_box is not None else {}),
             "mean_ttft_s": round(float(ttft_arr.mean()), 3)
             if len(ttft_arr)
             else -1,
@@ -1176,6 +1232,8 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         del pd_prefiller
     engine.shutdown()
     del engine
+    if cache_server_box is not None:
+        cache_server_box.close()
     gc.collect()
     teardown_guard.cancel()
     return result

@@ -36,6 +36,23 @@ def hash_block(prev_hash: int, token_ids: tuple[int, ...],
     return h.intdigest()
 
 
+def iter_chain_hashes(token_ids, block_size: int, seed: int = 0):
+    """Chain hashes for each *full* block of token_ids, lazily.
+
+    THE one token->block-hash folding, shared by the BlockManager, the
+    KV controller's prefix matcher, and the router's shared-cache
+    lookup hints — every copy of this loop that drifts (seed, chunk
+    boundary, partial-block handling) makes cross-component prefix
+    matches miss silently, so there is exactly one. Lazy so matchers
+    can stop hashing at the first miss."""
+    prev = seed
+    for i in range(len(token_ids) // block_size):
+        prev = hash_block(
+            prev, tuple(token_ids[i * block_size:(i + 1) * block_size])
+        )
+        yield prev
+
+
 class Block:
     __slots__ = ("block_id", "ref_count", "block_hash")
 
@@ -128,13 +145,9 @@ class BlockManager:
 
         `seed` starts the chain (0 = base model; LoRA requests pass a
         per-adapter seed so adapters never share KV blocks)."""
-        hashes = []
-        prev = seed
-        bs = self.block_size
-        for i in range(len(token_ids) // bs):
-            prev = hash_block(prev, tuple(token_ids[i * bs : (i + 1) * bs]))
-            hashes.append(prev)
-        return hashes
+        return list(
+            iter_chain_hashes(token_ids, self.block_size, seed)
+        )
 
     def contains_hash(self, h: int) -> bool:
         return h in self.cached_blocks

@@ -302,10 +302,13 @@ class LLMEngine:
         self._kv_restore_bytes_total = 0
         self._kv_restore_fallbacks_total = 0
         self._kv_export_sync_fallbacks_total = 0
-        if self.offload is not None and self.offload.tiers:
-            # export hooks only where there is somewhere to export TO: a
-            # peer-only manager (pure PD decode engine) must not pin and
-            # d2h-snapshot freed blocks into an empty cascade
+        if self.offload is not None and (
+            self.offload.tiers or self.offload.remote is not None
+        ):
+            # export hooks only where there is somewhere to export TO
+            # (local tiers or the shared cache server's write-through):
+            # a peer-only manager (pure PD decode engine) must not pin
+            # and d2h-snapshot freed blocks into an empty cascade
             if self._kv_async:
                 self.block_manager.on_freed_cached = (
                     self._queue_freed_exports
@@ -475,24 +478,29 @@ class LLMEngine:
             bm.num_blocks - 1,
             self.scheduler.config.max_model_len // bm.block_size,
         )
-        has_peer = self.offload.peer is not None
+        has_chain = self.offload.has_chain_source()
         i = 0
-        want: list[int] = []   # ordered fetch list (local + peer)
+        want: list[int] = []   # ordered fetch list (local + chain)
         local: list[int] = []  # hashes a local tier claims to hold
-        remote: list[int] = []  # tail the PD peer may hold (one pull)
+        remote: list[int] = []  # tail a chain source may hold (1 pull)
         while i < len(hashes) and len(want) < cap:
             h = hashes[i]
             if bm.contains_hash(h):
                 i += 1  # already resident: nothing to fetch
                 continue
-            if self.offload.contains(h):
+            if self.offload.contains_local(h):
+                # per-block local tier reads (pending/cpu/disk); blocks
+                # this engine pushed to the shared cache deliberately
+                # fall through to the chain branch — one get_chain pull
+                # beats a per-block network get each
                 want.append(h)
                 local.append(h)
-            elif has_peer:
-                # past the local continuation the PD peer may still
-                # hold the chain (it just prefilled this prompt, or a
-                # shared cache server has it) — the whole tail rides
-                # ONE get_chain pull on the offload worker
+            elif has_chain:
+                # past the local continuation the PD peer or the shared
+                # cache server may still hold the chain (a peer just
+                # prefilled this prompt, or a sibling engine pushed the
+                # prefix) — the whole tail rides ONE get_chain pull on
+                # the offload worker
                 want.append(h)
                 remote.append(h)
             else:
@@ -508,11 +516,12 @@ class LLMEngine:
             "rid": seq.request_id,
             "hashes": hashes,
             "want": want,
-            # pure-peer records (no local tier claimed anything) that
-            # come back empty are COLD PROMPTS the peer never
-            # prefilled (e.g. a resume's new tail) — finalize must not
-            # count them as restore fallbacks (kv_peer_misses already
-            # carries that signal)
+            # pure-chain records (no local tier claimed anything) that
+            # come back empty are COLD PROMPTS neither the PD peer nor
+            # the shared cache ever held (e.g. a resume's new tail) —
+            # finalize must not count them as restore fallbacks
+            # (kv_peer_misses / kv_remote_misses already carry that
+            # signal)
             "peer_only": bool(remote) and not local,
             "state": "fetching",
             "t0": time.monotonic(),
@@ -603,11 +612,11 @@ class LLMEngine:
         self._kv_restores.pop(rec["rid"], None)
         if rec["state"] != "staged":
             if not (rec.get("peer_only") and rec.get("nothing_fetched")):
-                # an empty PURE-PEER fetch is a cold prompt the peer
-                # never held, not a failed restore (kv_peer_misses /
-                # kv_peer_fallbacks carry that signal); everything
-                # else — local chain break, staging error, timeout —
-                # still counts
+                # an empty PURE-CHAIN fetch is a cold prompt neither
+                # the peer nor the shared cache held, not a failed
+                # restore (kv_peer_*/kv_remote_* carry that signal);
+                # everything else — local chain break, staging error,
+                # timeout — still counts
                 self._kv_restore_fallbacks_total += 1
             return
         bm = self.block_manager
@@ -821,16 +830,16 @@ class LLMEngine:
     def _pd_transfer_restore(
         self, seq: Sequence, hashes: list[int] | None = None
     ) -> None:
-        """SYNC-MODE disaggregated-prefill consumer pull: one batched
-        blocking round-trip from the PD peer for whatever the local
-        tiers could not supply. Only reachable from _restore_sync
-        (--sync-kv-offload attribution control and multihost engines) —
-        the zero-stall async path routes peer pulls through the staged
-        restore's pending-READ map instead (request_chain_reads), so no
-        socket ever runs on the scheduler thread there. `hashes` is the
-        precomputed chain when the caller already has it (one hashing
-        pass per admission)."""
-        if self.kv_peer is None:
+        """SYNC-MODE chain-source pull: one batched blocking round-trip
+        from the PD peer (then the shared cache server) for whatever
+        the local tiers could not supply. Only reachable from
+        _restore_sync (--sync-kv-offload attribution control and
+        multihost engines) — the zero-stall async path routes chain
+        pulls through the staged restore's pending-READ map instead
+        (request_chain_reads), so no socket ever runs on the scheduler
+        thread there. `hashes` is the precomputed chain when the caller
+        already has it (one hashing pass per admission)."""
+        if self.offload is None or not self.offload.has_chain_source():
             return
         bm = self.block_manager
         if hashes is None:
@@ -842,7 +851,16 @@ class LLMEngine:
             i += 1
         if i >= len(hashes):
             return
-        blocks, _peer = self.kv_peer.get_chain(hashes[i:])
+        blocks: list[np.ndarray] = []
+        for source in self.offload.chain_sources():
+            if i + len(blocks) >= len(hashes):
+                break
+            # a source serving only a short prefix hands the UNSERVED
+            # TAIL to the next one — same contract as the async path's
+            # _do_chain_read (a peer that evicted most of a chain the
+            # shared cache still holds must not force a recompute)
+            got, _addr = source.get_chain(hashes[i + len(blocks):])
+            blocks.extend(got)
         if not blocks:
             return
         restore: list[tuple[int, np.ndarray]] = []
@@ -3313,6 +3331,7 @@ class LLMEngine:
 
     # -- stats for /metrics -------------------------------------------------
     def stats(self) -> EngineStatsSnapshot:
+        _remote = self.offload.remote if self.offload is not None else None
         return EngineStatsSnapshot(
             num_running=self.scheduler.num_running,
             num_waiting=self.scheduler.num_waiting,
@@ -3380,6 +3399,24 @@ class LLMEngine:
             kv_peer_fallbacks_total=(
                 self.kv_peer.fallbacks
                 if self.kv_peer is not None else 0
+            ),
+            kv_remote_hits_total=(
+                _remote.hits if _remote is not None else 0
+            ),
+            kv_remote_misses_total=(
+                _remote.misses if _remote is not None else 0
+            ),
+            kv_remote_read_bytes_total=(
+                _remote.read_bytes if _remote is not None else 0
+            ),
+            kv_remote_write_bytes_total=(
+                _remote.write_bytes if _remote is not None else 0
+            ),
+            kv_remote_flushes_total=(
+                _remote.flushes if _remote is not None else 0
+            ),
+            kv_remote_fallbacks_total=(
+                _remote.fallbacks if _remote is not None else 0
             ),
         )
 

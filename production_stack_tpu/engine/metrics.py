@@ -199,6 +199,42 @@ class EngineMetrics:
             "corrupt payload) that degraded to local recompute",
             label, registry=reg,
         )
+        # cluster-wide shared KV cache (RemoteTier <-> kv.cache_server):
+        # cross-engine chain hits/misses, wire bytes each direction,
+        # write-behind put_batch frames, and failed flushes/pulls
+        self.kv_remote_hits = Counter(
+            "tpu:kv_remote_hits",
+            "KV blocks served by the shared cache server",
+            label, registry=reg,
+        )
+        self.kv_remote_misses = Counter(
+            "tpu:kv_remote_misses",
+            "KV blocks requested from the shared cache server but not "
+            "held there (cold chain or evicted/expired)",
+            label, registry=reg,
+        )
+        self.kv_remote_read_bytes = Counter(
+            "tpu:kv_remote_read_bytes",
+            "Bytes pulled from the shared cache server",
+            label, registry=reg,
+        )
+        self.kv_remote_write_bytes = Counter(
+            "tpu:kv_remote_write_bytes",
+            "Bytes shipped to the shared cache server (write-behind "
+            "batched puts)",
+            label, registry=reg,
+        )
+        self.kv_remote_flushes = Counter(
+            "tpu:kv_remote_flushes",
+            "Write-behind put_batch frames shipped to the shared cache",
+            label, registry=reg,
+        )
+        self.kv_remote_fallbacks = Counter(
+            "tpu:kv_remote_fallbacks",
+            "Failed shared-cache flushes/pulls (dead server / corrupt "
+            "frame) that degraded without stalling the engine",
+            label, registry=reg,
+        )
         # elastic fused decode: per-round chosen K (adaptive sizing in
         # pow2 buckets up to num_scheduler_steps), host-discarded
         # overshoot tokens (the K=32 waste mode — ~0 under device
@@ -369,6 +405,22 @@ class EngineMetrics:
         self.kv_peer_fallbacks.labels(m).inc(max(
             0, s.kv_peer_fallbacks_total
             - prev.kv_peer_fallbacks_total))
+        self.kv_remote_hits.labels(m).inc(max(
+            0, s.kv_remote_hits_total - prev.kv_remote_hits_total))
+        self.kv_remote_misses.labels(m).inc(max(
+            0, s.kv_remote_misses_total - prev.kv_remote_misses_total))
+        self.kv_remote_read_bytes.labels(m).inc(max(
+            0, s.kv_remote_read_bytes_total
+            - prev.kv_remote_read_bytes_total))
+        self.kv_remote_write_bytes.labels(m).inc(max(
+            0, s.kv_remote_write_bytes_total
+            - prev.kv_remote_write_bytes_total))
+        self.kv_remote_flushes.labels(m).inc(max(
+            0, s.kv_remote_flushes_total
+            - prev.kv_remote_flushes_total))
+        self.kv_remote_fallbacks.labels(m).inc(max(
+            0, s.kv_remote_fallbacks_total
+            - prev.kv_remote_fallbacks_total))
         for tier, c in (s.kv_tier_counters or {}).items():
             pc = (prev.kv_tier_counters or {}).get(tier, {})
             self.kv_tier_hits.labels(m, tier).inc(

@@ -96,6 +96,17 @@ class EngineStatsSnapshot:
     kv_peer_misses_total: int = 0
     kv_peer_read_bytes_total: int = 0
     kv_peer_fallbacks_total: int = 0
+    # shared cache server (RemoteTier): blocks served by / missing from
+    # the cluster-wide cache, bytes over the wire in each direction,
+    # write-behind put_batch frames shipped, and failed flushes/pulls
+    # (dead server) — tpu:kv_remote_* in /metrics and the bench
+    # `kv_remote` detail slot
+    kv_remote_hits_total: int = 0
+    kv_remote_misses_total: int = 0
+    kv_remote_read_bytes_total: int = 0
+    kv_remote_write_bytes_total: int = 0
+    kv_remote_flushes_total: int = 0
+    kv_remote_fallbacks_total: int = 0
 
     @property
     def prefix_cache_hit_rate(self) -> float:

@@ -14,8 +14,12 @@ messages so `kvaware` and `ttft` routing work.
 Modules:
   wire          length-prefixed JSON+payload framing (async + sync)
   controller    KVController server, KVControllerClient, ControllerReporter
-  offload       CpuTier / DiskTier / RemoteTier + KVOffloadManager
-  cache_server  standalone remote KV cache server process + client
+  offload       CpuTier / DiskTier + KVOffloadManager (worker, pending maps)
+  cache_server  standalone SHARED KV cache service (index + lookup verb,
+                batched frames, TTL+LRU across RAM->disk, health/metrics)
+  remote        RemoteTier + CacheClient/AsyncCacheClient — the engine and
+                router sides of the shared cache (write-behind batched
+                PUTs, one-pull chain restores, router lookup hints)
   transfer      disaggregated-prefill producer (KVTransferServer)
   peer          PeerTier — zero-stall inter-engine chain pulls (consumer)
 """

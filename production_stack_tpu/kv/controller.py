@@ -25,7 +25,7 @@ import socket
 import threading
 import time
 
-from production_stack_tpu.engine.block_manager import hash_block
+from production_stack_tpu.engine.block_manager import iter_chain_hashes
 from production_stack_tpu.kv import wire
 from production_stack_tpu.utils.log import init_logger
 
@@ -141,14 +141,11 @@ class KVController:
     @staticmethod
     def _match(tokens: list[int], inst: InstanceState,
                hashes: set[int]) -> int:
-        bs = inst.block_size
-        prev = 0
         matched = 0
-        for i in range(len(tokens) // bs):
-            prev = hash_block(prev, tuple(tokens[i * bs: (i + 1) * bs]))
-            if prev not in hashes:
+        for h in iter_chain_hashes(tokens, inst.block_size):
+            if h not in hashes:
                 break
-            matched += bs
+            matched += inst.block_size
         return matched
 
     # -- TCP server --------------------------------------------------------

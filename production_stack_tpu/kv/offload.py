@@ -1,4 +1,5 @@
-"""Engine-side KV offload tiers: host RAM -> local disk -> remote server.
+"""Engine-side KV offload tiers: host RAM -> local disk, plus the
+shared-cache and PD-peer chain sources.
 
 Capability parity with LMCache's LocalCpuBackend / LocalDiskBackend /
 remote server (reference: routing_logic.py:655-657 names the backends;
@@ -6,7 +7,11 @@ helm wires cpuOffloadingBufferSize / diskOffloadingBufferSize / remote
 cache server at deployment-vllm-multi.yaml:307-323). TPU-native twist:
 blocks arrive as host numpy arrays produced by the model runner's
 device->host block export (model_runner.export_blocks), i.e. the d2h DMA
-is done in one batched copy per freed sequence, not per block.
+is done in one batched copy per freed sequence, not per block. The
+remote cache server's tier lives in kv/remote.py (`RemoteTier`): NOT in
+the eviction cascade — the manager writes THROUGH to it on every store
+and reads from it only as a chain source (one `get_chain` per restore),
+like the PD `PeerTier`.
 
 Each tier is an LRU keyed by the chained block hash (same content address
 the BlockManager and KV controller use). Evictions cascade to the next
@@ -95,6 +100,10 @@ class KVTier:
     def contains(self, h: int) -> bool:
         raise NotImplementedError
 
+    def delete(self, h: int) -> None:
+        """Drop a block (TTL expiry / cache-server admin); no-op when
+        absent. Default: nothing — tiers that cannot delete keep it."""
+
     def hashes(self) -> list[int]:
         raise NotImplementedError
 
@@ -140,6 +149,12 @@ class CpuTier(KVTier):
     def contains(self, h: int) -> bool:
         with self._lock:
             return h in self._d
+
+    def delete(self, h: int) -> None:
+        with self._lock:
+            arr = self._d.pop(h, None)
+            if arr is not None:
+                self.used -= _nbytes(arr)
 
     def hashes(self) -> list[int]:
         with self._lock:
@@ -281,6 +296,24 @@ class DiskTier(KVTier):
         with self._lock:
             return h in self._sizes
 
+    def delete(self, h: int) -> None:
+        """Index drop under the lock, file removal outside it. A block
+        mid-landing (`_writing`) is WAITED OUT like get() does —
+        skipping it would leak the about-to-land file forever when the
+        caller (e.g. the cache server's TTL sweep) has already dropped
+        its own ledger entry and will never retry."""
+        with self._landed:
+            while h in self._writing:
+                self._landed.wait(timeout=0.25)
+            sz = self._sizes.pop(h, None)
+            if sz is None:
+                return
+            self.used -= sz
+        try:
+            os.remove(self._path(h))
+        except OSError:
+            pass
+
     def hashes(self) -> list[int]:
         with self._lock:
             return list(self._sizes.keys())
@@ -289,51 +322,6 @@ class DiskTier(KVTier):
         with self._lock:
             return {"tier": self.name, "blocks": len(self._sizes),
                     "used_bytes": self.used, "capacity_bytes": self.capacity}
-
-
-class RemoteTier(KVTier):
-    """Remote cache-server tier (shared across engines).
-
-    contains() consults a local memo of hashes this engine pushed (no
-    network round-trip — it sits on the engine's free/admission paths);
-    get() does the real fetch and also finds blocks pushed by peers.
-    """
-
-    name = "remote"
-
-    def __init__(self, client):
-        # client: production_stack_tpu.kv.cache_server.RemoteCacheClient
-        self.client = client
-        self._pushed: set[int] = set()
-        self._lock = threading.RLock()
-
-    def put(self, h: int, arr: np.ndarray) -> list[tuple[int, np.ndarray]]:
-        try:
-            self.client.put(h, arr)
-            with self._lock:
-                self._pushed.add(h)
-        except OSError as e:
-            logger.warning("remote KV put failed: %s", e)
-        return []
-
-    def get(self, h: int) -> np.ndarray | None:
-        try:
-            return self.client.get(h)
-        except OSError as e:
-            logger.warning("remote KV get failed: %s", e)
-            return None
-
-    def contains(self, h: int) -> bool:
-        with self._lock:
-            return h in self._pushed
-
-    def hashes(self) -> list[int]:
-        with self._lock:
-            return list(self._pushed)
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {"tier": self.name, "blocks_pushed": len(self._pushed)}
 
 
 class KVOffloadManager:
@@ -347,7 +335,8 @@ class KVOffloadManager:
     (--sync-kv-offload and unit tests).
     """
 
-    def __init__(self, tiers: list[KVTier], reporter=None, peer=None):
+    def __init__(self, tiers: list[KVTier], reporter=None, peer=None,
+                 remote=None):
         self.tiers = tiers
         # optional kv.peer.PeerTier (disaggregated prefill): NOT part of
         # the cascade — evictions never push to a peer and contains()
@@ -355,7 +344,26 @@ class KVOffloadManager:
         # request_chain_reads (one chain pull per restore, on the
         # worker) and the --sync-kv-offload control path.
         self.peer = peer
+        # optional kv.remote.RemoteTier (shared cache server): also NOT
+        # part of the cascade. The manager writes THROUGH to it (every
+        # stored block is offered via the tier's write-behind batched
+        # put, so sibling engines get cross-engine hits even while the
+        # local tiers still hold the block) and reads from it only as a
+        # chain source (one get_chain per restore, on the worker).
+        # contains() consults its push memo for export dedupe; restore
+        # partitioning uses contains_local() so remote-held chains ride
+        # the single pull instead of per-block reads.
+        self.remote = remote
         self.reporter = reporter
+        if remote is not None and reporter is not None:
+            # controller admits for tier 'remote' fire only when a
+            # write-behind batch is ACKED by the server — admitting at
+            # buffer time would leave phantom remote entries whenever a
+            # flush drops on a dead server (KV-aware routing would then
+            # chase restores that always miss)
+            remote.on_flushed = (
+                lambda hs: self.reporter.admit(self.remote.name, hs)
+            )
         # guards the pending-write/pending-read maps and the per-tier
         # counters; tiers are internally locked so the worker thread's
         # disk/remote IO never blocks the engine loop
@@ -467,17 +475,28 @@ class KVOffloadManager:
         for h in enq:
             self._q.put(("read", h))
 
+    def chain_sources(self) -> list:
+        """Chain-read sources in preference order: the PD peer (an
+        engine that JUST prefilled this prompt — intra-fleet, hottest)
+        first, then the shared cache server. Both speak the same
+        `get_chain(hashes) -> (blocks, addr)` contract."""
+        return [s for s in (self.peer, self.remote) if s is not None]
+
+    def has_chain_source(self) -> bool:
+        return self.peer is not None or self.remote is not None
+
     # stackcheck: hot-path — called at add_request on the scheduler
-    # thread: refcount + queue bookkeeping only; the peer's blocking
-    # socket round-trip runs on the worker (_do_chain_read)
+    # thread: refcount + queue bookkeeping only; the peer's/remote's
+    # blocking socket round-trip runs on the worker (_do_chain_read)
     def request_chain_reads(self, hashes: list[int]) -> None:
-        """Queue ONE peer chain pull for `hashes` (staged restore over
-        the inter-engine transfer). Same refcount contract as
-        request_reads; hashes already fetching/fetched ride the
-        existing entry, the rest travel as a single get_chain
-        round-trip (the chain hash is the address — no per-block
-        requests). Without a peer, the hashes park as misses so the
-        caller's poll/take flow needs no special case."""
+        """Queue ONE chain pull for `hashes` (staged restore over the
+        inter-engine transfer or the shared cache server). Same
+        refcount contract as request_reads; hashes already
+        fetching/fetched ride the existing entry, the rest travel as a
+        single get_chain round-trip (the chain hash is the address — no
+        per-block requests). Without any chain source, the hashes park
+        as misses so the caller's poll/take flow needs no special
+        case."""
         enq: list[int] = []
         with self._lock:
             for h in hashes:
@@ -488,7 +507,7 @@ class KVOffloadManager:
                     enq.append(h)
         if not enq:
             return
-        if self.peer is None:
+        if not self.has_chain_source():
             with self._lock:
                 for h in enq:
                     self._requested_reads.discard(h)
@@ -568,6 +587,24 @@ class KVOffloadManager:
         return self._lookup(h)[0]
 
     def contains(self, h: int) -> bool:
+        """Block known to the manager ANYWHERE it could write (pending,
+        local tiers, or already pushed to the shared cache) — the
+        export-dedupe probe: a block the remote already holds must not
+        be re-exported just because the local tiers dropped it."""
+        with self._lock:
+            if h in self._pending:
+                return True
+        if self._contains_tier(h):
+            return True
+        return self.remote is not None and self.remote.contains(h)
+
+    # stackcheck: hot-path — restore partitioning on the scheduler
+    # thread (_begin_kv_restore): in-memory map probes only
+    def contains_local(self, h: int) -> bool:
+        """Block readable via per-block LOCAL tier reads (pending map or
+        cpu/disk). Remote-held blocks deliberately answer False here so
+        the restore routes them through the ONE-pull chain read instead
+        of a per-block network get each."""
         with self._lock:
             if h in self._pending:
                 return True
@@ -579,6 +616,8 @@ class KVOffloadManager:
     def snapshot(self) -> dict[str, list[int]]:
         """tier -> hashes, for controller re-registration replay."""
         out = {t.name: t.hashes() for t in self.tiers}
+        if self.remote is not None:
+            out[self.remote.name] = self.remote.hashes()
         with self._lock:
             if self._pending and self.tiers:
                 out.setdefault(self.tiers[0].name, []).extend(self._pending)
@@ -593,6 +632,8 @@ class KVOffloadManager:
         ]
         if self.peer is not None:
             out.append(self.peer.stats())
+        if self.remote is not None:
+            out.append(self.remote.stats())
         return out
 
     def close(self) -> None:
@@ -600,6 +641,8 @@ class KVOffloadManager:
         self._worker.join(timeout=2.0)
         if self.peer is not None:
             self.peer.close()
+        if self.remote is not None:
+            self.remote.close()
 
     # -- worker thread -----------------------------------------------------
     def _run(self) -> None:
@@ -687,33 +730,60 @@ class KVOffloadManager:
                 self._pending_reads[h] = (arr, tier_name)
 
     def _do_chain_read(self, hashes: list[int]) -> None:
-        """Peer-chain-pull body: ONE blocking get_chain round-trip on
-        this worker thread, per-block results parked for the
-        requester(s) exactly like local tier reads (the pending-READ
-        map is the transport-agnostic fetch interface). The served
-        prefix parks as tier 'peer'; the unserved tail parks as misses
-        so the owning restore truncates at the break and recomputes."""
-        blocks, _ = self.peer.get_chain(hashes)
-        counts: dict[str, int] = {}
-        if blocks:
-            counts = {
-                "hits": len(blocks),
-                "read_bytes": sum(int(b.nbytes) for b in blocks),
-            }
+        """Chain-pull body: at most one blocking get_chain round-trip
+        per source on this worker thread (peer first, then the shared
+        cache — a source serving only a short prefix hands the
+        UNSERVED TAIL to the next source, so a peer that evicted most
+        of a chain the shared cache still holds does not force a
+        recompute), per-block results parked for the requester(s)
+        exactly like local tier reads (the pending-READ map is the
+        transport-agnostic fetch interface). Each served block parks
+        under its serving source's tier name ('peer'/'remote'); the
+        tail nobody serves parks as misses so the owning restore
+        truncates at the break and recomputes."""
+        sources = self.chain_sources()
+        blocks: list[np.ndarray] = []
+        tiers: list[str] = []  # per-block serving source
+        for source in sources:
+            if len(blocks) >= len(hashes):
+                break
+            got, _addr = source.get_chain(hashes[len(blocks):])
+            if got:
+                blocks.extend(got)
+                tiers.extend([source.name] * len(got))
+        counts: dict[str, dict[str, int]] = {}
+        for b, t in zip(blocks, tiers):
+            c = counts.setdefault(t, {"hits": 0, "read_bytes": 0})
+            c["hits"] += 1
+            c["read_bytes"] += int(b.nbytes)
         if len(blocks) < len(hashes):
-            counts["misses"] = len(hashes) - len(blocks)
+            # the fully-unserved tail is attributed to the first
+            # source walked (each source also keeps its own counters)
+            first = sources[0].name if sources else "peer"
+            counts.setdefault(first, {})["misses"] = (
+                counts.get(first, {}).get("misses", 0)
+                + len(hashes) - len(blocks)
+            )
         with self._lock:
             for i, h in enumerate(hashes):
                 self._requested_reads.discard(h)
                 if self._read_refs.get(h, 0) > 0:
                     if i < len(blocks):
-                        self._pending_reads[h] = (blocks[i], "peer")
+                        self._pending_reads[h] = (blocks[i], tiers[i])
                     else:
                         self._pending_reads[h] = (None, None)
         if counts:
-            self._count_all({"peer": counts})
+            self._count_all(counts)
 
     def _store(self, h: int, arr: np.ndarray) -> None:
+        # write THROUGH to the shared cache (write-behind batched put
+        # inside the tier — buffering here, the frame ships when the
+        # batch fills/ages): every exported block is offered so sibling
+        # engines get cross-engine hits regardless of local tier state.
+        # Controller admits fire from the tier's on_flushed callback
+        # (ack'd state only), not here.
+        if self.remote is not None and not self.remote.contains(h):
+            self.remote.put(h, arr)
         cascade = [(h, arr)]
         for tier in self.tiers:
             next_cascade: list[tuple[int, np.ndarray]] = []
@@ -749,21 +819,20 @@ def build_offload_manager(
     config, reporter=None, peer=None
 ) -> KVOffloadManager | None:
     """Construct tiers from EngineConfig (cpu/disk/remote settings).
-    `peer` is an optional kv.peer.PeerTier: a peer-only manager (no
-    local tiers) is valid — disaggregated decode engines restore
-    through the same pending-READ map without any offload tier."""
+    `peer` is an optional kv.peer.PeerTier: a peer-only or remote-only
+    manager (no local tiers) is valid — disaggregated decode engines
+    and shared-cache-only engines restore through the same pending-READ
+    map without any local offload tier."""
     tiers: list[KVTier] = []
     if config.cpu_offload_bytes:
         tiers.append(CpuTier(config.cpu_offload_bytes))
     if config.disk_offload_dir:
         tiers.append(DiskTier(config.disk_offload_dir))
+    remote = None
     if config.remote_cache_url:
-        from production_stack_tpu.kv.cache_server import RemoteCacheClient
+        from production_stack_tpu.kv.remote import RemoteTier
 
-        host, _, port = config.remote_cache_url.rpartition(":")
-        tiers.append(
-            RemoteTier(RemoteCacheClient(host or "127.0.0.1", int(port)))
-        )
-    if not tiers and peer is None:
+        remote = RemoteTier(config.remote_cache_url)
+    if not tiers and peer is None and remote is None:
         return None
-    return KVOffloadManager(tiers, reporter, peer=peer)
+    return KVOffloadManager(tiers, reporter, peer=peer, remote=remote)

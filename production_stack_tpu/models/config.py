@@ -110,6 +110,17 @@ TINY_DEBUG = _register(
     )
 )
 
+# same tiny dims with headroom past 512-token prompts: the shared-KV-
+# cache e2e serves a 512-token cross-engine prefix (tests/
+# test_cache_server.py) which TINY_DEBUG's 256 ceiling cannot hold
+TINY_CTX1K_DEBUG = _register(
+    dataclasses.replace(
+        TINY_DEBUG,
+        name="pst-tiny-ctx1k-debug",
+        max_model_len=1024,
+    )
+)
+
 TINY_MOE_DEBUG = _register(
     dataclasses.replace(
         TINY_DEBUG,

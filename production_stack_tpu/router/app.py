@@ -144,6 +144,12 @@ class RouterApp:
             session_key=args.session_key,
             kv_controller_url=args.kv_controller_url,
             kv_min_match_tokens=args.kv_aware_threshold,
+            kv_cache_server_url=getattr(
+                args, "kv_cache_server_url", None
+            ),
+            kv_cache_block_size=getattr(
+                args, "kv_cache_block_size", 32
+            ),
             kv_transfer_gbps=args.kv_transfer_gbps,
             kv_bytes_per_token=args.kv_bytes_per_token,
             default_prefill_tps=args.default_prefill_tps,

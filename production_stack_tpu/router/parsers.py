@@ -102,6 +102,19 @@ def build_parser() -> argparse.ArgumentParser:
     rout.add_argument("--kv-aware-threshold", type=int, default=2000,
                       help="min matched tokens before kvaware overrides "
                            "load-based choice")
+    rout.add_argument("--kv-cache-server-url", type=str, default=None,
+                      help="TCP address of the shared KV cache server "
+                           "(kv.cache_server); kvaware/prefixaware "
+                           "probe its `lookup` verb so cold-on-every-"
+                           "engine prompts with a cluster cache hit "
+                           "route load-aware into a RemoteTier restore "
+                           "instead of a recompute")
+    rout.add_argument("--kv-cache-block-size", type=int, default=32,
+                      help="engine KV block size used to fold tokens "
+                           "into chain hashes for cache-server lookups "
+                           "(MUST match the engines' --block-size — "
+                           "default mirrors the engine default; a "
+                           "mismatch makes every lookup miss silently)")
     rout.add_argument("--kv-transfer-gbps", type=float, default=10.0,
                       help="inter-engine KV pull bandwidth the ttft "
                            "estimator assumes for prefixes cached on a "

@@ -206,10 +206,137 @@ def _engine_prompt_text(request, tokenizer=None) -> str:
     return request.request_text()
 
 
+class SharedCacheHints:
+    """Cluster-cache prefix-depth probe feeding KV-aware routing.
+
+    Wraps the cache server's payload-free `lookup` verb
+    (kv.remote.AsyncCacheClient): tokens are folded into the SAME
+    chained block hashes the engines' BlockManager computes (so a depth
+    here IS a depth the RemoteTier restore will serve), and the answer
+    is matched-prefix TOKENS in the shared cache. A cold-on-every-
+    engine prompt with a cluster hit is cheaper to restore ANYWHERE
+    than to recompute somewhere — the caller turns that into a
+    load-aware pick instead of a sticky/QPS fallback. Every failure
+    mode degrades to depth 0 (routing must never depend on the cache
+    being up)."""
+
+    #: circuit-breaker cooldown after a failed lookup: routing must
+    #: never serialize behind a dead cache server's connect timeouts
+    #: (the client lock admits one request at a time), so after one
+    #: failure every probe short-circuits to depth 0 until the window
+    #: passes and ONE request retries
+    DOWN_COOLDOWN_S = 15.0
+
+    #: probe depth cap (tokens): prompts are hashed only this deep —
+    #: bounds the per-request tokenize+hash cost on huge prompts (a
+    #: multi-thousand-token cluster hit already decides the routing)
+    MAX_PROBE_TOKENS = 4096
+
+    def __init__(self, url: str, block_size: int = 32,
+                 timeout: float = 2.0, tokenizer=None):
+        from production_stack_tpu.kv.remote import AsyncCacheClient
+
+        self.url = url
+        self.block_size = block_size
+        self.tokenizer = tokenizer
+        self.client = AsyncCacheClient(url, timeout=timeout)
+        self._down_until = 0.0  # monotonic
+
+    def chain_hashes(self, tokens: list[int]) -> list[int]:
+        from production_stack_tpu.engine.block_manager import (
+            iter_chain_hashes,
+        )
+
+        return list(iter_chain_hashes(tokens, self.block_size))
+
+    def max_depth_tokens(self, tokens: list[int]) -> int:
+        """The deepest answer a lookup could possibly return (full
+        blocks only, probe cap applied) — callers skip the round-trip
+        entirely when an engine-local match already covers this."""
+        n = min(len(tokens), self.MAX_PROBE_TOKENS)
+        return (n // self.block_size) * self.block_size
+
+    async def depth_tokens(self, tokens: list[int]) -> int:
+        """Matched-prefix depth in TOKENS (0 on miss or any failure —
+        a dead cache server must not fail OR slow routing: failures
+        trip a cooldown during which probes short-circuit)."""
+        import time as _time
+
+        if _time.monotonic() < self._down_until:
+            return 0
+        hashes = self.chain_hashes(tokens[: self.MAX_PROBE_TOKENS])
+        if not hashes:
+            return 0
+        try:
+            depth = await self.client.lookup(hashes)
+        except Exception as e:  # noqa: BLE001 — the estimate degrades
+            self._down_until = _time.monotonic() + self.DOWN_COOLDOWN_S
+            logger.warning(
+                "shared-cache lookup failed (%s); skipping probes for "
+                "%.0fs", e, self.DOWN_COOLDOWN_S,
+            )
+            return 0
+        self._down_until = 0.0
+        self._note(hit=depth > 0)
+        return depth * self.block_size
+
+    async def probe_text(self, text: str) -> int:
+        """depth_tokens for raw text: the tokenize + per-block hashing
+        run in an EXECUTOR (a 100KB trie-cold prompt must not stall the
+        router event loop for every concurrent request) and only the
+        capped prefix is processed. The breaker check runs first so a
+        down server costs nothing at all."""
+        import asyncio
+        import time as _time
+
+        if _time.monotonic() < self._down_until:
+            return 0
+        # ~4 chars/token upper bound keeps the executor job itself
+        # bounded before the token-level cap applies
+        capped = text[: self.MAX_PROBE_TOKENS * 4]
+        tokens = await asyncio.get_running_loop().run_in_executor(
+            None, _tokenize_with, self.tokenizer, capped
+        )
+        return await self.depth_tokens(tokens)
+
+    def note_routed(self) -> None:
+        self._note(hit=False, routed=True, lookup=False)
+
+    def _note(self, hit: bool, routed: bool = False,
+              lookup: bool = True) -> None:
+        try:
+            from production_stack_tpu.router.services.metrics_service import (
+                note_shared_cache_lookup,
+            )
+        except ImportError:  # prometheus_client absent: hints still work
+            return
+        note_shared_cache_lookup(
+            self.url, hit=hit, routed=routed, lookup=lookup
+        )
+
+    async def close(self) -> None:
+        await self.client.close()
+
+
+def _tokenize_with(tokenizer, text: str) -> list[int]:
+    """Tokenize the way the target engines do: the provided model
+    tokenizer, else the hermetic byte tokenizer (incl. BOS) matching
+    engines running tokenizer="byte" — hashes must line up with
+    engine-side block hashes."""
+    if tokenizer is not None:
+        return tokenizer.encode(text)
+    from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+    return ByteTokenizer().encode(text)
+
+
 class KvawareRouter(RoutingInterface):
     """Route to the engine already holding the longest KV prefix, via the KV
     controller (reference: routing_logic.py:250 asks the LMCache controller;
-    ours asks production_stack_tpu.kv.controller)."""
+    ours asks production_stack_tpu.kv.controller). With a shared cache
+    server configured, a prompt no engine holds locally but the CLUSTER
+    cache does routes load-aware (any engine restores it via RemoteTier
+    at transfer cost) instead of falling back to session routing."""
 
     def __init__(
         self,
@@ -217,6 +344,8 @@ class KvawareRouter(RoutingInterface):
         session_key: str | None = "x-user-id",
         kv_min_match_tokens: int = 1,
         tokenizer=None,
+        kv_cache_server_url: str | None = None,
+        kv_cache_block_size: int = 32,
         **kwargs,
     ):
         self.controller_url = kv_controller_url
@@ -224,6 +353,11 @@ class KvawareRouter(RoutingInterface):
         self.fallback = SessionRouter(session_key)
         self.tokenizer = tokenizer
         self._client = None
+        self.cache_hints = (
+            SharedCacheHints(kv_cache_server_url, kv_cache_block_size,
+                             tokenizer=tokenizer)
+            if kv_cache_server_url else None
+        )
 
     async def start(self) -> None:
         # the router embeds the KV controller (engines report to it over
@@ -237,16 +371,11 @@ class KvawareRouter(RoutingInterface):
     async def close(self) -> None:
         if self._client is not None:
             await self._client.close()
+        if self.cache_hints is not None:
+            await self.cache_hints.close()
 
     def _tokenize(self, text: str) -> list[int]:
-        if self.tokenizer is not None:
-            return self.tokenizer.encode(text)
-        # fallback: the hermetic byte tokenizer (incl. BOS) so hashes line
-        # up with engines running tokenizer="byte"; real deployments pass
-        # the model tokenizer via --tokenizer
-        from production_stack_tpu.engine.tokenizer import ByteTokenizer
-
-        return ByteTokenizer().encode(text)
+        return _tokenize_with(self.tokenizer, text)
 
     async def route_request(self, endpoints, engine_stats, request_stats,
                             request) -> str:
@@ -268,24 +397,65 @@ class KvawareRouter(RoutingInterface):
         by_instance = {
             inst: n for inst, n in matches.items() if n >= self.min_match
         }
+        best_engine_tokens = 0
+        best_engine_url = None
         if by_instance:
             best = sorted(
                 by_instance.items(), key=lambda kv: -kv[1]
             )
-            for inst, _ in best:
+            for inst, n in best:
                 url = _match_instance_to_url(inst, endpoints)
                 if url is not None:
-                    return url
+                    best_engine_url, best_engine_tokens = url, n
+                    break
+        cluster_tokens = 0
+        if (self.cache_hints is not None
+                and best_engine_tokens
+                < self.cache_hints.max_depth_tokens(tokens)):
+            # only probe when the cluster could possibly answer DEEPER
+            # than the best engine-local match — a fully-covered prompt
+            # routes to its holder without a round-trip
+            cluster_tokens = await self.cache_hints.depth_tokens(tokens)
+        if (best_engine_url is not None
+                and best_engine_tokens >= cluster_tokens):
+            # an engine-local hit at least as deep as the cluster's
+            # beats paying the restore transfer
+            return best_engine_url
+        if cluster_tokens > 0 and cluster_tokens >= self.min_match:
+            # cluster hit beats recompute: EVERY engine can restore the
+            # chain from the shared cache, so pick load-aware instead
+            # of herding onto the session fallback
+            self.cache_hints.note_routed()
+            return _health_scored_pick(endpoints)
+        if best_engine_url is not None:
+            return best_engine_url
         return await self.fallback.route_request(
             endpoints, engine_stats, request_stats, request
         )
 
 
 class PrefixAwareRouter(RoutingInterface):
-    """HashTrie longest-prefix-match routing (reference: routing_logic.py:379)."""
+    """HashTrie longest-prefix-match routing (reference:
+    routing_logic.py:379). With a shared cache server configured, a
+    trie-cold prompt (this router never saw it — restart, or another
+    router replica served the session) probes the cluster cache: a hit
+    means ANY engine restores the chain via RemoteTier, so the pick
+    goes load-aware off the health scoreboard instead of blind QPS."""
 
-    def __init__(self, prefix_chunk_size: int = 128, **kwargs):
+    def __init__(self, prefix_chunk_size: int = 128, tokenizer=None,
+                 kv_cache_server_url: str | None = None,
+                 kv_cache_block_size: int = 32, **kwargs):
         self.trie = HashTrie(chunk_size=prefix_chunk_size)
+        self.tokenizer = tokenizer
+        self.cache_hints = (
+            SharedCacheHints(kv_cache_server_url, kv_cache_block_size,
+                             tokenizer=tokenizer)
+            if kv_cache_server_url else None
+        )
+
+    async def close(self) -> None:
+        if self.cache_hints is not None:
+            await self.cache_hints.close()
 
     async def route_request(self, endpoints, engine_stats, request_stats,
                             request) -> str:
@@ -299,6 +469,14 @@ class PrefixAwareRouter(RoutingInterface):
         if candidates and matched_chars > 0:
             cand_eps = [e for e in endpoints if e.url in candidates]
             url = self._qps_routing(cand_eps, request_stats)
+        elif (self.cache_hints is not None and text
+              and await self.cache_hints.probe_text(
+                  _engine_prompt_text(request, self.tokenizer)
+              ) > 0):
+            # trie-cold but cluster-hot: the chain is one RemoteTier
+            # pull away on whichever engine is least loaded
+            self.cache_hints.note_routed()
+            url = _health_scored_pick(endpoints)
         else:
             url = self._qps_routing(endpoints, request_stats)
         await self.trie.insert(text, url)

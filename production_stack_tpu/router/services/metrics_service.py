@@ -136,6 +136,27 @@ upstream_retries = Counter(
     ["server"], registry=ROUTER_REGISTRY,
 )
 
+# shared KV cache hints (kvaware/prefixaware querying the cache
+# server's `lookup` verb): how often the cluster cache held a prefix no
+# candidate engine did — each hit is a cold prompt that routed
+# load-aware into a restore instead of sticky into a recompute
+shared_cache_lookups = Counter(
+    "tpu_router:shared_cache_lookups",
+    "Cache-server lookup probes issued by KV-aware routing",
+    ["server"], registry=ROUTER_REGISTRY,
+)
+shared_cache_hits = Counter(
+    "tpu_router:shared_cache_hits",
+    "Lookups where the shared cache held a chain prefix",
+    ["server"], registry=ROUTER_REGISTRY,
+)
+shared_cache_routed = Counter(
+    "tpu_router:shared_cache_routed",
+    "Requests routed load-aware on a cluster cache hit (no engine "
+    "held the prefix locally)",
+    ["server"], registry=ROUTER_REGISTRY,
+)
+
 # engine health scoreboard gauges (mirror of GET /debug/engines; pushed
 # by stats/log_stats.py on each render so /metrics scrapes stay fresh)
 engine_ewma_latency = _g(
@@ -196,6 +217,21 @@ def observe_proxy_phases(
         upstream_errors.labels(
             server=url, kind=error_kind or "error"
         ).inc()
+
+
+def note_shared_cache_lookup(
+    cache_url: str, hit: bool, routed: bool, lookup: bool = True
+) -> None:
+    """KV-aware routing accounting against the shared cache server:
+    `lookup=True` counts a probe (plus its hit), `routed=True` counts
+    a request actually sent load-aware into a restore (a separate,
+    later decision — pass lookup=False for it)."""
+    if lookup:
+        shared_cache_lookups.labels(server=cache_url).inc()
+        if hit:
+            shared_cache_hits.labels(server=cache_url).inc()
+    if routed:
+        shared_cache_routed.labels(server=cache_url).inc()
 
 
 # router-host resource gauges (reference: routers/metrics_router.py:42-53)

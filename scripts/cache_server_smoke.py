@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Shared KV cache server smoke harness — no jax, no chip, REAL sockets.
+
+Starts a `kv.cache_server.KVCacheServer` (RAM + disk tier, short TTL)
+in-process, then drives the full verb surface from blocking
+`kv.remote.CacheClient`s the way a fleet of engines would: batched
+multi-block PUT frames, single/chain/batch GETs, `lookup` prefix-depth
+probes, LRU spill RAM -> disk, TTL expiry, health + metrics, and N
+concurrent writer/reader clients hammering the server at once (the
+IO-outside-lock discipline under load). Writes a stats artifact
+(default CACHE_SERVER_BENCH.json) and exits non-zero on any gate
+violation — the CI `kv-cache-server` job runs exactly this.
+
+Usage: python scripts/cache_server_smoke.py [--out CACHE_SERVER_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = __file__.rsplit("/scripts/", 1)[0]
+sys.path.insert(0, REPO)
+
+from production_stack_tpu.kv.cache_server import (  # noqa: E402
+    InProcessCacheServer,
+    probe,
+)
+from production_stack_tpu.kv.remote import CacheClient  # noqa: E402
+
+BLOCK_NBYTES = 64 * 1024          # ~a tiny-model KV block
+N_CHAINS = 24                     # distinct hash chains (sessions)
+CHAIN_LEN = 16                    # blocks per chain
+BATCH = 8                         # blocks per put_batch frame
+N_CLIENTS = 8                     # concurrent writer/reader threads
+RAM_CAPACITY = 40 * BLOCK_NBYTES  # forces a RAM -> disk spill
+TTL_S = 2.0
+
+
+def blk(chain: int, i: int) -> np.ndarray:
+    arr = np.full(
+        (2, 2, BLOCK_NBYTES // 16), chain * 1000 + i, dtype=np.float32
+    )
+    return arr
+
+
+def chain_hashes(chain: int) -> list[int]:
+    return [chain * 100_000 + i for i in range(CHAIN_LEN)]
+
+
+def drive_one_client(port: int, chains: list[int], errors: list[str]):
+    cl = CacheClient("127.0.0.1", port)
+    try:
+        for c in chains:
+            hashes = chain_hashes(c)
+            # batched write-behind shape: CHAIN_LEN blocks in
+            # CHAIN_LEN/BATCH frames
+            for ofs in range(0, CHAIN_LEN, BATCH):
+                cl.put_batch([
+                    (hashes[i], blk(c, i))
+                    for i in range(ofs, ofs + BATCH)
+                ])
+            depth = cl.lookup(hashes)
+            if depth != CHAIN_LEN:
+                errors.append(
+                    f"chain {c}: lookup depth {depth} != {CHAIN_LEN}"
+                )
+            blocks = cl.get_chain(hashes)
+            if len(blocks) != CHAIN_LEN:
+                errors.append(
+                    f"chain {c}: get_chain returned {len(blocks)}"
+                )
+                continue
+            for i, got in enumerate(blocks):
+                if got[0, 0, 0] != c * 1000 + i:
+                    errors.append(f"chain {c} block {i}: wrong payload")
+                    break
+    except Exception as e:  # noqa: BLE001 — any client failure fails CI
+        errors.append(f"client exception: {type(e).__name__}: {e}")
+    finally:
+        cl.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="CACHE_SERVER_BENCH.json")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="pst-cache-smoke-")
+    box = InProcessCacheServer(
+        capacity_bytes=RAM_CAPACITY, disk_dir=tmp, ttl_s=TTL_S
+    )
+    port = box.port
+
+    t0 = time.monotonic()
+    errors: list[str] = []
+    threads = []
+    per_client = max(1, N_CHAINS // N_CLIENTS)
+    for w in range(N_CLIENTS):
+        chains = list(range(w * per_client, (w + 1) * per_client))
+        t = threading.Thread(
+            target=drive_one_client, args=(port, chains, errors)
+        )
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=120)
+        if t.is_alive():
+            errors.append("client thread hung (lock held across IO?)")
+    drive_s = time.monotonic() - t0
+
+    cl = CacheClient("127.0.0.1", port)
+    stats_mid = cl.stats()
+    # the RAM tier cannot hold the working set: the spill MUST have
+    # cascaded into the disk tier
+    disk_blocks = next(
+        (t["blocks"] for t in stats_mid["tiers"] if t["tier"] == "disk"), 0
+    )
+    if disk_blocks <= 0:
+        errors.append("RAM->disk spill never happened")
+    if probe(f"127.0.0.1:{port}") != 0:
+        errors.append("health probe failed on a live server")
+    _, metrics_payload = cl.call({"type": "metrics"})
+    if b"pst_cache_server_hit_rate" not in metrics_payload:
+        errors.append("metrics verb missing hit_rate")
+
+    # TTL: everything expires once idle past the deadline
+    time.sleep(TTL_S + 0.5)
+    depth_after_ttl = cl.lookup(chain_hashes(0))
+    stats_end = cl.stats()
+    if depth_after_ttl != 0:
+        errors.append(
+            f"TTL never expired chain 0 (depth {depth_after_ttl})"
+        )
+    if stats_end["expired"] <= 0:
+        errors.append("expired counter never moved")
+    cl.close()
+
+    n_blocks = N_CLIENTS * per_client * CHAIN_LEN
+    result = {
+        "ok": not errors,
+        "errors": errors,
+        "clients": N_CLIENTS,
+        "chains": N_CLIENTS * per_client,
+        "blocks_put": n_blocks,
+        "block_nbytes": BLOCK_NBYTES,
+        "drive_seconds": round(drive_s, 3),
+        "put_blocks_per_s": round(n_blocks / max(drive_s, 1e-9), 1),
+        "stats_after_drive": stats_mid,
+        "stats_after_ttl": stats_end,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("stats_after_drive", "stats_after_ttl")},
+                     indent=2))
+
+    box.stop()
+    if errors:
+        print("FAIL:\n  " + "\n  ".join(errors), file=sys.stderr)
+        return 2
+    print(f"OK: {n_blocks} blocks over {N_CLIENTS} clients in "
+          f"{drive_s:.2f}s, disk spill {disk_blocks} blocks, TTL clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

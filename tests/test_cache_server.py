@@ -1,0 +1,633 @@
+"""Shared KV cache service (kv/cache_server.py + kv/remote.py).
+
+Covers the production-server behaviors the stub never had — IO outside
+the global lock (slow-disk regression), the per-chain `lookup` verb,
+batched put/get frames, TTL+LRU eviction across RAM -> disk, the
+health/metrics ops surface — plus the engine-side RemoteTier
+(write-behind batched PUTs, chain-read restores, dead-server
+degradation) and the acceptance e2e: engine B cold-starts a 512-token
+prefix engine A served, restored cross-engine through the cache server
+with decode tokens bit-identical to recompute-from-scratch.
+"""
+
+
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.kv.cache_server import (
+    InProcessCacheServer,
+    probe,
+)
+from production_stack_tpu.kv.offload import (
+    CpuTier,
+    KVOffloadManager,
+    KVTier,
+)
+from production_stack_tpu.kv.remote import CacheClient, RemoteTier
+
+
+def blk(v, nbytes=1024):
+    # shaped like a (k/v, layers, rest) wire block so batched frames can
+    # stack on the wire block axis (axis=2), same as real KV payloads
+    return np.full((2, 2, nbytes // 16), v, dtype=np.float32)
+
+
+def _wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+@pytest.fixture
+def server_box():
+    """InProcessCacheServer factory (real sockets, own-thread loop, the
+    shared harness from kv/cache_server.py); all stopped on teardown."""
+    boxes = []
+
+    def make(**kw):
+        box = InProcessCacheServer(**kw)
+        boxes.append(box)
+        return box
+
+    yield make
+    for b in boxes:
+        b.stop()
+
+
+# -- spill cascade / index / lookup -----------------------------------------
+def test_ram_disk_spill_cascade_all_retrievable(tmp_path, server_box):
+    """RAM too small for the working set -> oldest blocks spill to the
+    disk tier; every block stays retrievable and the chain index keeps
+    them all visible to `lookup`."""
+    box = server_box(
+        capacity_bytes=2 * 1024 + 512,  # ~2 blocks of RAM
+        disk_dir=str(tmp_path / "spill"),
+    )
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        for i in range(1, 6):
+            cl.put(i, blk(i))
+        srv = box.server
+        assert len(srv.tiers[1].hashes()) >= 3, "nothing spilled to disk"
+        for i in range(1, 6):
+            np.testing.assert_array_equal(cl.get(i), blk(i))
+        assert cl.lookup([1, 2, 3, 4, 5]) == 5
+    finally:
+        cl.close()
+
+
+def test_lru_eviction_updates_index_and_counters(server_box):
+    """Blocks falling off the LAST tier leave the per-chain index (a
+    lookup/exists must not advertise state the tiers no longer hold)
+    in LRU order: the touched block survives, the cold one dies."""
+    box = server_box(capacity_bytes=3 * 1024 + 512)  # RAM only, 3 blocks
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        for i in (1, 2, 3):
+            cl.put(i, blk(i))
+        cl.get(1)  # touch -> 2 is now LRU
+        cl.put(4, blk(4))
+        assert not cl.exists(2), "LRU victim still indexed"
+        for i in (1, 3, 4):
+            assert cl.exists(i)
+        st = cl.stats()
+        assert st["evicted"] >= 1
+        assert st["blocks"] == 3
+    finally:
+        cl.close()
+
+
+def test_ttl_expiry_and_refresh(server_box):
+    """TTL bounds staleness beyond LRU: entries expire by age (lazily
+    on the query path), a re-put refreshes the deadline."""
+    box = server_box(capacity_bytes=1 << 20, ttl_s=0.3)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        cl.put(10, blk(10))
+        cl.put(11, blk(11))
+        assert cl.exists(10) and cl.lookup([10, 11]) == 2
+        time.sleep(0.18)
+        cl.put(11, blk(11))  # refresh 11's deadline
+        time.sleep(0.18)     # 10 is past TTL, 11 is not
+        assert not cl.exists(10)
+        assert cl.exists(11)
+        assert cl.get(10) is None
+        st = cl.stats()
+        assert st["expired"] >= 1
+    finally:
+        cl.close()
+
+
+def test_lookup_depth_semantics(server_box):
+    """`lookup` answers prefix-hit DEPTH for a hash chain: it stops at
+    the first missing link (a mid-chain gap hides the stored tail —
+    exactly the restore semantics), costs no payload, and counts."""
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        for h in (100, 101, 103):  # 102 missing: chain breaks there
+            cl.put(h, blk(h % 7))
+        assert cl.lookup([100, 101, 102, 103]) == 2
+        assert cl.lookup([100, 101, 103]) == 3
+        assert cl.lookup([999]) == 0
+        assert cl.lookup([]) == 0
+        st = cl.stats()
+        assert st["lookups"] == 4
+        assert st["lookup_hits"] == 2
+    finally:
+        cl.close()
+
+
+# -- batched frames ----------------------------------------------------------
+def test_batched_put_get_frames(server_box):
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        pairs = [(200 + i, blk(i, nbytes=2048)) for i in range(5)]
+        cl.put_batch(pairs)  # ONE frame
+        st = cl.stats()
+        assert st["puts"] == 5
+        # chain read back in one frame
+        blocks = cl.get_chain([200, 201, 202, 203, 204])
+        assert len(blocks) == 5
+        for (h, want), got in zip(pairs, blocks):
+            np.testing.assert_array_equal(got, want)
+        # arbitrary-subset batched read
+        reply, payload = cl.call(
+            {"type": "get_batch", "hashes": [201, 999, 203]}
+        )
+        assert reply["ok"] and reply["found"] == [201, 203]
+        from production_stack_tpu.kv.offload import deserialize_block
+
+        data = deserialize_block(payload)
+        assert int(data.shape[2]) == 2
+        np.testing.assert_array_equal(data[:, :, 0], pairs[1][1])
+    finally:
+        cl.close()
+
+
+def test_put_batch_hash_count_mismatch_rejected(server_box):
+    """A put_batch whose meta hash list disagrees with the payload's
+    block count is rejected with an error reply — storing blocks under
+    wrong hashes would serve another prompt's KV as a prefix hit."""
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        from production_stack_tpu.kv.offload import serialize_block
+
+        data = np.stack([blk(1), blk(2)], axis=2)  # 2 blocks
+        reply, _ = cl.call(
+            {"type": "put_batch", "hashes": [1, 2, 3]},  # 3 hashes
+            serialize_block(data),
+        )
+        assert not reply["ok"] and "put_batch" in reply["error"]
+        assert not cl.exists(1)
+        # the connection AND server survive the rejection
+        cl.put(7, blk(7))
+        assert cl.exists(7)
+    finally:
+        cl.close()
+
+
+def test_corrupt_payload_error_reply_not_connection_death(server_box):
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        reply, _ = cl.call({"type": "put", "hash": 5}, b"not-a-block")
+        assert not reply["ok"]
+        cl.put(6, blk(6))  # same connection still serves
+        assert cl.exists(6)
+    finally:
+        cl.close()
+
+
+def test_oversize_frame_drops_connection_not_server(server_box):
+    """A hostile/corrupt header past the wire caps kills that
+    CONNECTION (the stream offset is unrecoverable) — the server keeps
+    serving everyone else."""
+    box = server_box(capacity_bytes=1 << 20)
+    s = socket.create_connection(("127.0.0.1", box.port), timeout=5)
+    try:
+        from production_stack_tpu.kv import wire
+
+        s.sendall(struct.pack(">II", wire.MAX_META + 1, 0))
+        s.settimeout(5)
+        assert s.recv(1) == b"", "server should close the connection"
+    finally:
+        s.close()
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        cl.put(8, blk(8))
+        assert cl.exists(8)
+    finally:
+        cl.close()
+
+
+# -- the IO-outside-lock regression (satellite: slow-disk stub) --------------
+class _SlowTier(KVTier):
+    """Disk-tier stand-in whose put blocks until released — the
+    regression stand-in for a multi-MB spill on slow disk."""
+
+    name = "slowdisk"
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def put(self, h, arr):
+        self.started.set()
+        assert self.release.wait(10), "slow put never released"
+        with self._lock:
+            self._d[h] = arr
+        return []
+
+    def get(self, h):
+        with self._lock:
+            return self._d.get(h)
+
+    def contains(self, h):
+        with self._lock:
+            return h in self._d
+
+    def delete(self, h):
+        with self._lock:
+            self._d.pop(h, None)
+
+    def hashes(self):
+        with self._lock:
+            return list(self._d)
+
+    def stats(self):
+        with self._lock:
+            return {"tier": self.name, "blocks": len(self._d)}
+
+
+def test_slow_disk_spill_does_not_stall_concurrent_reads(server_box):
+    """THE PR 4 discipline, finally applied to the cache server: a put
+    stalled in tier IO (disk spill) must not hold the server lock —
+    concurrent gets/lookups on other connections keep answering. The
+    pre-fix server held `self._lock` across the whole cascade, so this
+    test timed out there."""
+    one = blk(1)
+    box = server_box(capacity_bytes=one.nbytes + 100)  # room for ONE
+    slow = _SlowTier()
+    box.server.tiers.append(slow)
+    writer = CacheClient("127.0.0.1", box.port)
+    reader = CacheClient("127.0.0.1", box.port)
+    try:
+        writer.put(1, blk(1))
+
+        def stalled_put():
+            writer.put(2, blk(2))  # evicts 1 -> cascades into slow tier
+
+        t = threading.Thread(target=stalled_put, daemon=True)
+        t.start()
+        assert slow.started.wait(5), "cascade never reached the slow tier"
+        # the spill is now BLOCKED mid-IO; reads must still answer fast
+        t0 = time.monotonic()
+        got = reader.get(2)  # in RAM (it displaced 1)
+        np.testing.assert_array_equal(got, blk(2))
+        assert reader.lookup([2]) == 1
+        assert reader.health()["status"] == "ok"
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, (
+            f"reads stalled {elapsed:.1f}s behind a slow disk spill"
+        )
+        slow.release.set()
+        t.join(timeout=10)
+        np.testing.assert_array_equal(reader.get(1), blk(1))
+    finally:
+        slow.release.set()
+        writer.close()
+        reader.close()
+
+
+# -- ops surface -------------------------------------------------------------
+def test_health_verb_and_probe(server_box):
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        h = cl.health()
+        assert h["status"] == "ok" and h["uptime_s"] >= 0
+    finally:
+        cl.close()
+    assert probe(f"127.0.0.1:{box.port}") == 0
+    # a dead port is unhealthy (exit 1), never an exception
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+    assert probe(f"127.0.0.1:{dead_port}", timeout=1.0) == 1
+
+
+def test_probe_cli_exit_codes(server_box):
+    """The helm liveness probe contract: `python -m ...cache_server
+    --probe host:port` exits 0 against a live server."""
+    box = server_box(capacity_bytes=1 << 20)
+    proc = subprocess.run(
+        [sys.executable, "-m", "production_stack_tpu.kv.cache_server",
+         "--probe", f"127.0.0.1:{box.port}"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_metrics_verb_prometheus_text(server_box):
+    box = server_box(capacity_bytes=1 << 20)
+    cl = CacheClient("127.0.0.1", box.port)
+    try:
+        cl.put(1, blk(1))
+        cl.get(1)
+        reply, payload = cl.call({"type": "metrics"})
+        assert reply["ok"]
+        text = payload.decode("utf-8")
+        for needle in (
+            "pst_cache_server_puts_total 1",
+            "pst_cache_server_gets_total 1",
+            "pst_cache_server_hits_total 1",
+            "pst_cache_server_hit_rate 1.0",
+            'pst_cache_server_tier_used_bytes{tier="cpu"}',
+            "pst_cache_server_blocks 1",
+        ):
+            assert needle in text, f"missing {needle!r} in:\n{text}"
+    finally:
+        cl.close()
+
+
+# -- RemoteTier (engine side) ------------------------------------------------
+def test_remote_tier_write_behind_batches(server_box):
+    box = server_box(capacity_bytes=1 << 20)
+    tier = RemoteTier(f"127.0.0.1:{box.port}", flush_blocks=4,
+                      flush_age_s=0.05)
+    try:
+        for i in range(4):
+            tier.put(300 + i, blk(i))
+        # threshold flush: ONE put_batch frame shipped
+        assert _wait_until(lambda: tier.flushes >= 1)
+        cl = CacheClient("127.0.0.1", box.port)
+        assert cl.lookup([300, 301, 302, 303]) == 4
+        # trailing partial batch ships via the age sweeper, no 5th put
+        tier.put(304, blk(9))
+        assert _wait_until(lambda: cl.exists(304))
+        assert tier.contains(300) and tier.contains(304)
+        assert tier.write_bytes > 0 and tier.puts == 5
+        # memo-only contains: a block another engine pushed is NOT
+        # visible here (it is found via get_chain instead)
+        cl.put(999, blk(3))
+        assert not tier.contains(999)
+        blocks, addr = tier.get_chain([300, 301, 999])
+        assert len(blocks) == 3 and addr == f"127.0.0.1:{box.port}"
+        assert tier.hits >= 3
+        cl.close()
+    finally:
+        tier.close()
+
+
+def test_remote_tier_degrades_on_dead_server():
+    """Every network failure is a counted fallback, never an exception
+    into the offload worker and never a scheduler stall."""
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()  # nothing listens here
+    tier = RemoteTier(f"127.0.0.1:{port}", flush_blocks=2,
+                      flush_age_s=10.0, timeout=0.5)
+    try:
+        tier.put(1, blk(1))
+        tier.put(2, blk(2))  # threshold flush -> connect fails
+        assert _wait_until(lambda: tier.fallbacks >= 1)
+        blocks, addr = tier.get_chain([1, 2])
+        assert blocks == [] and addr is None
+        assert tier.get(5) is None
+        assert not tier.ping()
+    finally:
+        tier.close()
+
+
+def test_offload_manager_writes_through_to_remote(server_box):
+    """The manager offers EVERY stored block to the shared cache
+    (write-behind), not just cascade overflow — sibling engines must
+    get cross-engine hits while the local tiers still hold the block.
+    contains() covers the remote memo (export dedupe); contains_local()
+    deliberately does not (restores route remote-held chains through
+    the ONE-pull chain read)."""
+    box = server_box(capacity_bytes=1 << 20)
+    cpu = CpuTier(capacity_bytes=1 << 20)
+    tier = RemoteTier(f"127.0.0.1:{box.port}", flush_blocks=2,
+                      flush_age_s=0.05)
+    m = KVOffloadManager([cpu], remote=tier)
+    try:
+        m.put_batch([(1, blk(1)), (2, blk(2))])
+        assert _wait_until(lambda: cpu.contains(1) and cpu.contains(2))
+        cl = CacheClient("127.0.0.1", box.port)
+        assert _wait_until(lambda: cl.lookup([1, 2]) == 2), (
+            "blocks never wrote through to the cache server"
+        )
+        cl.close()
+        assert m.contains(1) and m.contains_local(1)
+        # drop from the local tier: still contained (remote memo), no
+        # longer contained LOCALLY -> the restore takes the chain path
+        cpu.delete(1)
+        assert m.contains(1)
+        assert not m.contains_local(1)
+        assert m.has_chain_source()
+    finally:
+        m.close()
+
+
+def test_chain_reads_park_as_remote_tier(server_box):
+    """request_chain_reads against a cache server (no PD peer): the
+    worker's ONE get_chain parks per-block results attributed to tier
+    'remote', unserved tails park as misses."""
+    box = server_box(capacity_bytes=1 << 20)
+    seed = CacheClient("127.0.0.1", box.port)
+    for h in (21, 22):  # 23 deliberately absent
+        seed.put(h, blk(h))
+    seed.close()
+    tier = RemoteTier(f"127.0.0.1:{box.port}")
+    m = KVOffloadManager([], remote=tier)
+    try:
+        m.request_chain_reads([21, 22, 23])
+        assert _wait_until(lambda: len(m.poll_reads([21, 22, 23])) == 3)
+        got = m.take_reads([21, 22, 23])
+        arr21, src21 = got[21]
+        np.testing.assert_array_equal(arr21, blk(21))
+        assert src21 == "remote"
+        assert got[22][1] == "remote"
+        assert got[23] == (None, None)
+        assert tier.hits == 2 and tier.misses == 1
+    finally:
+        m.close()
+
+
+# -- acceptance e2e: cross-engine shared-cache restore -----------------------
+def test_cross_engine_shared_cache_restore_e2e(server_box):
+    """Engine A serves a 512-token shared prefix; engine B (a separate
+    engine process-equivalent, cold, NO local tiers) restores the chain
+    from the shared cache server through its RemoteTier staged restore
+    and decodes tokens bit-identical to a recompute-from-scratch
+    control. tpu:kv_remote_hits > 0 on B proves the cross-engine hit."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    box = server_box(capacity_bytes=1 << 30)
+    url = f"127.0.0.1:{box.port}"
+
+    def cfg(**over):
+        base = dict(
+            model="pst-tiny-ctx1k-debug",
+            tokenizer="byte",
+            dtype="float32",
+            cache_dtype="float32",
+            block_size=8,
+            num_kv_blocks=96,
+            max_num_seqs=2,
+            max_prefill_chunk=128,
+        )
+        base.update(over)
+        return EngineConfig(**base)
+
+    sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    prefix = [(17 + i * 13) % 250 for i in range(512)]  # 64 blocks
+
+    # -- engine A: serves the prefix, exports ride the write-behind
+    eng_a = LLMEngine(cfg(remote_cache_url=url))
+    try:
+        assert eng_a._kv_async
+        out_a = eng_a.generate([list(prefix)], sp)[0]
+        assert len(out_a.token_ids) == 8
+        # freed-but-cached blocks export -> write through to the server
+        cl = CacheClient("127.0.0.1", box.port)
+        hashes = eng_a.block_manager.block_hashes_for(list(prefix), 0)
+        assert _wait_until(
+            lambda: cl.lookup(hashes) >= len(hashes), timeout=30
+        ), "engine A's prefix chain never reached the cache server"
+        cl.close()
+        assert eng_a.offload.remote.flushes > 0, (
+            "exports should ship as batched put_batch frames"
+        )
+    finally:
+        eng_a.shutdown()
+
+    # -- engine B: cold, remote-only (no cpu/disk tiers) — the 512-token
+    # prefix must come over the wire as ONE chain pull
+    eng_b = LLMEngine(cfg(remote_cache_url=url))
+    try:
+        out_b = eng_b.generate([list(prefix)], sp)[0]
+        assert eng_b.offload.remote.hits > 0, (
+            "no cross-engine shared-cache hit (tpu:kv_remote_hits == 0)"
+        )
+        assert eng_b._kv_restore_blocks_total > 0, (
+            "restore never landed staged blocks"
+        )
+        snap = eng_b.stats()
+        assert snap.kv_remote_hits_total > 0
+        assert snap.kv_remote_read_bytes_total > 0
+    finally:
+        eng_b.shutdown()
+
+    # -- control: recompute from scratch, no cache anywhere
+    ctl = LLMEngine(cfg())
+    try:
+        out_c = ctl.generate([list(prefix)], sp)[0]
+    finally:
+        ctl.shutdown()
+
+    assert out_b.token_ids == out_c.token_ids, (
+        "cross-engine restored decode diverged from recompute"
+    )
+    assert out_a.token_ids == out_c.token_ids
+
+
+class _StubPeer:
+    """Chain source serving only the first `n` hashes of any request."""
+
+    name = "peer"
+
+    def __init__(self, n, block):
+        self.n = n
+        self.block = block
+        self.calls = []
+
+    def get_chain(self, hashes):
+        self.calls.append(list(hashes))
+        got = [self.block.copy() for _ in hashes[: self.n]]
+        return got, ("stub:1" if got else None)
+
+    def close(self):
+        pass
+
+
+def test_chain_read_spans_sources_peer_then_remote(server_box):
+    """A PD peer serving only a short prefix hands the UNSERVED TAIL to
+    the shared cache — a chain the peer mostly evicted but the cluster
+    cache still holds must not force a recompute."""
+    box = server_box(capacity_bytes=1 << 20)
+    seed = CacheClient("127.0.0.1", box.port)
+    for h in (31, 32, 33):
+        seed.put(h, blk(h))
+    seed.close()
+    peer = _StubPeer(n=1, block=blk(99))
+    remote = RemoteTier(f"127.0.0.1:{box.port}")
+    m = KVOffloadManager([], peer=peer, remote=remote)
+    try:
+        m.request_chain_reads([31, 32, 33])
+        assert _wait_until(lambda: len(m.poll_reads([31, 32, 33])) == 3)
+        got = m.take_reads([31, 32, 33])
+        # block 31 came from the peer, 32/33 from the shared cache
+        assert got[31][1] == "peer"
+        np.testing.assert_array_equal(got[31][0], blk(99))
+        assert got[32][1] == "remote" and got[33][1] == "remote"
+        np.testing.assert_array_equal(got[33][0], blk(33))
+        # the remote was asked only for the tail the peer did not serve
+        assert peer.calls == [[31, 32, 33]]
+        assert remote.hits == 2
+    finally:
+        m.close()
+
+
+def test_remote_flush_callback_fires_only_on_ack(server_box):
+    """Controller admits for tier 'remote' must reflect server-ACKED
+    state: the on_flushed callback fires with the flushed hashes after
+    a successful put_batch, and NOT for a dropped batch."""
+    box = server_box(capacity_bytes=1 << 20)
+    tier = RemoteTier(f"127.0.0.1:{box.port}", flush_blocks=2,
+                      flush_age_s=10.0)
+    flushed = []
+    tier.on_flushed = lambda hs: flushed.append(sorted(hs))
+    try:
+        tier.put(41, blk(1))
+        tier.put(42, blk(2))  # threshold flush
+        assert _wait_until(lambda: flushed == [[41, 42]])
+    finally:
+        tier.close()
+    # dead server: batch drops, callback must NOT fire
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()
+    tier2 = RemoteTier(f"127.0.0.1:{port}", flush_blocks=2,
+                       flush_age_s=10.0, timeout=0.5)
+    dropped = []
+    tier2.on_flushed = lambda hs: dropped.append(hs)
+    try:
+        tier2.put(51, blk(1))
+        tier2.put(52, blk(2))
+        assert _wait_until(lambda: tier2.fallbacks >= 1)
+        assert dropped == []
+    finally:
+        tier2.close()

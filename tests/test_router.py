@@ -1089,3 +1089,299 @@ def test_pd_phase1_failures_trip_prefill_failover(reset_singletons):
             dead.close()
 
     asyncio.run(run())
+
+
+# -- shared KV cache hints (cache-server lookup feeding routing) ------------
+class _StubHints:
+    """SharedCacheHints stand-in: fixed cluster depth, call recording."""
+
+    def __init__(self, depth_tokens, block_size=16):
+        self._depth = depth_tokens
+        self.block_size = block_size
+        self.url = "stub:8100"
+        self.lookups = 0
+        self.routed = 0
+
+    def max_depth_tokens(self, tokens):
+        return (len(tokens) // self.block_size) * self.block_size
+
+    async def depth_tokens(self, tokens):
+        self.lookups += 1
+        return self._depth
+
+    async def probe_text(self, text):
+        self.lookups += 1
+        return self._depth
+
+    def note_routed(self):
+        self.routed += 1
+
+    async def close(self):
+        pass
+
+
+def test_shared_cache_hints_hashes_match_engine_chain():
+    """SharedCacheHints must fold tokens into the SAME chained block
+    hashes the engines' BlockManager computes — a divergence would make
+    every router lookup miss silently."""
+    from production_stack_tpu.engine.block_manager import hash_block
+    from production_stack_tpu.router.routing_logic import SharedCacheHints
+
+    hints = SharedCacheHints("127.0.0.1:1", block_size=4)
+    toks = list(range(11))  # 2 full blocks + ragged tail (dropped)
+    prev, want = 0, []
+    for i in range(2):
+        prev = hash_block(prev, tuple(toks[i * 4:(i + 1) * 4]))
+        want.append(prev)
+    assert hints.chain_hashes(toks) == want
+
+
+def test_shared_cache_hints_depth_is_tokens_and_degrades():
+    from production_stack_tpu.router.routing_logic import SharedCacheHints
+
+    hints = SharedCacheHints("127.0.0.1:1", block_size=4)
+
+    class _Ok:
+        async def lookup(self, hashes):
+            return 3  # blocks
+
+    class _Dead:
+        async def lookup(self, hashes):
+            raise OSError("connection refused")
+
+    loop = asyncio.new_event_loop()
+    hints.client = _Ok()
+    assert loop.run_until_complete(
+        hints.depth_tokens(list(range(16)))
+    ) == 12  # 3 blocks x 4 tokens
+    # a dead cache server degrades to depth 0, never an exception
+    hints.client = _Dead()
+    assert loop.run_until_complete(
+        hints.depth_tokens(list(range(16)))
+    ) == 0
+    # sub-block prompts cannot match anything: no round-trip at all
+    hints.client = _Dead()
+    assert loop.run_until_complete(hints.depth_tokens([1, 2])) == 0
+
+
+def test_kvaware_cluster_hit_routes_load_aware(monkeypatch):
+    """No engine holds the prefix locally but the shared cache does:
+    kvaware must pick load-aware across the fleet (any engine restores
+    the chain via RemoteTier) instead of the session fallback."""
+    from production_stack_tpu.router import routing_logic
+    from production_stack_tpu.router.routing_logic import KvawareRouter
+
+    router = KvawareRouter(kv_min_match_tokens=8)
+
+    class _Controller:
+        async def lookup(self, tokens):
+            return {}  # nobody holds it locally
+
+    router._client = _Controller()
+    router.cache_hints = _StubHints(depth_tokens=64)
+    monkeypatch.setattr(
+        routing_logic, "_health_scored_pick",
+        lambda eps: "http://picked-load-aware:8000",
+    )
+    eps = make_endpoints(3)
+    url = asyncio.new_event_loop().run_until_complete(
+        router.route_request(eps, {}, {}, make_request(
+            body={"prompt": "shared system prompt " * 16}
+        ))
+    )
+    assert url == "http://picked-load-aware:8000"
+    assert router.cache_hints.lookups == 1
+    assert router.cache_hints.routed == 1
+
+
+def test_kvaware_engine_hit_beats_shallower_cluster_hit(monkeypatch):
+    """An engine-local hit at least as deep as the cluster's must win:
+    local prefix reuse costs nothing, the cluster hit costs a restore
+    transfer."""
+    from production_stack_tpu.router import routing_logic
+    from production_stack_tpu.router.routing_logic import KvawareRouter
+
+    router = KvawareRouter(kv_min_match_tokens=8)
+
+    class _Controller:
+        async def lookup(self, tokens):
+            return {"e1:8000": 128}
+
+    router._client = _Controller()
+    router.cache_hints = _StubHints(depth_tokens=64)  # shallower
+    monkeypatch.setattr(
+        routing_logic, "_health_scored_pick",
+        lambda eps: (_ for _ in ()).throw(
+            AssertionError("must not fall through to load-aware")
+        ),
+    )
+    eps = make_endpoints(3)
+    url = asyncio.new_event_loop().run_until_complete(
+        router.route_request(eps, {}, {}, make_request(
+            body={"prompt": "shared system prompt " * 16}
+        ))
+    )
+    assert url == "http://e1:8000"
+
+
+def test_kvaware_deeper_cluster_hit_overrides_shallow_local(monkeypatch):
+    """A cluster hit DEEPER than the best engine-local one wins: the
+    restore serves more prefix than the local cache would."""
+    from production_stack_tpu.router import routing_logic
+    from production_stack_tpu.router.routing_logic import KvawareRouter
+
+    router = KvawareRouter(kv_min_match_tokens=8)
+
+    class _Controller:
+        async def lookup(self, tokens):
+            return {"e1:8000": 16}  # shallow local match
+
+    router._client = _Controller()
+    router.cache_hints = _StubHints(depth_tokens=512)
+    monkeypatch.setattr(
+        routing_logic, "_health_scored_pick",
+        lambda eps: "http://picked-load-aware:8000",
+    )
+    eps = make_endpoints(3)
+    url = asyncio.new_event_loop().run_until_complete(
+        router.route_request(eps, {}, {}, make_request(
+            body={"prompt": "shared system prompt " * 16}
+        ))
+    )
+    assert url == "http://picked-load-aware:8000"
+
+
+def test_prefixaware_trie_cold_cluster_hit_routes_load_aware(monkeypatch):
+    """A trie-cold prompt (restart / sibling router served the session)
+    with a cluster cache hit picks load-aware; once the trie warms, the
+    normal prefix-affine path takes over and the cache is not asked."""
+    from production_stack_tpu.router import routing_logic
+
+    router = PrefixAwareRouter()
+    router.cache_hints = _StubHints(depth_tokens=64)
+    monkeypatch.setattr(
+        routing_logic, "_health_scored_pick",
+        lambda eps: "http://e2:8000",
+    )
+    eps = make_endpoints(3)
+    req = make_request(body={"prompt": "tenant shared preamble " * 32})
+    loop = asyncio.new_event_loop()
+    url = loop.run_until_complete(
+        router.route_request(eps, {}, {}, req)
+    )
+    assert url == "http://e2:8000"
+    assert router.cache_hints.lookups == 1
+    assert router.cache_hints.routed == 1
+    # second identical request: trie hit -> prefix-affine, no probe
+    url2 = loop.run_until_complete(
+        router.route_request(eps, {}, {}, req)
+    )
+    assert url2 == "http://e2:8000"
+    assert router.cache_hints.lookups == 1  # unchanged
+
+
+def test_prefixaware_trie_cold_cluster_cold_falls_back_to_qps():
+    router = PrefixAwareRouter()
+    router.cache_hints = _StubHints(depth_tokens=0)
+    eps = make_endpoints(3)
+    loop = asyncio.new_event_loop()
+    url = loop.run_until_complete(
+        router.route_request(eps, {}, {}, make_request(
+            body={"prompt": "never seen anywhere " * 16}
+        ))
+    )
+    assert url in {e.url for e in eps}
+    assert router.cache_hints.lookups == 1
+    assert router.cache_hints.routed == 0
+
+
+def test_async_cache_client_lookup_against_real_server():
+    """AsyncCacheClient (the router side) against a REAL KVCacheServer
+    over real sockets: depth reflects the server's chain index, and the
+    client survives the server restarting between calls."""
+    import numpy as np
+
+    from production_stack_tpu.kv.cache_server import KVCacheServer
+    from production_stack_tpu.kv.remote import AsyncCacheClient
+
+    async def run():
+        srv = KVCacheServer(capacity_bytes=1 << 20)
+        await srv.start("127.0.0.1", 0)
+        port = srv.port
+        blkarr = np.ones((2, 2, 16), np.float32)
+        for h in (501, 502):
+            srv.put(h, blkarr)
+        client = AsyncCacheClient(f"127.0.0.1:{port}")
+        try:
+            assert await client.lookup([501, 502, 503]) == 2
+            stats = await client.stats()
+            assert stats["blocks"] == 2
+        finally:
+            await client.close()
+            await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_shared_cache_hints_circuit_breaker_skips_dead_server():
+    """One failed lookup trips a cooldown: later probes short-circuit
+    to depth 0 WITHOUT touching the client — routing must not
+    serialize behind a dead cache server's connect timeouts."""
+    from production_stack_tpu.router.routing_logic import SharedCacheHints
+
+    hints = SharedCacheHints("127.0.0.1:1", block_size=4)
+    calls = {"n": 0}
+
+    class _Dead:
+        async def lookup(self, hashes):
+            calls["n"] += 1
+            raise OSError("connection refused")
+
+    hints.client = _Dead()
+    loop = asyncio.new_event_loop()
+    toks = list(range(16))
+    assert loop.run_until_complete(hints.depth_tokens(toks)) == 0
+    assert calls["n"] == 1
+    # inside the cooldown: no client call at all
+    assert loop.run_until_complete(hints.depth_tokens(toks)) == 0
+    assert calls["n"] == 1
+    # cooldown elapsed: ONE request retries (and a success resets)
+    hints._down_until = 0.0
+
+    class _Back:
+        async def lookup(self, hashes):
+            calls["n"] += 1
+            return 2
+
+    hints.client = _Back()
+    assert loop.run_until_complete(hints.depth_tokens(toks)) == 8
+    assert hints._down_until == 0.0
+
+
+def test_kvaware_skips_probe_when_local_match_covers_chain(monkeypatch):
+    """An engine-local match already covering every full block of the
+    prompt routes straight to its holder — the cluster probe would cost
+    a round-trip and could not answer deeper."""
+    from production_stack_tpu.router.routing_logic import KvawareRouter
+
+    router = KvawareRouter(kv_min_match_tokens=1)
+    text = "shared system prompt " * 16
+    toklen = None
+
+    class _Controller:
+        async def lookup(self, tokens):
+            nonlocal toklen
+            toklen = len(tokens)
+            return {"e1:8000": len(tokens)}  # full coverage
+
+    router._client = _Controller()
+    hints = _StubHints(depth_tokens=10_000)
+    router.cache_hints = hints
+    eps = make_endpoints(3)
+    url = asyncio.new_event_loop().run_until_complete(
+        router.route_request(eps, {}, {}, make_request(
+            body={"prompt": text}
+        ))
+    )
+    assert url == "http://e1:8000"
+    assert hints.lookups == 0  # probe skipped entirely

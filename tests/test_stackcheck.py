@@ -511,15 +511,18 @@ def test_repo_self_scan_is_clean_cli():
 
 
 def test_kv_tiering_stays_off_hot_paths():
-    """Zero-stall KV tiering (PR 4) + disaggregated PD transfer (PR 8):
-    the deferred-export staging (LLMEngine._flush_kv_exports,
-    ModelRunner.stage_export_blocks), the staged-restore staging/landing
-    (_advance_kv_restore, stage_import_blocks, import_staged_blocks),
-    the PD pull/serve paths (offload.request_chain_reads,
-    transfer.KVTransferServer._snapshot_chain), and everything else in
-    engine/ + kv/ must keep device syncs and event-loop stalls off the
-    marked hot paths — the blocking d2h / tier IO / peer sockets belong
-    to the offload worker thread (or the executor, producer side)."""
+    """Zero-stall KV tiering (PR 4) + disaggregated PD transfer (PR 8)
+    + shared-cache RemoteTier (PR 10): the deferred-export staging
+    (LLMEngine._flush_kv_exports, ModelRunner.stage_export_blocks), the
+    staged-restore staging/landing (_advance_kv_restore,
+    stage_import_blocks, import_staged_blocks), the chain pull/serve
+    paths (offload.request_chain_reads,
+    transfer.KVTransferServer._snapshot_chain), the remote tier's
+    scheduler-thread probes (remote.RemoteTier.contains — memo only,
+    the socket lives on the worker), and everything else in engine/ +
+    kv/ must keep device syncs and event-loop stalls off the marked hot
+    paths — the blocking d2h / tier IO / peer+cache sockets belong to
+    the offload worker thread (or the executor, producer side)."""
     report = analyze_paths(
         [
             str(PACKAGE / "engine"),
@@ -531,15 +534,15 @@ def test_kv_tiering_stays_off_hot_paths():
     assert report.unsuppressed == [], "\n".join(
         f.format() for f in report.unsuppressed
     )
-    # the transfer/cache-server/peer modules must actually be INSIDE
-    # the sweep — a rename or move dropping them out would pass the
-    # zero-findings assertion silently
+    # the transfer/cache-server/peer/remote modules must actually be
+    # INSIDE the sweep — a rename or move dropping them out would pass
+    # the zero-findings assertion silently
     kv_report = analyze_paths(
         [str(PACKAGE / "kv")],
         select=["device-sync-hot", "blocking-async"],
     )
-    assert kv_report.files_scanned >= 7  # __init__, wire, controller,
-    # offload, cache_server, transfer, peer
+    assert kv_report.files_scanned >= 8  # __init__, wire, controller,
+    # offload, cache_server, transfer, peer, remote
 
 
 def test_kv_tiering_hot_marks_present():
@@ -557,7 +560,11 @@ def test_kv_tiering_hot_marks_present():
             "import_staged_blocks",
         },
         ("kv", "transfer.py"): {"_snapshot_chain"},
-        ("kv", "offload.py"): {"request_chain_reads"},
+        ("kv", "offload.py"): {"request_chain_reads", "contains_local"},
+        # the shared-cache tier's scheduler-thread probe must stay a
+        # memo lookup (the socket client runs only on the offload
+        # worker: put/flush/get_chain)
+        ("kv", "remote.py"): {"contains"},
     }
     for (sub, fname), funcs in want.items():
         path = PACKAGE / sub / fname

@@ -206,6 +206,56 @@ def test_max_sized_header_fields_not_rejected_early():
     asyncio.run(run())
 
 
+def test_batched_block_frame_roundtrips_bit_exact():
+    """The cache server's batched frames (put_batch/get_chain/
+    get_batch) stack blocks on the wire block axis inside ONE payload;
+    the stack/serialize/deserialize/slice round-trip must be bit-exact
+    per block — a mis-sliced batch would serve one prompt's KV under
+    another prompt's hash."""
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import (
+        deserialize_block,
+        serialize_block,
+    )
+
+    blocks = [
+        np.arange(2 * 3 * 4 * 5, dtype=np.float32).reshape(2, 3, 4, 5)
+        + i * 1000
+        for i in range(4)
+    ]
+    batched = np.stack(blocks, axis=2)  # (2, 3, n, 4, 5)
+    got = deserialize_block(serialize_block(batched))
+    assert int(got.shape[2]) == 4
+    for i, want in enumerate(blocks):
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(got[:, :, i]), want
+        )
+
+
+def test_truncated_batched_frame_raises_not_partial():
+    """A batched payload cut mid-transfer must surface as WireError on
+    the sync side (the client degrades to a counted fallback) — never a
+    short read that deserializes a PARTIAL batch as a smaller one."""
+    import numpy as np
+
+    from production_stack_tpu.kv.offload import serialize_block
+
+    batched = np.ones((2, 2, 8, 64), np.float32)
+    frame = wire.encode_msg(
+        {"type": "put_batch", "hashes": list(range(8))},
+        serialize_block(batched),
+    )
+    a, b = _sync_pair()
+    try:
+        a.sendall(frame[: len(frame) // 2])
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.sync_recv(b)
+    finally:
+        b.close()
+
+
 def test_bf16_block_payload_roundtrips():
     """bf16 KV payloads (the production cache dtype) must round-trip
     the wire/disk serialization as bfloat16 — np.save alone degrades
