@@ -104,6 +104,15 @@ ELASTIC = os.environ.get("PST_BENCH_ELASTIC", "1") == "1"
 # as the attribution control. Slots: BENCH_SWEEP_ragged.json (on) vs
 # the matching @noragged control
 RAGGED = os.environ.get("PST_BENCH_RAGGED", "1") == "1"
+# single-kernel ragged paged attention (engine ragged_kernel): ONE
+# batched-grid Pallas kernel serves any lane mix (decode rows +
+# prefill q-tiles share the grid), and program variants key on padded
+# row-count buckets instead of the (group, chunk) lane-mix grid.
+# Default ON (the engine default, effective only under
+# attention_impl=pallas i.e. on a real chip); @norpakernel pins the
+# composed per-lane kernels as the attribution control. Slots:
+# BENCH_SWEEP_rpa.json (on) vs the matching @norpakernel control
+RAGGED_KERNEL = os.environ.get("PST_BENCH_RAGGED_KERNEL", "1") == "1"
 # KV tiering workload (@kvoff): cap the HBM pool so the multi-round
 # working set churns through the cpu/disk offload tiers — the zero-stall
 # async export/staged-restore measurement. PST_BENCH_KV_BLOCKS overrides
@@ -250,6 +259,10 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_RAGGED"] = "1"
             elif m == "noragged":
                 overrides["PST_BENCH_RAGGED"] = "0"
+            elif m == "rpa":
+                overrides["PST_BENCH_RAGGED_KERNEL"] = "1"
+            elif m == "norpakernel":
+                overrides["PST_BENCH_RAGGED_KERNEL"] = "0"
             elif m == "remotekv":  # before the r<N> rounds prefix rule
                 overrides["PST_BENCH_KV_REMOTE"] = "1"
             elif m == "noremotekv":
@@ -285,7 +298,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
                     "| trace | elastic | noelastic | ragged | noragged "
-                    "| kvoff | synckv | remotekv | noremotekv | pd | nopd"
+                    "| rpa | norpakernel | kvoff | synckv | remotekv "
+                    "| noremotekv | pd | nopd"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -313,8 +327,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 f"bad sweep config label {label!r}: want "
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
                 "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
-                "|@noelastic|@ragged|@noragged|@kvoff|@synckv"
-                "|@remotekv|@noremotekv|@pd|@nopd]"
+                "|@noelastic|@ragged|@noragged|@rpa|@norpakernel"
+                "|@kvoff|@synckv|@remotekv|@noremotekv|@pd|@nopd]"
             )
         configs.append((
             label,
@@ -736,6 +750,9 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
         # unified ragged dispatch A/B: @noragged pins the split
         # alternating prefill/decode rounds for attribution
         ragged_dispatch=RAGGED,
+        # single-kernel ragged attention A/B: @norpakernel pins the
+        # composed per-lane kernels for attribution (pallas impl only)
+        ragged_kernel=RAGGED_KERNEL,
         async_decode=async_decode,
         prefetch_decode=PREFETCH,
         prefill_pipeline=PREFILL_PIPELINE,
@@ -1144,6 +1161,17 @@ def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                 ),
                 "staged_hits": engine._ragged_staged_hits_total,
                 "staged_misses": engine._ragged_staged_misses_total,
+            },
+            # compile-count attribution (@rpa/@norpakernel): program-
+            # variant builds per builder kind — the cold-start compile
+            # tax the single-kernel row-bucket variants shrink. Reads
+            # the same counters as tpu:compile_events_total.
+            "compiles": {
+                "ragged_kernel": RAGGED_KERNEL,
+                "total": engine.runner.compile_events_total,
+                "by_kind": dict(sorted(
+                    engine.runner.compile_events.items()
+                )),
             },
             # zero-stall KV tiering attribution (@kvoff): export time is
             # offload-worker wall (overlapped), restore time is

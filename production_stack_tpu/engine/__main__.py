@@ -114,6 +114,18 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false",
                    help="split alternating prefill/decode rounds (the "
                         "pre-ragged path; bench attribution control)")
+    p.add_argument("--ragged-kernel", action="store_true",
+                   default=True,
+                   help="single-kernel ragged paged attention: ONE "
+                        "batched-grid Pallas kernel serves any lane "
+                        "mix (decode rows + prefill q-tiles share the "
+                        "grid), shrinking the precompile variant "
+                        "space to row-count buckets (pallas impl only)")
+    p.add_argument("--no-ragged-kernel", dest="ragged_kernel",
+                   action="store_false",
+                   help="compose per-lane prefill/decode kernels (the "
+                        "pre-unified kernels; bench attribution "
+                        "control)")
     p.add_argument("--precompile-serving", action="store_true",
                    default=False,
                    help="compile every steady-state prefill/decode "
@@ -242,6 +254,7 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         prefetch_decode=args.prefetch_decode,
         prefill_pipeline=args.prefill_pipeline,
         ragged_dispatch=args.ragged_dispatch,
+        ragged_kernel=args.ragged_kernel,
         num_speculative_tokens=args.num_speculative_tokens,
         ngram_prompt_lookup_max=args.ngram_prompt_lookup_max,
         ngram_prompt_lookup_min=args.ngram_prompt_lookup_min,

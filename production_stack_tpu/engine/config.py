@@ -126,6 +126,23 @@ class EngineConfig:
     # as the bench attribution control; multihost engines, async-
     # chained decode, and meshed (tp/pp) engines always split.
     ragged_dispatch: bool = True
+    # single-kernel ragged paged attention (the device half of the
+    # Ragged Paged Attention design): route every Pallas attention
+    # call — decode rounds, packed prefill groups, and the mixed
+    # lane-typed rounds above — through ONE batched-grid kernel
+    # (ops/pallas_attention.ragged_paged_attention) whose grid
+    # iterates a flattened query-row space with per-lane metadata in
+    # scalar-prefetch SMEM: decode lanes contribute one row, prefill
+    # lanes their chunk's q-tiles, so ANY lane mix is one kernel
+    # launch with no cross-lane padding, and the packed-prefill /
+    # ragged-round program variants key on padded ROW-count buckets
+    # instead of the (group, chunk) lane-mix grid (fewer compiles =
+    # smaller cold-start tax). Tokens + logical KV are bit-identical
+    # to the composed per-lane kernels (tests/test_pallas_attention
+    # .py, tests/test_ragged_dispatch.py). Only effective with
+    # attention_impl=pallas; False (--no-ragged-kernel) keeps the
+    # composed per-lane kernels as the bench attribution control.
+    ragged_kernel: bool = True
     # compile every steady-state serving program shape at startup
     # (full-chunk + resume-tail prefill, packed groups, fused-K decode,
     # per ctx bucket) so no XLA compile lands inside a live request's

@@ -3372,6 +3372,8 @@ class LLMEngine:
                 self._ragged_prefill_lanes_total
             ),
             ragged_decode_lanes_total=self._ragged_decode_lanes_total,
+            compile_events_total=self.runner.compile_events_total,
+            compile_events=dict(self.runner.compile_events),
             kv_export_seconds_total=self._kv_export_seconds_total,
             kv_export_blocks_total=self._kv_export_blocks_total,
             kv_export_bytes_total=self._kv_export_bytes_total,
@@ -3502,6 +3504,22 @@ class LLMEngine:
                 while s <= cfg.max_prefill_seqs:
                     groups.append((s, t, c))
                     s *= 2
+        if rnr.ragged_kernel and rnr.prefill_pipeline:
+            # single-kernel mode: the packed-prefill program keys on
+            # the padded ROW bucket (r_pad, pc_pad), so (group, chunk)
+            # pairs with equal row counts share one variant — warm
+            # each row bucket once instead of the full lane-mix grid
+            # (chunk buckets are pow2 >= RAGGED_TQ, so s * t IS the
+            # packed row count)
+            seen_rows: set[tuple[int, int]] = set()
+            deduped: list[tuple[int, int, int]] = []
+            for s, t, c in groups:
+                rkey = (rnr._rows_bucket(s * t), c)
+                if rkey in seen_rows:
+                    continue
+                seen_rows.add(rkey)
+                deduped.append((s, t, c))
+            groups = deduped
         n = rnr.precompile_prefill(singles, groups)
         # decode: pick context lens that land IN each bucket after the
         # +K-1 lookahead shift (passing the bucket boundary itself would

@@ -285,6 +285,13 @@ class EngineMetrics:
             "near-budget guided lanes)",
             label, registry=reg,
         )
+        self.compile_events = Counter(
+            "tpu:compile_events_total",
+            "Program-variant builds (jit cache misses on the model "
+            "runner's step builders) — the cold-start compile tax, "
+            "labeled by builder kind (decode_multi, ragged_rows, ...)",
+            ["model_name", "kind"], registry=reg,
+        )
         self.request_success = Counter(
             "vllm:request_success", "Finished requests",
             ["model_name", "finished_reason"], registry=reg,
@@ -385,6 +392,9 @@ class EngineMetrics:
         self.ragged_split_rounds.labels(m).inc(max(
             0, s.ragged_split_rounds_total
             - prev.ragged_split_rounds_total))
+        for kind, n in (s.compile_events or {}).items():
+            pn = (prev.compile_events or {}).get(kind, 0)
+            self.compile_events.labels(m, kind).inc(max(0, n - pn))
         self.kv_export_blocks.labels(m).inc(max(
             0, s.kv_export_blocks_total - prev.kv_export_blocks_total))
         self.kv_restore_blocks.labels(m).inc(max(

@@ -54,6 +54,16 @@ RAGGED_LANE_IDLE = 0
 RAGGED_LANE_PREFILL = 1
 RAGGED_LANE_DECODE = 2
 
+# Row-block height of the unified ragged kernel's flattened query-row
+# space (ops/pallas_attention.RAGGED_TQ). Prefill lanes pack their
+# chunk rows RAGGED_TQ-aligned; decode lanes contribute one row each
+# and share blocks.
+RAGGED_TQ = 8
+
+
+def _ceil_tq(n: int) -> int:
+    return -(-n // RAGGED_TQ) * RAGGED_TQ
+
 
 class ModelRunner:
     def __init__(
@@ -197,10 +207,12 @@ class ModelRunner:
         # pallas kernels too: the page walk starts at the window's first
         # page and masks within the boundary page (the smoke test below
         # compiles the windowed variant on hardware before committing)
+        ragged_smoke_ok = True
         if impl == "pallas" and jax.default_backend() == "tpu":
-            # compile-check the kernel on tiny shapes before committing:
-            # if this TPU generation/toolchain rejects it, serve on the
-            # XLA path instead of failing at the first request
+            # compile-check the kernels on tiny shapes before
+            # committing: if this TPU generation/toolchain rejects the
+            # composed kernels, serve on the XLA path instead of
+            # failing at the first request
             try:
                 self._pallas_smoke_test(mc)
             except Exception as e:  # noqa: BLE001
@@ -209,8 +221,37 @@ class ModelRunner:
                     "falling back to the XLA gather path", e,
                 )
                 impl = "xla"
+            if impl == "pallas" and config.ragged_kernel:
+                # the unified kernel degrades INDEPENDENTLY: a chip
+                # that compiles the composed kernels but rejects the
+                # CSR ragged grid keeps serving on pallas with
+                # per-lane composition, not the slow XLA path
+                try:
+                    self._ragged_smoke_test(mc)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "ragged paged-attention kernel failed its "
+                        "smoke test (%s); composing the per-lane "
+                        "kernels instead", e,
+                    )
+                    ragged_smoke_ok = False
         self.attention_impl = impl
-        logger.info("attention impl: %s", impl)
+        # single-kernel ragged paged attention: route EVERY pallas
+        # attention call — decode rounds, packed prefill groups, mixed
+        # lane-typed rounds — through the one batched-grid
+        # ragged_paged_attention kernel (ops/pallas_attention.py), so
+        # any lane mix is one launch and the packed-prefill/ragged
+        # program variants key on padded ROW-count buckets instead of
+        # the (s_pad, t_pad) lane-mix grid. --no-ragged-kernel keeps
+        # the composed per-lane kernels as the A/B control.
+        self.ragged_kernel = (
+            bool(config.ragged_kernel) and impl == "pallas"
+            and ragged_smoke_ok
+        )
+        logger.info(
+            "attention impl: %s%s", impl,
+            " (ragged kernel)" if self.ragged_kernel else "",
+        )
 
         # multi-LoRA: stacked adapter buffers applied inside the jitted
         # steps (engine/lora.py); None when --enable-lora is off so the
@@ -263,7 +304,20 @@ class ModelRunner:
         # import), keyed by (n_src_pad, n_dst_pad) pow2 buckets
         self._import_fns: dict[tuple[int, int], object] = {}
 
+        # compile-count observability: every program-variant build (a
+        # jit-cache miss on one of the builders above) is counted per
+        # kind — the chip-window cold-start tax and the ragged-kernel
+        # variant-space shrink become measurable (tpu:compile_events_
+        # total, bench `compiles` slot) instead of inferred from logs
+        self.compile_events: dict[str, int] = {}
+        self.compile_events_total = 0
+
         self.max_ctx_bucket = self._ctx_bucket(self.max_model_len)
+
+    def _note_compile(self, kind: str) -> None:
+        """Count one program-variant build (jit cache miss)."""
+        self.compile_events[kind] = self.compile_events.get(kind, 0) + 1
+        self.compile_events_total += 1
 
     # -- sizing -----------------------------------------------------------
     def _resolve_num_blocks(self) -> int:
@@ -306,13 +360,12 @@ class ModelRunner:
         return int(min(num, max(cap, 2)))
 
     def _pallas_smoke_test(self, mc: ModelConfig) -> None:
-        from production_stack_tpu.ops import pallas_attention
-
         bs = self.block_size
         d, nkv = mc.head_dim, mc.num_kv_heads
-        # probe the exact kernel variant serving will compile — the
-        # windowed page walk included (traced loop start + guarded DMA)
-        window = mc.sliding_window
+        # probe the exact kernel variants serving will compile — the
+        # windowed page walk included (traced loop start + guarded
+        # DMA); `_attn` routes through the shard_map TP wrappers under
+        # a mesh, exactly as the step builders do
         kc = jnp.zeros((1, nkv, 4 * bs, d), self.cache_dtype)
         q = jnp.zeros((1, mc.num_heads, d), self.dtype)
         tables = jnp.zeros((1, 2), jnp.int32)
@@ -324,26 +377,34 @@ class ModelRunner:
             kc = jax.device_put(
                 kc, sharding_rules.cache_sharding(self.mesh)
             )
-            out = pallas_attention.paged_decode_attention_tp(
-                q, kc, kc, jnp.int32(0), tables, lens,
-                mesh=self.mesh, block_size=bs, scale=self._scale,
-                window=window,
-            )
-            out2 = pallas_attention.paged_prefill_attention_tp(
-                qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
-                mesh=self.mesh, block_size=bs, scale=self._scale,
-                window=window,
-            )
-        else:
-            out = pallas_attention.paged_decode_attention(
-                q, kc, kc, jnp.int32(0), tables, lens,
-                block_size=bs, scale=self._scale, window=window,
-            )
-            out2 = pallas_attention.paged_prefill_attention(
-                qp, kc, kc, jnp.int32(0), table1, jnp.int32(0),
-                block_size=bs, scale=self._scale, window=window,
-            )
+        out = self._attn("decode", q, jnp.int32(0), kc, kc, tables,
+                         lens)
+        out2 = self._attn("prefill", qp, jnp.int32(0), kc, kc, table1,
+                          jnp.int32(0))
         jax.block_until_ready((out, out2))
+
+    def _ragged_smoke_test(self, mc: ModelConfig) -> None:
+        """Probe the unified ragged kernel in the grid shape serving
+        dispatches — one prefill q-tile beside one decode row — so a
+        toolchain that rejects the CSR scalar-prefetch grid degrades
+        to the composed kernels, not the XLA path."""
+        bs = self.block_size
+        d, nkv = mc.head_dim, mc.num_kv_heads
+        kc = jnp.zeros((1, nkv, 4 * bs, d), self.cache_dtype)
+        if self.mesh is not None:
+            kc = jax.device_put(
+                kc, sharding_rules.cache_sharding(self.mesh)
+            )
+        blk_seg = jnp.asarray([0, 1, 2], jnp.int32)
+        seg_meta = jnp.asarray(
+            [[0, 0, RAGGED_TQ, 0], [1, 0, 1, 0]], jnp.int32
+        )
+        qr = jnp.zeros((2 * RAGGED_TQ, mc.num_heads, d), self.dtype)
+        out = self._attn(
+            "ragged", qr, jnp.int32(0), kc, kc,
+            jnp.zeros((2, 2), jnp.int32), blk_seg, seg_meta,
+        )
+        jax.block_until_ready(out)
 
     def _step_jit_kwargs(self, n_host_outs: int = 1) -> dict:
         """Extra jit options for the prefill/decode step builders.
@@ -390,6 +451,44 @@ class ModelRunner:
                 with_layout_constraint(vc, fmt))
 
     # -- jitted step builders ---------------------------------------------
+    # stackcheck: hot-path — the ONE dispatch seam every pallas
+    # attention call goes through (trace-time only: closed over by the
+    # jitted step builders); collapses the former per-site
+    # `mesh is not None -> *_tp else *` call ladders
+    def _attn(self, kind: str, q, layer, kc, vc, *args):
+        """Route one attention call to the pallas kernel for `kind`
+        ("prefill" | "decode" | "ragged"), picking the shard_map TP
+        variant under a mesh and filling the static block-size/scale/
+        interpret/window arguments from the runner's config. All
+        kernel call sites dispatch through here, so a new kernel (the
+        unified ragged one) lands at one seam instead of eight."""
+        from production_stack_tpu.ops import pallas_attention
+
+        fns = {
+            "prefill": (
+                pallas_attention.paged_prefill_attention,
+                pallas_attention.paged_prefill_attention_tp,
+            ),
+            "decode": (
+                pallas_attention.paged_decode_attention,
+                pallas_attention.paged_decode_attention_tp,
+            ),
+            "ragged": (
+                pallas_attention.ragged_paged_attention,
+                pallas_attention.ragged_paged_attention_tp,
+            ),
+        }[kind]
+        pallas_attention._note_trace(kind)  # launch accounting
+        kw = dict(
+            block_size=self.block_size,
+            scale=self._scale,
+            interpret=jax.default_backend() != "tpu",
+            window=self.model_config.sliding_window,
+        )
+        if self.mesh is not None:
+            return fns[1](q, kc, vc, layer, *args, mesh=self.mesh, **kw)
+        return fns[0](q, kc, vc, layer, *args, **kw)
+
     def _prefill_attn_closure(self):
         """The per-layer attention callback shared by the prefill and
         verify step builders (pallas paged kernel or XLA gather path).
@@ -402,24 +501,11 @@ class ModelRunner:
         XLA path."""
         scale = self._scale
         if self.attention_impl == "pallas":
-            from production_stack_tpu.ops import pallas_attention
-
-            bs = self.block_size
-            interpret = jax.default_backend() != "tpu"
-            mesh = self.mesh
-            window = self.model_config.sliding_window
 
             def attn(q, l, kc, vc, gather_slots, q_positions, total_len):
-                if mesh is not None:
-                    return pallas_attention.paged_prefill_attention_tp(
-                        q, kc, vc, l, gather_slots, q_positions[0],
-                        mesh=mesh, block_size=bs, scale=scale,
-                        interpret=interpret, window=window,
-                    )
-                return pallas_attention.paged_prefill_attention(
-                    q, kc, vc, l, gather_slots, q_positions[0],
-                    block_size=bs, scale=scale, interpret=interpret,
-                    window=window,
+                return self._attn(
+                    "prefill", q, l, kc, vc, gather_slots,
+                    q_positions[0],
                 )
         else:
 
@@ -543,6 +629,269 @@ class ModelRunner:
             ("keys", (s_pad, 2)),
         ]
         return self._layout_of(fields)
+
+    # -- ragged-rows prefill pack (single-kernel mode) ---------------------
+    # Under the unified ragged kernel the packed-prefill token axis is
+    # RAGGED: each lane's chunk rows pack back-to-back (RAGGED_TQ-
+    # aligned) with lane offsets riding per-lane metadata instead of a
+    # per-lane t_pad shape — so the program variant keys on the padded
+    # ROW bucket (r_pad, pc_pad), not the (s_pad, t_pad) lane-mix
+    # pair, and the precompile grid collapses accordingly.
+    def _rows_lane_cap(self) -> int:
+        """Static prefill-lane capacity of the ragged-rows programs
+        (config-derived, NOT part of the program key)."""
+        return next_pow2(max(self.config.max_prefill_seqs, 1))
+
+    def _rows_bucket(self, n_rows: int) -> int:
+        return next_pow2(max(n_rows, RAGGED_TQ))
+
+    def _rows_dims(
+        self, chunks: list[list[int]], total_lens: list[int]
+    ) -> tuple[int, int]:
+        """(r_pad, pc_pad) row/context buckets for a ragged-rows
+        prefill group."""
+        r_pad = self._rows_bucket(
+            sum(_ceil_tq(len(c)) for c in chunks)
+        )
+        pc_pad = max(self._ctx_bucket(tl) for tl in total_lens)
+        return r_pad, pc_pad
+
+    def _rows_prefill_pack_layout(self, r_pad: int, pc_pad: int):
+        """Ragged-rows variant of _packed_prefill_pack_layout: flat
+        row-axis fields + per-lane metadata at the static lane cap."""
+        s_cap = self._rows_lane_cap()
+        fields = [
+            ("tokens", (r_pad,)),
+            ("positions", (r_pad,)),
+            ("write_slots", (r_pad,)),
+            ("tables", (s_cap, pc_pad // self.block_size)),
+            ("lane_row0", (s_cap,)),
+            ("lane_rows", (s_cap,)),
+            ("q_starts", (s_cap,)),
+            ("last_rows", (s_cap,)),
+            ("temps", (s_cap,)),
+            ("top_ps", (s_cap,)),
+            ("top_ks", (s_cap,)),
+            ("min_ps", (s_cap,)),
+            ("keys", (s_cap, 2)),
+        ]
+        return self._layout_of(fields)
+
+    # stackcheck: hot-path — host build of the ragged-rows prefill
+    # pack (dispatch + staging prefetch); one pass over the lanes, no
+    # device fetch
+    def _fill_rows_prefill_pack(
+        self,
+        chunks: list[list[int]],
+        start_positions: list[int],
+        block_tables: list[list[int]],
+        total_lens: list[int],
+        sampling=None,
+    ) -> tuple[int, int, np.ndarray]:
+        """Host-side build of the ragged-rows prefill pack; returns
+        (r_pad, pc_pad, packed). Lane i's chunk occupies rows
+        [lane_row0[i], lane_row0[i] + len(chunk)) of the flat axis;
+        the RAGGED_TQ-alignment tail rows and the bucket tail carry
+        position -1 -> rope 0, write the trash slot, and are never
+        stored by the kernel's causal rows (same padded-row contract
+        as the composed pack)."""
+        n = len(chunks)
+        s_cap = self._rows_lane_cap()
+        r_pad, pc_pad = self._rows_dims(chunks, total_lens)
+        n_pages = pc_pad // self.block_size
+        tokens = np.zeros((r_pad,), np.int32)
+        positions = np.full((r_pad,), -1, np.int32)
+        write_slots = np.zeros((r_pad,), np.int32)
+        tables = np.zeros((s_cap, n_pages), np.int32)
+        lane_row0 = np.zeros((s_cap,), np.int32)
+        lane_rows = np.zeros((s_cap,), np.int32)
+        q_starts = np.zeros((s_cap,), np.int32)
+        last_rows = np.zeros((s_cap,), np.int32)
+        row = 0
+        for i, (ids, start) in enumerate(zip(chunks, start_positions)):
+            t = len(ids)
+            tokens[row: row + t] = ids
+            pos = np.arange(start, start + t, dtype=np.int32)
+            positions[row: row + t] = pos
+            write_slots[row: row + t] = self._slots_for_positions(
+                block_tables[i], pos
+            )
+            tables[i] = self._padded_block_table(
+                block_tables[i], n_pages
+            )
+            lane_row0[i] = row
+            lane_rows[i] = _ceil_tq(t)
+            q_starts[i] = start
+            last_rows[i] = row + t - 1
+            row += _ceil_tq(t)
+        # idle lanes: empty row ranges past the packed region (cover
+        # nothing in the in-trace block map), last row 0 (sampled slot
+        # pinned to the idle sentinel by the step)
+        lane_row0[n:] = row
+        positions_dev = np.where(positions < 0, 0, positions).astype(
+            np.int32
+        )
+        layout, size = self._rows_prefill_pack_layout(r_pad, pc_pad)
+        packed = np.zeros((size,), np.int32)
+        put = functools.partial(self._pack_put, packed, layout)
+        put("tokens", tokens)
+        put("positions", positions_dev)
+        put("write_slots", write_slots)
+        put("tables", tables)
+        put("lane_row0", lane_row0)
+        put("lane_rows", lane_rows)
+        put("q_starts", q_starts)
+        put("last_rows", last_rows)
+        temps, top_ps, top_ks, min_ps, keys = self._sampling_args(
+            s_cap, sampling
+        )
+        put("temps", temps)
+        put("top_ps", top_ps)
+        put("top_ks", top_ks)
+        put("min_ps", min_ps)
+        put("keys", keys)
+        return r_pad, pc_pad, packed
+
+    def _rows_pf_seg_meta(self, r_pad, lane_row0, lane_rows, q_starts):
+        """In-trace per-block segment metadata for the ragged-rows
+        prefill region: every RAGGED_TQ block belongs to at most one
+        lane (lanes pack TQ-aligned), so each block carries one
+        segment — [lane, 0, TQ, q_pos of the block's first row] — and
+        blocks outside every lane carry a zero-row segment the kernel
+        walks past for free."""
+        tq = RAGGED_TQ
+        n_blk = r_pad // tq
+        blk0 = jnp.arange(n_blk, dtype=jnp.int32) * tq
+        ends = lane_row0 + lane_rows
+        cover = (
+            (blk0[:, None] >= lane_row0[None, :])
+            & (blk0[:, None] < ends[None, :])
+        )
+        has = jnp.any(cover, axis=1)
+        lane_of = jnp.argmax(cover, axis=1).astype(jnp.int32)
+        rows = jnp.where(has, tq, 0).astype(jnp.int32)
+        qpos0 = jnp.where(
+            has, q_starts[lane_of] + (blk0 - lane_row0[lane_of]), 0
+        )
+        return jnp.stack(
+            [lane_of, jnp.zeros_like(blk0), rows, qpos0], axis=1
+        )
+
+    @staticmethod
+    def _rows_slot_vector(
+        chunks: list[list[int]], slots, r_pad: int
+    ) -> np.ndarray:
+        """Per-row LoRA slot vector over the ragged-rows flat axis —
+        the ONE copy of the lane->row expansion, kept in lockstep with
+        _fill_rows_prefill_pack's row packing (RAGGED_TQ-aligned lane
+        starts)."""
+        slots = slots if slots is not None else [0] * len(chunks)
+        per_row = np.zeros((r_pad,), np.int32)
+        row = 0
+        for ids, slot in zip(chunks, slots):
+            per_row[row: row + len(ids)] = slot
+            row += _ceil_tq(len(ids))
+        return per_row
+
+    def _rows_lora_kwargs(
+        self, lora_slots, chunks: list[list[int]], r_pad: int
+    ) -> dict:
+        """Ragged-rows mirror of _packed_lora_kwargs: uniform-adapter
+        fast path, else a per-row slot vector over the flat axis."""
+        if self.lora_manager is None:
+            return {}
+        slots = (
+            lora_slots if lora_slots is not None else [0] * len(chunks)
+        )
+        if len(set(slots)) <= 1:
+            slots_arg = jnp.int32(slots[0] if slots else 0)
+        else:
+            slots_arg = jnp.asarray(
+                self._rows_slot_vector(chunks, slots, r_pad)
+            )
+        return {
+            "lora": self.lora_manager.buffers,
+            "lora_slots": slots_arg,
+        }
+
+    def _make_prefill_rows_step(self, r_pad: int, pc_pad: int):
+        """Ragged-rows packed prefill step: chunks from up to
+        max_prefill_seqs sequences pack back-to-back on ONE flat row
+        axis and the whole group's chunk attention is ONE
+        ragged_paged_attention launch — the un-jitted core shared by
+        _build_prefill_rows (split prefill path) and the fused
+        lane-typed round builder (_build_ragged_rows)."""
+        mc = self.model_config
+        from production_stack_tpu.engine.sampler import sample_tokens
+
+        s_cap = self._rows_lane_cap()
+        layout, _size = self._rows_prefill_pack_layout(r_pad, pc_pad)
+
+        def _seg(packed, name, _lo=layout):
+            return self._pack_seg(packed, _lo, name)
+
+        def unpack(packed):
+            def f32(name):
+                return jax.lax.bitcast_convert_type(
+                    _seg(packed, name), jnp.float32
+                )
+
+            return {
+                "tokens": _seg(packed, "tokens"),
+                "positions": _seg(packed, "positions"),
+                "write_slots": _seg(packed, "write_slots"),
+                "tables": _seg(packed, "tables"),
+                "lane_row0": _seg(packed, "lane_row0"),
+                "lane_rows": _seg(packed, "lane_rows"),
+                "q_starts": _seg(packed, "q_starts"),
+                "last_rows": _seg(packed, "last_rows"),
+                "temps": f32("temps"),
+                "top_ps": f32("top_ps"),
+                "top_ks": _seg(packed, "top_ks"),
+                "min_ps": f32("min_ps"),
+                "keys": jax.lax.bitcast_convert_type(
+                    _seg(packed, "keys"), jnp.uint32
+                ),
+            }
+
+        def step(params, kc, vc, packed, lora=None, lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            pf = unpack(packed)
+            seg_meta = self._rows_pf_seg_meta(
+                r_pad, pf["lane_row0"], pf["lane_rows"], pf["q_starts"]
+            )
+            blk_seg = jnp.arange(
+                r_pad // RAGGED_TQ + 1, dtype=jnp.int32
+            )
+
+            def attn_fn(q, l, kcc, vcc):
+                return self._attn(
+                    "ragged", q, l, kcc, vcc, pf["tables"], blk_seg,
+                    seg_meta,
+                )
+
+            logits, kc, vc = self._forward(
+                mc, params, pf["tokens"], pf["positions"], kc, vc,
+                pf["write_slots"], attn_fn,
+                logits_rows=pf["last_rows"],
+                lora=lora, lora_slots=lora_slots,
+            )
+            sampled = sample_tokens(
+                logits, pf["temps"], pf["top_ps"], pf["top_ks"],
+                pf["keys"], min_p=pf["min_ps"],
+            )
+            return sampled, logits, kc, vc
+
+        step._unpack = unpack  # the fused-round builder reuses it
+        return step
+
+    def _build_prefill_rows(self, r_pad: int, pc_pad: int):
+        """Jitted ragged-rows packed prefill (kernel-mode variant of
+        _build_prefill_batch; program key (r_pad, pc_pad))."""
+        return jax.jit(
+            self._make_prefill_rows_step(r_pad, pc_pad),
+            donate_argnums=(1, 2), **self._step_jit_kwargs(2),
+        )
 
     @staticmethod
     def _pack_put(packed: np.ndarray, layout: dict, name: str,
@@ -672,13 +1021,21 @@ class ModelRunner:
     ) -> tuple:
         """Packed-group variant of stage_prefill."""
         t0 = time.perf_counter()
-        s_pad, t_pad, c_pad, packed = self._fill_packed_prefill_pack(
-            chunks, start_positions, block_tables, total_lens,
-            sampling=sampling,
-        )
+        if self.ragged_kernel and self.prefill_pipeline:
+            r_pad, pc_pad, packed = self._fill_rows_prefill_pack(
+                chunks, start_positions, block_tables, total_lens,
+                sampling=sampling,
+            )
+            key = ("rows", r_pad, pc_pad)
+        else:
+            s_pad, t_pad, c_pad, packed = self._fill_packed_prefill_pack(
+                chunks, start_positions, block_tables, total_lens,
+                sampling=sampling,
+            )
+            key = ("packed", s_pad, t_pad, c_pad)
         t1 = time.perf_counter()
         self._phase_add("prep", t1 - t0)
-        handle = (("packed", s_pad, t_pad, c_pad), jax.device_put(packed))
+        handle = (key, jax.device_put(packed))
         self._phase_add("h2d", time.perf_counter() - t1)
         return handle
 
@@ -871,6 +1228,7 @@ class ModelRunner:
                 "compiling batched verify step s=%d t=%d ctx=%d",
                 s_pad, t_pad, c_pad,
             )
+            self._note_compile("verify")
             self._verify_batch_fns[key] = self._build_verify_batch(
                 s_pad, t_pad, c_pad
             )
@@ -977,13 +1335,30 @@ class ModelRunner:
         mc = self.model_config
         scale = self._scale
 
-        if self.attention_impl == "pallas":
-            from production_stack_tpu.ops import pallas_attention
+        if self.attention_impl == "pallas" and self.ragged_kernel:
+            # ONE ragged-kernel launch over the whole packed token
+            # axis: every block of t_pad (pow2 >= RAGGED_TQ) belongs
+            # to exactly one lane, so per-block segment metadata is a
+            # static lane map + the traced q_starts — the s_pad
+            # unrolled per-lane kernel ladder collapses to one grid
+            tq = RAGGED_TQ
+            n_blk = (s_pad * t_pad) // tq
+            lane_of = np.arange(n_blk, dtype=np.int32) * tq // t_pad
+            off_in = (np.arange(n_blk, dtype=np.int32) * tq) % t_pad
 
-            bs = self.block_size
-            interpret = jax.default_backend() != "tpu"
-            mesh = self.mesh
-            window = self.model_config.sliding_window
+            def attn(q, l, kc, vc, tables, q_starts, positions2d,
+                     total_lens):
+                blk_seg = jnp.arange(n_blk + 1, dtype=jnp.int32)
+                seg_meta = jnp.stack([
+                    jnp.asarray(lane_of),
+                    jnp.zeros((n_blk,), jnp.int32),
+                    jnp.full((n_blk,), tq, jnp.int32),
+                    q_starts[lane_of] + jnp.asarray(off_in),
+                ], axis=1)
+                return self._attn(
+                    "ragged", q, l, kc, vc, tables, blk_seg, seg_meta
+                )
+        elif self.attention_impl == "pallas":
 
             # tables: (s_pad, P) per-sequence padded block tables;
             # q_starts: (s_pad,) absolute position of each chunk's row 0
@@ -992,19 +1367,10 @@ class ModelRunner:
                 qs = q.reshape(s_pad, t_pad, mc.num_heads, mc.head_dim)
                 outs = []
                 for s in range(s_pad):
-                    if mesh is not None:
-                        o = pallas_attention.paged_prefill_attention_tp(
-                            qs[s], kc, vc, l, tables[s], q_starts[s],
-                            mesh=mesh, block_size=bs, scale=scale,
-                            interpret=interpret, window=window,
-                        )
-                    else:
-                        o = pallas_attention.paged_prefill_attention(
-                            qs[s], kc, vc, l, tables[s], q_starts[s],
-                            block_size=bs, scale=scale,
-                            interpret=interpret, window=window,
-                        )
-                    outs.append(o)
+                    outs.append(self._attn(
+                        "prefill", qs[s], l, kc, vc, tables[s],
+                        q_starts[s],
+                    ))
                 return jnp.concatenate(outs, axis=0)
         else:
 
@@ -1131,38 +1497,54 @@ class ModelRunner:
             donate_argnums=(1, 2), **jit_kw,
         )
 
-    def _build_decode(self, b: int, c_pad: int):
-        mc = self.model_config
+    def _decode_attn_closure(self):
+        """The decode-shaped attention callback shared by the
+        single-step, fused-K, and ragged-round builders: the unified
+        ragged kernel in all-decode-row configuration (decode lanes
+        are single-row segments of the one grid — the SAME program the
+        mixed rounds launch), the composed per-sequence-grid decode
+        kernel (--no-ragged-kernel A/B control), or the XLA gather
+        path. `tables` = padded per-sequence block tables (b, pages)
+        on the pallas paths, per-position gather slots (b, c_pad) on
+        the XLA path."""
         scale = self._scale
+        if self.attention_impl == "pallas" and self.ragged_kernel:
+            tq = RAGGED_TQ
 
-        if self.attention_impl == "pallas":
-            from production_stack_tpu.ops import pallas_attention
+            def attn(q, l, kc, vc, tables, context_lens):
+                b = q.shape[0]
+                r_pad = _ceil_tq(b)
+                n_blk = r_pad // tq
+                qp = jnp.pad(q, ((0, r_pad - b), (0, 0), (0, 0)))
+                # one single-row segment per lane; blocks hold up to
+                # TQ lanes (CSR offsets clip at the live lane count)
+                blk_seg = jnp.minimum(
+                    jnp.arange(n_blk + 1, dtype=jnp.int32) * tq, b
+                )
+                lanes = jnp.arange(b, dtype=jnp.int32)
+                seg_meta = jnp.stack([
+                    lanes,
+                    lanes % tq,
+                    jnp.ones((b,), jnp.int32),
+                    context_lens - 1,
+                ], axis=1)
+                out = self._attn(
+                    "ragged", qp, l, kc, vc, tables, blk_seg, seg_meta
+                )
+                return out[:b]
+        elif self.attention_impl == "pallas":
 
-            bs = self.block_size
-            interpret = jax.default_backend() != "tpu"
-            mesh = self.mesh
-            window = self.model_config.sliding_window
-
-            # `tables` = padded per-sequence block tables (b, pages)
             def attn(q, l, kc, vc, tables, context_lens):
                 # q: (b, nq, d); kc/vc: full (L, nkv, slots, d) — the
-                # kernel DMAs pages straight from HBM, no gathered copy.
-                # Under TP the kernel is shard_mapped: each chip runs it
-                # on its local kv-head shard (GQA groups are chip-local)
-                if mesh is not None:
-                    return pallas_attention.paged_decode_attention_tp(
-                        q, kc, vc, l, tables, context_lens, mesh=mesh,
-                        block_size=bs, scale=scale, interpret=interpret,
-                        window=window,
-                    )
-                return pallas_attention.paged_decode_attention(
-                    q, kc, vc, l, tables, context_lens,
-                    block_size=bs, scale=scale, interpret=interpret,
-                    window=window,
+                # kernel DMAs pages straight from HBM, no gathered
+                # copy. Under TP the kernel is shard_mapped: each chip
+                # runs it on its local kv-head shard (GQA groups are
+                # chip-local)
+                return self._attn(
+                    "decode", q, l, kc, vc, tables, context_lens
                 )
         else:
 
-            # `tables` = per-position gather slots (b, c_pad)
             def attn(q, l, kc, vc, tables, context_lens):
                 # advanced-index hoisting (see prefill): (b, c, nkv, d)
                 k_ctx = kc[l, :, tables]
@@ -1171,6 +1553,12 @@ class ModelRunner:
                     q, k_ctx, v_ctx, context_lens, scale,
                     window=self.model_config.sliding_window,
                 )
+
+        return attn
+
+    def _build_decode(self, b: int, c_pad: int):
+        mc = self.model_config
+        attn = self._decode_attn_closure()
 
         def step(params, kc, vc, tokens, positions, write_slots,
                  tables, context_lens, lora=None, lora_slots=None):
@@ -1279,10 +1667,51 @@ class ModelRunner:
         Tokens below the valid count are bit-identical to the
         fixed-trip program — masking engages strictly after the stop
         token is sampled."""
+        core = self._decode_round_core(
+            b, c_pad, k_steps, use_penalties=use_penalties,
+            want_logprobs=want_logprobs, chained=chained,
+            guided_shapes=guided_shapes, bias_cap=bias_cap,
+            stop_cap=stop_cap,
+        )
+
+        def step(params, kc, vc, packed, chained_tokens=None,
+                 g_token_class=None, g_class_mask=None, g_class_trans=None,
+                 gen_ids=None, presence=None, frequency=None,
+                 repetition=None, lb_ids=None, lb_vals=None,
+                 lora=None, lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            consts, carry0 = core["unpack"](
+                packed, chained_tokens=chained_tokens,
+                g_token_class=g_token_class, g_class_mask=g_class_mask,
+                g_class_trans=g_class_trans, gen_ids=gen_ids,
+                presence=presence, frequency=frequency,
+                repetition=repetition, lb_ids=lb_ids, lb_vals=lb_vals,
+            )
+            return core["run"](params, kc, vc, consts, carry0,
+                               lora=lora, lora_slots=lora_slots)
+
+        return step
+
+    def _decode_round_core(self, b: int, c_pad: int, k_steps: int,
+                           use_penalties: bool = False,
+                           want_logprobs: bool = False,
+                           chained: bool = False,
+                           guided_shapes: tuple | None = None,
+                           bias_cap: int = 0,
+                           stop_cap: int | None = None):
+        """Shared internals of the fused-K decode round, factored into
+        unpack / forward / post-sample / loop closures so the packed
+        dispatch (_make_decode_multi_step) and the fused lane-typed
+        round (_build_ragged_rows — whose FIRST decode iteration's
+        forward is welded to the prefill rows inside one ragged-kernel
+        grid) run IDENTICAL per-step math. `run(first_logits=...)`
+        consumes an externally computed step-0 logits and continues
+        the loop from iteration 1; without it the loop is exactly the
+        packed dispatch's scan/while_loop."""
         mc = self.model_config
-        scale = self._scale
         bs = self.block_size
         from production_stack_tpu.engine.sampler import (
+            LOGPROB_CAP,
             STOP_PAD_TOKEN,
             apply_penalties,
             sample_tokens,
@@ -1290,35 +1719,7 @@ class ModelRunner:
             token_logprobs,
         )
 
-        if self.attention_impl == "pallas":
-            from production_stack_tpu.ops import pallas_attention
-
-            interpret = jax.default_backend() != "tpu"
-            mesh = self.mesh
-            window = self.model_config.sliding_window
-
-            def attn(q, l, kc, vc, page_tables, context_lens):
-                if mesh is not None:
-                    return pallas_attention.paged_decode_attention_tp(
-                        q, kc, vc, l, page_tables, context_lens,
-                        mesh=mesh, block_size=bs, scale=scale,
-                        interpret=interpret, window=window,
-                    )
-                return pallas_attention.paged_decode_attention(
-                    q, kc, vc, l, page_tables, context_lens,
-                    block_size=bs, scale=scale, interpret=interpret,
-                    window=window,
-                )
-        else:
-
-            def attn(q, l, kc, vc, gather_tables, context_lens):
-                k_ctx = kc[l, :, gather_tables]
-                v_ctx = vc[l, :, gather_tables]
-                return xla_attn.context_attention_decode(
-                    q, k_ctx, v_ctx, context_lens, scale,
-                    window=self.model_config.sliding_window,
-                )
-
+        attn = self._decode_attn_closure()
         use_pages = self.attention_impl == "pallas"
         use_stop = stop_cap is not None
         layout, _total = self._decode_pack_layout(
@@ -1329,35 +1730,46 @@ class ModelRunner:
         def _seg(packed, name, _lo=layout):
             return self._pack_seg(packed, _lo, name)
 
-        def step(params, kc, vc, packed, chained_tokens=None,
-                 g_token_class=None, g_class_mask=None, g_class_trans=None,
-                 gen_ids=None, presence=None, frequency=None,
-                 repetition=None, lb_ids=None, lb_vals=None,
-                 lora=None, lora_slots=None):
-            kc, vc = self._pin_cache_layout(kc, vc)
-            lane = jnp.arange(b)
+        lane = jnp.arange(b)
+
+        def unpack(packed, chained_tokens=None, g_token_class=None,
+                   g_class_mask=None, g_class_trans=None, gen_ids=None,
+                   presence=None, frequency=None, repetition=None,
+                   lb_ids=None, lb_vals=None):
+            """Decode-pack fields -> (consts dict, initial carry)."""
             tokens = (
                 chained_tokens if chained else _seg(packed, "tokens")
             )
             positions = _seg(packed, "positions")
             context_lens = _seg(packed, "ctx")
-            temps = jax.lax.bitcast_convert_type(
-                _seg(packed, "temps"), jnp.float32
-            )
-            top_ps = jax.lax.bitcast_convert_type(
-                _seg(packed, "top_ps"), jnp.float32
-            )
-            top_ks = _seg(packed, "top_ks")
-            min_ps = jax.lax.bitcast_convert_type(
-                _seg(packed, "min_ps"), jnp.float32
-            )
-            base_keys = jax.lax.bitcast_convert_type(
-                _seg(packed, "keys"), jnp.uint32
-            )
             page_tables = _seg(packed, "page_tables")
-            gather_tables = (
-                _seg(packed, "gather_tables") if not use_pages else None
-            )
+            consts = {
+                "temps": jax.lax.bitcast_convert_type(
+                    _seg(packed, "temps"), jnp.float32
+                ),
+                "top_ps": jax.lax.bitcast_convert_type(
+                    _seg(packed, "top_ps"), jnp.float32
+                ),
+                "top_ks": _seg(packed, "top_ks"),
+                "min_ps": jax.lax.bitcast_convert_type(
+                    _seg(packed, "min_ps"), jnp.float32
+                ),
+                "base_keys": jax.lax.bitcast_convert_type(
+                    _seg(packed, "keys"), jnp.uint32
+                ),
+                "page_tables": page_tables,
+                "attn_tables": (
+                    page_tables if use_pages
+                    else _seg(packed, "gather_tables")
+                ),
+                "presence": presence,
+                "frequency": frequency,
+                "repetition": repetition,
+                "lb_ids": lb_ids,
+                "lb_vals": lb_vals,
+                "g_class_mask": g_class_mask,
+                "g_class_trans": g_class_trans,
+            }
 
             if use_penalties:
                 # per-lane generated-token counts, maintained ON DEVICE
@@ -1373,124 +1785,150 @@ class ModelRunner:
             if guided_shapes is not None:
                 # (b, V) class of every token for each lane's machine,
                 # gathered once per dispatch outside the scan
-                lane_tc = g_token_class[_seg(packed, "g_lane")]
+                consts["lane_tc"] = g_token_class[_seg(packed, "g_lane")]
                 g_state0 = _seg(packed, "g_state")
             else:
-                lane_tc = None
+                consts["lane_tc"] = None
                 g_state0 = jnp.zeros((b,), jnp.int32)  # unused carry
 
             if use_stop:
-                eos_ids = _seg(packed, "stop_eos")
-                min_need = _seg(packed, "stop_min")
+                consts["eos_ids"] = _seg(packed, "stop_eos")
+                consts["min_need"] = _seg(packed, "stop_min")
                 budget = _seg(packed, "stop_budget")
-                s_ids = _seg(packed, "stop_ids") if stop_cap else None
+                consts["budget"] = budget
+                consts["s_ids"] = (
+                    _seg(packed, "stop_ids") if stop_cap else None
+                )
                 # padded lanes ship budget 0: done from iteration 0, so
                 # an all-real-lanes-finished round early-exits even
                 # when the static lane count exceeds the live batch
                 done0 = budget <= 0
             else:
-                s_ids = None
+                consts["s_ids"] = None
                 done0 = jnp.zeros((b,), bool)  # unused carry
             valid0 = jnp.zeros((b,), jnp.int32)
-
-            def one(kc, vc, carry, i):
-                (tokens, positions, ctx, counts, g_state, done,
-                 valid) = carry
-                # slot for each lane's current position from its block
-                # table (idle lanes carry the zero table -> trash block 0;
-                # K <= block_size keeps them inside it)
-                write_slots = (
-                    page_tables[lane, positions // bs] * bs
-                    + positions % bs
-                )
-                if use_stop:
-                    # frozen lanes write the trash slot: a done lane's
-                    # overshoot KV must never land past its real end
-                    write_slots = jnp.where(done, 0, write_slots)
-                attn_tables = page_tables if use_pages else gather_tables
-                attn_fn = functools.partial(
-                    attn, page_tables=attn_tables, context_lens=ctx,
-                ) if use_pages else functools.partial(
-                    attn, gather_tables=attn_tables, context_lens=ctx,
-                )
-                logits, kc, vc = self._forward(
-                    mc, params, tokens, positions, kc, vc, write_slots,
-                    lambda q, l, k, v: attn_fn(q, l, k, v),
-                    logits_rows=lane,
-                    lora=lora, lora_slots=lora_slots,
-                )
-                if use_penalties:
-                    logits = apply_penalties(
-                        logits, counts > 0, counts, presence, frequency,
-                        repetition,
-                    )
-                if bias_cap:
-                    # OpenAI logit_bias: per-lane sparse additive bias
-                    # (padding adds 0.0 to token 0 — a no-op), applied
-                    # after penalties and before any guided mask, same
-                    # order as the host path (_sample)
-                    logits = logits.at[
-                        lane[:, None], lb_ids
-                    ].add(lb_vals)
-                if guided_shapes is not None:
-                    # constraint mask from the lane's DFA state (same
-                    # penalties->mask->sample order as the host path)
-                    mask_c = g_class_mask[g_state]        # (b, C)
-                    allowed = jnp.take_along_axis(
-                        mask_c, lane_tc, axis=1
-                    )                                     # (b, V)
-                    logits = jnp.where(allowed, logits, -jnp.inf)
-                keys = base_keys.at[:, 1].add(i.astype(jnp.uint32))
-                nxt = sample_tokens(logits, temps, top_ps, top_ks, keys,
-                                    min_p=min_ps)
-                live = jnp.logical_not(done)
-                if use_stop:
-                    # pin frozen lanes' sampled slots to the pad token
-                    # (the host reads only valid[lane] tokens anyway)
-                    nxt = jnp.where(done, STOP_PAD_TOKEN, nxt)
-                if guided_shapes is not None:
-                    cls = jnp.take_along_axis(
-                        lane_tc, nxt[:, None], axis=1
-                    )[:, 0]
-                    new_g = g_class_trans[g_state, cls]
-                    # a frozen lane's DFA state stops stepping (the pad
-                    # token is not part of its stream)
-                    g_state = (
-                        jnp.where(done, g_state, new_g)
-                        if use_stop else new_g
-                    )
-                if use_penalties:
-                    # frozen lanes stop updating penalty counts: pinned
-                    # pad tokens are not generated output
-                    counts = counts.at[lane, nxt].add(
-                        live.astype(jnp.float32) if use_stop else 1.0
-                    )
-                valid = valid + live.astype(jnp.int32)
-                if use_stop:
-                    # the sampled token is valid (the stop token itself
-                    # is appended, same as the host path); the lane
-                    # freezes FROM THE NEXT iteration. Budget first,
-                    # then the min_tokens-gated EOS/stop-id check —
-                    # check_stop's exact ordering.
-                    hit = stop_hit(nxt, eos_ids, s_ids)
-                    done = done | (valid >= budget) | (
-                        live & hit & (valid >= min_need)
-                    )
-                    adv = jnp.where(done, 0, 1)
-                else:
-                    adv = 1
-                if want_logprobs:
-                    # on-device logprobs ride the same single fetch —
-                    # (k, b) chosen + (k, b, CAP) top alternatives
-                    ys = (nxt, *token_logprobs(logits, nxt))
-                else:
-                    ys = nxt
-                carry = (nxt, positions + adv, ctx + adv, counts,
-                         g_state, done, valid)
-                return kc, vc, carry, ys
-
             carry0 = (tokens, positions, context_lens, counts0,
                       g_state0, done0, valid0)
+            return consts, carry0
+
+        def fwd_args(carry, consts):
+            """(tokens, positions, write_slots, ctx) for one decode
+            forward — shared by the in-loop forward and the fused
+            round's step-0 mixed forward."""
+            tokens, positions, ctx = carry[0], carry[1], carry[2]
+            done = carry[5]
+            # slot for each lane's current position from its block
+            # table (idle lanes carry the zero table -> trash block 0;
+            # K <= block_size keeps them inside it)
+            write_slots = (
+                consts["page_tables"][lane, positions // bs] * bs
+                + positions % bs
+            )
+            if use_stop:
+                # frozen lanes write the trash slot: a done lane's
+                # overshoot KV must never land past its real end
+                write_slots = jnp.where(done, 0, write_slots)
+            return tokens, positions, write_slots, ctx
+
+        def fwd(params, kc, vc, carry, consts, lora, lora_slots):
+            tokens, positions, write_slots, ctx = fwd_args(carry, consts)
+            attn_fn = functools.partial(
+                attn, tables=consts["attn_tables"], context_lens=ctx,
+            )
+            logits, kc, vc = self._forward(
+                mc, params, tokens, positions, kc, vc, write_slots,
+                lambda q, l, k, v: attn_fn(q, l, k, v),
+                logits_rows=lane,
+                lora=lora, lora_slots=lora_slots,
+            )
+            return logits, kc, vc
+
+        def post(logits, carry, i, consts):
+            """Sample + stop/penalty/guided state advance for one
+            iteration's logits; returns (carry', ys_i)."""
+            (tokens, positions, ctx, counts, g_state, done,
+             valid) = carry
+            if use_penalties:
+                logits = apply_penalties(
+                    logits, counts > 0, counts, consts["presence"],
+                    consts["frequency"], consts["repetition"],
+                )
+            if bias_cap:
+                # OpenAI logit_bias: per-lane sparse additive bias
+                # (padding adds 0.0 to token 0 — a no-op), applied
+                # after penalties and before any guided mask, same
+                # order as the host path (_sample)
+                logits = logits.at[
+                    lane[:, None], consts["lb_ids"]
+                ].add(consts["lb_vals"])
+            if guided_shapes is not None:
+                # constraint mask from the lane's DFA state (same
+                # penalties->mask->sample order as the host path)
+                mask_c = consts["g_class_mask"][g_state]  # (b, C)
+                allowed = jnp.take_along_axis(
+                    mask_c, consts["lane_tc"], axis=1
+                )                                         # (b, V)
+                logits = jnp.where(allowed, logits, -jnp.inf)
+            keys = consts["base_keys"].at[:, 1].add(
+                jnp.asarray(i).astype(jnp.uint32)
+            )
+            nxt = sample_tokens(logits, consts["temps"],
+                                consts["top_ps"], consts["top_ks"],
+                                keys, min_p=consts["min_ps"])
+            live = jnp.logical_not(done)
+            if use_stop:
+                # pin frozen lanes' sampled slots to the pad token
+                # (the host reads only valid[lane] tokens anyway)
+                nxt = jnp.where(done, STOP_PAD_TOKEN, nxt)
+            if guided_shapes is not None:
+                cls = jnp.take_along_axis(
+                    consts["lane_tc"], nxt[:, None], axis=1
+                )[:, 0]
+                new_g = consts["g_class_trans"][g_state, cls]
+                # a frozen lane's DFA state stops stepping (the pad
+                # token is not part of its stream)
+                g_state = (
+                    jnp.where(done, g_state, new_g)
+                    if use_stop else new_g
+                )
+            if use_penalties:
+                # frozen lanes stop updating penalty counts: pinned
+                # pad tokens are not generated output
+                counts = counts.at[lane, nxt].add(
+                    live.astype(jnp.float32) if use_stop else 1.0
+                )
+            valid = valid + live.astype(jnp.int32)
+            if use_stop:
+                # the sampled token is valid (the stop token itself
+                # is appended, same as the host path); the lane
+                # freezes FROM THE NEXT iteration. Budget first,
+                # then the min_tokens-gated EOS/stop-id check —
+                # check_stop's exact ordering.
+                hit = stop_hit(nxt, consts["eos_ids"], consts["s_ids"])
+                done = done | (valid >= consts["budget"]) | (
+                    live & hit & (valid >= consts["min_need"])
+                )
+                adv = jnp.where(done, 0, 1)
+            else:
+                adv = 1
+            if want_logprobs:
+                # on-device logprobs ride the same single fetch —
+                # (k, b) chosen + (k, b, CAP) top alternatives
+                ys = (nxt, *token_logprobs(logits, nxt))
+            else:
+                ys = nxt
+            carry = (nxt, positions + adv, ctx + adv, counts,
+                     g_state, done, valid)
+            return carry, ys
+
+        def run(params, kc, vc, consts, carry0, lora=None,
+                lora_slots=None, first_logits=None):
+            def one(kc, vc, carry, i):
+                logits, kc, vc = fwd(params, kc, vc, carry, consts,
+                                     lora, lora_slots)
+                carry, ys = post(logits, carry, i, consts)
+                return kc, vc, carry, ys
+
             if not use_stop:
 
                 def scan_one(sc, i):
@@ -1498,18 +1936,30 @@ class ModelRunner:
                     kc, vc, c, ys = one(kc, vc, c, i)
                     return (kc, vc, c), ys
 
-                (kc, vc, _), ys = jax.lax.scan(
-                    scan_one, (kc, vc, carry0), jnp.arange(k_steps)
+                if first_logits is None:
+                    (kc, vc, _), ys = jax.lax.scan(
+                        scan_one, (kc, vc, carry0), jnp.arange(k_steps)
+                    )
+                    return ys, kc, vc  # ys: (k, b) toks [+ lp arrays]
+                # fused lane-typed round: step 0's forward already ran
+                # (welded to the prefill rows); apply its post half
+                # here and scan the remaining iterations
+                c, ys0 = post(first_logits, carry0, jnp.int32(0),
+                              consts)
+                (kc, vc, _), ys_rest = jax.lax.scan(
+                    scan_one, (kc, vc, c), jnp.arange(1, k_steps)
                 )
-                return ys, kc, vc  # ys: (k, b) toks [+ logprob arrays]
+                ys = jax.tree_util.tree_map(
+                    lambda a, r: jnp.concatenate([a[None], r], axis=0),
+                    ys0, ys_rest,
+                )
+                return ys, kc, vc
 
             # device-stop variant: while_loop over preallocated output
             # rows so the round EXITS as soon as every lane is done —
             # an all-finished tail iteration would otherwise still pay
             # the full forward. Unwritten rows stay at the pad token;
             # the host consumes only valid[lane] tokens per lane.
-            from production_stack_tpu.engine.sampler import LOGPROB_CAP
-
             toks_buf = jnp.full((k_steps, b), STOP_PAD_TOKEN, jnp.int32)
             lp_bufs = ()
             if want_logprobs:
@@ -1542,9 +1992,28 @@ class ModelRunner:
                 tb = tb.at[i].set(nxt)
                 return (i + 1, kc, vc, c, tb, *lps)
 
+            c0 = carry0
+            i0 = jnp.int32(0)
+            if first_logits is not None:
+                # fused round: seed the buffers with step 0's post
+                # half, then loop from iteration 1 (the while cond
+                # still early-exits once every lane is done)
+                c0, ys0 = post(first_logits, carry0, jnp.int32(0),
+                               consts)
+                if want_logprobs:
+                    nxt0, ch0, tv0, ti0 = ys0
+                    lp_bufs = (
+                        lp_bufs[0].at[0].set(ch0),
+                        lp_bufs[1].at[0].set(tv0),
+                        lp_bufs[2].at[0].set(ti0),
+                    )
+                else:
+                    nxt0 = ys0
+                toks_buf = toks_buf.at[0].set(nxt0)
+                i0 = jnp.int32(1)
             state = jax.lax.while_loop(
                 cond, body,
-                (jnp.int32(0), kc, vc, carry0, toks_buf, *lp_bufs),
+                (i0, kc, vc, c0, toks_buf, *lp_bufs),
             )
             _, kc, vc, c, tb = state[:5]
             valid = c[6]
@@ -1554,7 +2023,13 @@ class ModelRunner:
                 ys = (tb, valid)
             return ys, kc, vc  # ys: (toks, [lp arrays,] valid)
 
-        return step
+        return {
+            "layout": layout,
+            "unpack": unpack,
+            "fwd_args": fwd_args,
+            "run": run,
+            "lane": lane,
+        }
 
     def _build_decode_multi(self, b: int, c_pad: int, k_steps: int,
                             use_penalties: bool = False,
@@ -1692,6 +2167,7 @@ class ModelRunner:
             if key not in self._prefill_fns:
                 logger.info("compiling prefill step t=%d ctx=%d plp=%s",
                             t_pad, c_pad, want_plp)
+                self._note_compile("prefill")
                 self._prefill_fns[key] = self._build_prefill(
                     t_pad, c_pad, want_prompt_lp=want_plp
                 )
@@ -1713,6 +2189,7 @@ class ModelRunner:
         if key not in self._prefill_fns:
             logger.info("compiling prefill step t=%d ctx=%d plp=%s",
                         t_pad, c_pad, want_plp)
+            self._note_compile("prefill")
             self._prefill_fns[key] = self._build_prefill(
                 t_pad, c_pad, want_prompt_lp=want_plp
             )
@@ -1773,6 +2250,44 @@ class ModelRunner:
 
         `staged` = a stage_prefill_batch handle (see prefill)."""
         n = len(chunks)
+        if self.prefill_pipeline and self.ragged_kernel:
+            # ragged-rows path: program keys on the padded ROW bucket
+            # (r_pad, pc_pad), one kernel launch for any group
+            r_pad, pc_pad = self._rows_dims(chunks, total_lens)
+            packed_dev = None
+            if (staged is not None
+                    and staged[0] == ("rows", r_pad, pc_pad)):
+                packed_dev = staged[1]  # upload already overlapped
+            if packed_dev is None:
+                t0 = time.perf_counter()
+                r_pad, pc_pad, packed = self._fill_rows_prefill_pack(
+                    chunks, start_positions, block_tables, total_lens,
+                    sampling=sampling,
+                )
+                t1 = time.perf_counter()
+                self._phase_add("prep", t1 - t0)
+                packed_dev = jnp.asarray(packed)
+                self._phase_add("h2d", time.perf_counter() - t1)
+            key = ("rows", r_pad, pc_pad)
+            if key not in self._prefill_batch_fns:
+                logger.info(
+                    "compiling ragged-rows prefill step rows=%d ctx=%d",
+                    r_pad, pc_pad,
+                )
+                self._note_compile("prefill_rows")
+                self._prefill_batch_fns[key] = self._build_prefill_rows(
+                    r_pad, pc_pad
+                )
+            lora_kw = self._rows_lora_kwargs(lora_slots, chunks, r_pad)
+            t2 = time.perf_counter()
+            sampled, logits, self.k_cache, self.v_cache = (
+                self._prefill_batch_fns[key](
+                    self.params, self.k_cache, self.v_cache,
+                    packed_dev, **lora_kw,
+                )
+            )
+            self._phase_add("dispatch", time.perf_counter() - t2)
+            return sampled, logits
         if self.prefill_pipeline:
             s_pad = next_pow2(max(n, 1))
             t_pad = self._prefill_bucket(max(len(c) for c in chunks))
@@ -1799,6 +2314,7 @@ class ModelRunner:
                     "compiling packed prefill step s=%d t=%d ctx=%d",
                     s_pad, t_pad, c_pad,
                 )
+                self._note_compile("prefill_batch")
                 self._prefill_batch_fns[key] = self._build_prefill_batch(
                     s_pad, t_pad, c_pad
                 )
@@ -1831,6 +2347,7 @@ class ModelRunner:
                 "compiling packed prefill step s=%d t=%d ctx=%d",
                 s_pad, t_pad, c_pad,
             )
+            self._note_compile("prefill_batch")
             self._prefill_batch_fns[key] = self._build_prefill_batch(
                 s_pad, t_pad, c_pad
             )
@@ -2122,6 +2639,7 @@ class ModelRunner:
         key = (b, c_pad)
         if key not in self._decode_fns:
             logger.info("compiling decode step b=%d ctx=%d", b, c_pad)
+            self._note_compile("decode")
             self._decode_fns[key] = self._build_decode(b, c_pad)
         fn = self._decode_fns[key]
         lora_kw = {}
@@ -2469,6 +2987,7 @@ class ModelRunner:
                 b, c_pad, steps, penalties is not None, want_logprobs,
                 chained, guided_shapes, bias_cap, stop_cap,
             )
+            self._note_compile("decode_multi")
             self._decode_multi_fns[cache_key] = self._build_decode_multi(
                 b, c_pad, steps, use_penalties=penalties is not None,
                 want_logprobs=want_logprobs, chained=chained,
@@ -2651,6 +3170,214 @@ class ModelRunner:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
+    # -- single-kernel ragged-rows round -----------------------------------
+    def _ragged_rows_pack_sizes(
+        self, r_pad: int, pc_pad: int, b: int, c_pad: int,
+        chained: bool, guided: bool = False,
+        stop_cap: int | None = None,
+    ) -> tuple[int, int, int]:
+        """(meta, prefill, decode) segment lengths of the ragged-ROWS
+        round's packed buffer (kernel-mode mirror of
+        _ragged_pack_sizes: the prefill segment is the ragged-rows
+        pack, lane meta spans the static lane cap)."""
+        meta = 3 * (self._rows_lane_cap() + b)
+        _, pf = self._rows_prefill_pack_layout(r_pad, pc_pad)
+        _, dec = self._decode_pack_layout(
+            b, c_pad, chained, guided=guided, stop_cap=stop_cap
+        )
+        return meta, pf, dec
+
+    # stackcheck: hot-path — host build of the kernel-mode round's
+    # single h2d buffer (dispatch + staging prefetch); one pass over
+    # the lanes, no device fetch
+    def _fill_ragged_rows_pack(
+        self,
+        pf_chunks, pf_start_positions, pf_block_tables, pf_total_lens,
+        pf_sampling, c_pad, chained, token_ids, positions,
+        block_tables, context_lens, steps, temps, top_ps, top_ks,
+        keys, min_ps=None, guided_lanes=None, stop=None,
+        pf_budgets=None, dec_budgets=None,
+    ) -> tuple[int, int, np.ndarray]:
+        """Kernel-mode mirror of _fill_ragged_pack: lane-meta header
+        (lane cap + b lanes) + the ragged-ROWS prefill pack + the
+        decode pack. Returns (r_pad, pc_pad, packed)."""
+        b = self.config.max_num_seqs
+        s_cap = self._rows_lane_cap()
+        r_pad, pc_pad, pf_packed = self._fill_rows_prefill_pack(
+            pf_chunks, pf_start_positions, pf_block_tables,
+            pf_total_lens, sampling=pf_sampling,
+        )
+        dec_packed = self._fill_decode_pack(
+            c_pad, chained, token_ids, positions, block_tables,
+            context_lens, temps, top_ps, top_ks, keys, min_ps=min_ps,
+            guided_lanes=guided_lanes, stop=stop,
+        )
+        n_pf = len(pf_chunks)
+        n_dec = len(positions)
+        n_lanes = s_cap + b
+        types = np.zeros((n_lanes,), np.int32)
+        types[:n_pf] = RAGGED_LANE_PREFILL
+        types[s_cap:s_cap + n_dec] = RAGGED_LANE_DECODE
+        lens = np.zeros((n_lanes,), np.int32)
+        lens[:n_pf] = [len(c) for c in pf_chunks]
+        lens[s_cap:s_cap + n_dec] = steps
+        budgets = np.zeros((n_lanes,), np.int32)
+        if pf_budgets is not None:
+            budgets[:n_pf] = pf_budgets
+        if dec_budgets is not None:
+            budgets[s_cap:s_cap + n_dec] = dec_budgets
+        elif stop is not None:
+            budgets[s_cap:s_cap + n_dec] = stop[2]
+        packed = np.concatenate([types, lens, budgets, pf_packed,
+                                 dec_packed])
+        return r_pad, pc_pad, packed
+
+    def _build_ragged_rows(self, r_pad: int, pc_pad: int, b: int,
+                           c_pad: int, k_steps: int,
+                           use_penalties: bool = False,
+                           want_logprobs: bool = False,
+                           chained: bool = False,
+                           guided_shapes: tuple | None = None,
+                           bias_cap: int = 0,
+                           stop_cap: int | None = None):
+        """ONE jitted lane-typed round in single-kernel mode: the
+        prefill lanes' chunk rows AND the decode lanes' step-0 query
+        rows share one flattened row space — one forward pass whose
+        per-layer attention is ONE ragged_paged_attention launch over
+        the whole lane mix — then decode iterations 1..K-1 continue
+        through the shared decode core (the same kernel in all-decode
+        configuration). Prefill and decode lanes belong to different
+        sequences with disjoint block tables, and the decode half's
+        post-sample math is _decode_round_core's verbatim, so tokens
+        and logical KV are bit-identical to both the composed-kernel
+        ragged round and the split path."""
+        from production_stack_tpu.engine.sampler import (
+            RAGGED_IDLE_TOKEN,
+            sample_tokens,
+        )
+
+        mc = self.model_config
+        tq = RAGGED_TQ
+        bs = self.block_size
+        s_cap = self._rows_lane_cap()
+        b_pad = _ceil_tq(b)
+        pf_step = self._make_prefill_rows_step(r_pad, pc_pad)
+        pf_unpack = pf_step._unpack
+        core = self._decode_round_core(
+            b, c_pad, k_steps, use_penalties=use_penalties,
+            want_logprobs=want_logprobs, chained=chained,
+            guided_shapes=guided_shapes, bias_cap=bias_cap,
+            stop_cap=stop_cap,
+        )
+        meta_n, pf_n, _dec_n = self._ragged_rows_pack_sizes(
+            r_pad, pc_pad, b, c_pad, chained,
+            guided=guided_shapes is not None, stop_cap=stop_cap,
+        )
+        n_pages = max(pc_pad, c_pad) // bs
+        n_pf_blk = r_pad // tq
+        n_dec_blk = b_pad // tq
+
+        def step(params, kc, vc, packed, chained_tokens=None,
+                 g_token_class=None, g_class_mask=None,
+                 g_class_trans=None, gen_ids=None, presence=None,
+                 frequency=None, repetition=None, lb_ids=None,
+                 lb_vals=None, lora=None, lora_slots=None,
+                 pf_lora_slots=None):
+            kc, vc = self._pin_cache_layout(kc, vc)
+            lane_types = packed[:s_cap + b]
+            pf_packed = packed[meta_n:meta_n + pf_n]
+            dec_packed = packed[meta_n + pf_n:]
+            pf = pf_unpack(pf_packed)
+            consts, carry0 = core["unpack"](
+                dec_packed, chained_tokens=chained_tokens,
+                g_token_class=g_token_class, g_class_mask=g_class_mask,
+                g_class_trans=g_class_trans, gen_ids=gen_ids,
+                presence=presence, frequency=frequency,
+                repetition=repetition, lb_ids=lb_ids, lb_vals=lb_vals,
+            )
+            # fused step-0 forward over [prefill rows | decode rows]:
+            # decode write slots / ctx come from the shared core so
+            # frozen-lane trash redirection matches the loop's
+            d_tokens, d_positions, d_ws, d_ctx = core["fwd_args"](
+                carry0, consts
+            )
+            tokens_cat = jnp.concatenate([pf["tokens"], d_tokens])
+            positions_cat = jnp.concatenate(
+                [pf["positions"], d_positions]
+            )
+            ws_cat = jnp.concatenate([pf["write_slots"], d_ws])
+            # lane tables: prefill lanes then decode lanes, padded to
+            # the wider page count (pad pages point at the null block
+            # and sit beyond every segment's page walk)
+            pf_tab = pf["tables"]
+            dec_tab = consts["page_tables"]
+            pf_tab = jnp.pad(
+                pf_tab, ((0, 0), (0, n_pages - pf_tab.shape[1]))
+            )
+            dec_tab = jnp.pad(
+                dec_tab, ((0, 0), (0, n_pages - dec_tab.shape[1]))
+            )
+            tables_cat = jnp.concatenate([pf_tab, dec_tab], axis=0)
+            # block map: prefill blocks carry one chunk segment each;
+            # decode lanes are single-row segments sharing the tail
+            # blocks (q_pos = ctx-1 makes decode the degenerate causal
+            # case of the one kernel body)
+            pf_seg = self._rows_pf_seg_meta(
+                r_pad, pf["lane_row0"], pf["lane_rows"], pf["q_starts"]
+            )
+            dlanes = jnp.arange(b, dtype=jnp.int32)
+            dec_seg = jnp.stack([
+                s_cap + dlanes,
+                dlanes % tq,
+                jnp.ones((b,), jnp.int32),
+                d_ctx - 1,
+            ], axis=1)
+            seg_meta = jnp.concatenate([pf_seg, dec_seg], axis=0)
+            blk_seg = jnp.concatenate([
+                jnp.arange(n_pf_blk + 1, dtype=jnp.int32),
+                n_pf_blk + jnp.minimum(
+                    (jnp.arange(n_dec_blk, dtype=jnp.int32) + 1) * tq,
+                    b,
+                ),
+            ])
+
+            def attn_fn(q, l, kcc, vcc):
+                qp = jnp.pad(q, ((0, b_pad - b), (0, 0), (0, 0)))
+                out = self._attn(
+                    "ragged", qp, l, kcc, vcc, tables_cat, blk_seg,
+                    seg_meta,
+                )
+                return out[:r_pad + b]
+
+            lora_cat = None
+            if lora is not None:
+                lora_cat = jnp.concatenate([pf_lora_slots, lora_slots])
+            logits_all, kc, vc = self._forward(
+                mc, params, tokens_cat, positions_cat, kc, vc, ws_cat,
+                attn_fn,
+                logits_rows=jnp.concatenate(
+                    [pf["last_rows"], r_pad + jnp.arange(b)]
+                ),
+                lora=lora, lora_slots=lora_cat,
+            )
+            pf_logits = logits_all[:s_cap]
+            dec0_logits = logits_all[s_cap:]
+            pf_sampled = sample_tokens(
+                pf_logits, pf["temps"], pf["top_ps"], pf["top_ks"],
+                pf["keys"], min_p=pf["min_ps"],
+            )
+            pf_sampled = jnp.where(
+                lane_types[:s_cap] == RAGGED_LANE_PREFILL,
+                pf_sampled, RAGGED_IDLE_TOKEN,
+            )
+            ys, kc, vc = core["run"](
+                params, kc, vc, consts, carry0, lora=lora,
+                lora_slots=lora_slots, first_logits=dec0_logits,
+            )
+            return pf_sampled, pf_logits, ys, kc, vc
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
     # stackcheck: hot-path — speculative h2d prefetch of the NEXT ragged
     # round's packed buffer: the upload overlaps the in-flight round's
     # execution and fetch (prefill mirror: stage_prefill_batch; decode
@@ -2677,17 +3404,27 @@ class ModelRunner:
         c_pad = self._ctx_bucket(
             max(context_lens) + max(0, steps - 1)
         )
-        s_pad, t_pad, pc_pad, packed = self._fill_ragged_pack(
-            pf_chunks, pf_start_positions, pf_block_tables,
-            pf_total_lens, pf_sampling, c_pad, True, None, positions,
-            block_tables, context_lens, steps, temps, top_ps, top_ks,
-            keys, min_ps=min_ps, stop=stop, pf_budgets=pf_budgets,
-            dec_budgets=dec_budgets,
-        )
+        if self.ragged_kernel:
+            r_pad, pc_pad, packed = self._fill_ragged_rows_pack(
+                pf_chunks, pf_start_positions, pf_block_tables,
+                pf_total_lens, pf_sampling, c_pad, True, None,
+                positions, block_tables, context_lens, steps, temps,
+                top_ps, top_ks, keys, min_ps=min_ps, stop=stop,
+                pf_budgets=pf_budgets, dec_budgets=dec_budgets,
+            )
+            key = ("rows", r_pad, pc_pad, c_pad)
+        else:
+            s_pad, t_pad, pc_pad, packed = self._fill_ragged_pack(
+                pf_chunks, pf_start_positions, pf_block_tables,
+                pf_total_lens, pf_sampling, c_pad, True, None,
+                positions, block_tables, context_lens, steps, temps,
+                top_ps, top_ks, keys, min_ps=min_ps, stop=stop,
+                pf_budgets=pf_budgets, dec_budgets=dec_budgets,
+            )
+            key = ("ragged", s_pad, t_pad, pc_pad, c_pad)
         t1 = time.perf_counter()
         self._phase_add("prep", t1 - t0)
-        handle = (("ragged", s_pad, t_pad, pc_pad, c_pad),
-                  jax.device_put(packed))
+        handle = (key, jax.device_put(packed))
         self._phase_add("h2d", time.perf_counter() - t1)
         return handle
 
@@ -2733,6 +3470,18 @@ class ModelRunner:
                 f"num_scheduler_steps={steps} > block_size="
                 f"{self.block_size}: idle lanes would overrun the trash "
                 "block"
+            )
+        if self.ragged_kernel:
+            return self._ragged_rows_dispatch(
+                pf_chunks, pf_start_positions, pf_block_tables,
+                pf_total_lens, token_ids, positions, block_tables,
+                context_lens, steps, temps, top_ps, top_ks, keys,
+                min_ps=min_ps, pf_sampling=pf_sampling,
+                pf_lora_slots=pf_lora_slots, lora_slots=lora_slots,
+                penalties=penalties, want_logprobs=want_logprobs,
+                guided=guided, logit_bias=logit_bias, staged=staged,
+                stop=stop, pf_budgets=pf_budgets,
+                dec_budgets=dec_budgets,
             )
         b = self.config.max_num_seqs
         chained = isinstance(token_ids, jax.Array)
@@ -2788,6 +3537,7 @@ class ModelRunner:
                 penalties is not None, want_logprobs, chained,
                 guided_shapes, bias_cap, stop_cap,
             )
+            self._note_compile("ragged")
             self._ragged_fns[cache_key] = self._build_ragged(
                 s_pad, t_pad, pc_pad, b, c_pad, steps,
                 use_penalties=penalties is not None,
@@ -2825,18 +3575,134 @@ class ModelRunner:
         self._phase_add("dispatch", time.perf_counter() - t2)
         return pf_sampled, pf_logits, ys
 
+    # stackcheck: hot-path — the single-kernel lane-typed round: ONE
+    # dispatch serves prefill chunks + decode steps; fetches stay
+    # deferred to the caller
+    def _ragged_rows_dispatch(
+        self,
+        pf_chunks, pf_start_positions, pf_block_tables, pf_total_lens,
+        token_ids, positions, block_tables, context_lens, steps,
+        temps, top_ps, top_ks, keys, min_ps=None, pf_sampling=None,
+        pf_lora_slots=None, lora_slots=None, penalties=None,
+        want_logprobs=False, guided=None, logit_bias=None,
+        staged=None, stop=None, pf_budgets=None, dec_budgets=None,
+    ) -> tuple:
+        """Kernel-mode body of ragged_dispatch (same contract): the
+        program keys on the padded ROW bucket + ctx buckets —
+        (r_pad, pc_pad, b, c_pad, k) — so every lane mix that packs to
+        the same row bucket shares one program, and the per-layer
+        attention of the whole mix is one kernel launch."""
+        b = self.config.max_num_seqs
+        chained = isinstance(token_ids, jax.Array)
+        b_actual = len(positions)
+        c_pad = self._ctx_bucket(max(context_lens) + steps - 1)
+        r_pad, pc_pad = self._rows_dims(pf_chunks, pf_total_lens)
+        guided_lanes = None
+        if guided is not None:
+            guided_lanes = (guided[1], guided[2])
+        stop_cap = None
+        if stop is not None:
+            stop_cap = 0 if stop[3] is None else int(stop[3].shape[1])
+        packed_dev = None
+        if (staged is not None and chained and guided is None
+                and staged[0] == ("rows", r_pad, pc_pad, c_pad)):
+            # same stale-stage contract as the composed path: the
+            # bucket key AND the total layout length must match, else
+            # the dispatch rebuilds serially (a counted staging miss)
+            want_total = sum(self._ragged_rows_pack_sizes(
+                r_pad, pc_pad, b, c_pad, chained,
+                guided=False, stop_cap=stop_cap,
+            ))
+            if int(staged[1].shape[0]) == want_total:
+                packed_dev = staged[1]
+        if packed_dev is None:
+            t0 = time.perf_counter()
+            _r, _pc, packed = self._fill_ragged_rows_pack(
+                pf_chunks, pf_start_positions, pf_block_tables,
+                pf_total_lens, pf_sampling, c_pad, chained, token_ids,
+                positions, block_tables, context_lens, steps, temps,
+                top_ps, top_ks, keys, min_ps=min_ps,
+                guided_lanes=guided_lanes, stop=stop,
+                pf_budgets=pf_budgets, dec_budgets=dec_budgets,
+            )
+            t1 = time.perf_counter()
+            self._phase_add("prep", t1 - t0)
+            packed_dev = jnp.asarray(packed)
+            self._phase_add("h2d", time.perf_counter() - t1)
+
+        pen_kw = self._decode_pen_kwargs(penalties, b, c_pad, b_actual)
+        guided_kw, guided_shapes = self._decode_guided_kwargs(guided)
+        bias_kw, bias_cap = self._decode_bias_kwargs(
+            logit_bias, b, b_actual
+        )
+        cache_key = ("rows", r_pad, pc_pad, b, c_pad, steps,
+                     penalties is not None, want_logprobs, chained,
+                     guided_shapes, bias_cap, stop_cap)
+        if cache_key not in self._ragged_fns:
+            logger.info(
+                "compiling ragged-rows round rows=%d pctx=%d b=%d "
+                "ctx=%d k=%d pen=%s lp=%s chained=%s guided=%s "
+                "bias=%d stop=%s",
+                r_pad, pc_pad, b, c_pad, steps, penalties is not None,
+                want_logprobs, chained, guided_shapes, bias_cap,
+                stop_cap,
+            )
+            self._note_compile("ragged_rows")
+            self._ragged_fns[cache_key] = self._build_ragged_rows(
+                r_pad, pc_pad, b, c_pad, steps,
+                use_penalties=penalties is not None,
+                want_logprobs=want_logprobs, chained=chained,
+                guided_shapes=guided_shapes, bias_cap=bias_cap,
+                stop_cap=stop_cap,
+            )
+        fn = self._ragged_fns[cache_key]
+        lora_kw = {}
+        if self.lora_manager is not None:
+            slots = np.zeros((b,), dtype=np.int32)
+            if lora_slots is not None:
+                slots[:b_actual] = lora_slots
+            # the fused step-0 forward concatenates prefill + decode
+            # slot vectors, so the prefill side always ships per-row
+            pf_rows = self._rows_slot_vector(
+                pf_chunks, pf_lora_slots, r_pad
+            )
+            lora_kw = {
+                "lora": self.lora_manager.buffers,
+                "lora_slots": jnp.asarray(slots),
+                "pf_lora_slots": jnp.asarray(pf_rows),
+            }
+        chained_kw = {"chained_tokens": token_ids} if chained else {}
+        t2 = time.perf_counter()
+        pf_sampled, pf_logits, ys, self.k_cache, self.v_cache = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            packed_dev,
+            **chained_kw,
+            **guided_kw,
+            **pen_kw,
+            **bias_kw,
+            **lora_kw,
+        )
+        self._phase_add("dispatch", time.perf_counter() - t2)
+        return pf_sampled, pf_logits, ys
+
     def precompile_ragged(
         self, context_lens: list[int], ks: list[int], max_groups: int,
         chunk_len: int, stop: bool = False, chained: bool = False,
     ) -> int:
-        """Warm the ragged round's pow2 lane-mix buckets: every pow2
+        """Warm the ragged round's program variants: every pow2
         prefill-lane group size up to max_groups x each fused-K bucket x
         each ctx bucket, prefill lanes' context matched to the decode
         bucket (the steady-state mixed-round shape: sessions in one
-        workload share a length regime). Trash tables at the top of the
-        pool, same safety contract as precompile_prefill/decode.
-        `chained=True` additionally warms the staged-prefetch variant
-        (device-array decode tokens — a distinct program key)."""
+        workload share a length regime). Under the single kernel the
+        program keys on padded ROW-count buckets, so group sizes that
+        pack to the same row bucket dedupe to ONE warm dispatch — the
+        variant space shrinks from the (group, chunk) lane-mix grid to
+        the row diagonal. Trash tables at the top of the pool, same
+        safety contract as precompile_prefill/decode. `chained=True`
+        additionally warms the staged-prefetch variant (device-array
+        decode tokens — a distinct program key)."""
         b = self.config.max_num_seqs
         bs = self.block_size
         nb = self.num_blocks
@@ -2857,7 +3723,17 @@ class ModelRunner:
                 ctx = c_pad - max(0, k - 1)
                 clen = min(chunk_len, c_pad)
                 for s in groups:
-                    key = (s, self._prefill_bucket(clen), c_pad, k)
+                    if self.ragged_kernel:
+                        # single-kernel mode: the program keys on the
+                        # padded ROW bucket, so distinct lane mixes
+                        # that pack to the same row count are ONE
+                        # variant — the (group, chunk) grid collapses
+                        key = (
+                            self._rows_bucket(s * _ceil_tq(clen)),
+                            c_pad, k,
+                        )
+                    else:
+                        key = (s, self._prefill_bucket(clen), c_pad, k)
                     if key in seen:
                         continue
                     seen.add(key)
@@ -2987,6 +3863,7 @@ class ModelRunner:
             if key not in self._embed_fns:
                 logger.info("compiling embed step t=%d ctx=%d", t_pad,
                             c_pad)
+                self._note_compile("embed")
                 self._embed_fns[key] = self._build_embed(t_pad, c_pad)
             part, kc, vc = self._embed_fns[key](
                 self.params, kc, vc, jnp.asarray(toks),
@@ -3120,6 +3997,7 @@ class ModelRunner:
         fn = self._import_fns.get(key)
         if fn is None:
             logger.info("compiling kv import n_src=%d n_dst=%d", *key)
+            self._note_compile("kv_import")
             fn = self._import_fns[key] = self._build_import(*key)
         self.k_cache, self.v_cache = fn(
             self.k_cache, self.v_cache, jnp.asarray(bids),

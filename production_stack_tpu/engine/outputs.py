@@ -71,6 +71,15 @@ class EngineStatsSnapshot:
     ragged_split_rounds_total: int = 0
     ragged_prefill_lanes_total: int = 0
     ragged_decode_lanes_total: int = 0
+    # compile-count observability: program-variant builds (jit cache
+    # misses on the runner's step builders) since boot, total and per
+    # builder kind — tpu:compile_events_total in /metrics and the
+    # bench `compiles` detail slot. The chip-window cold-start tax
+    # (and the single-kernel variant-space shrink) read directly off
+    # this instead of being inferred from compile logs.
+    compile_events_total: int = 0
+    # kind -> count, e.g. {"decode_multi": 3, "ragged_rows": 2}
+    compile_events: dict = field(default_factory=dict)
     # zero-stall KV tiering attribution: deferred-export batches (wall
     # seconds measured ON THE OFFLOAD WORKER — overlapped activity, not
     # step-loop stalls) and staged restores (enqueue -> landed), plus

@@ -388,6 +388,256 @@ def test_engine_swa_selects_pallas_and_matches_xla():
         mcfg._PRESETS.pop(cfg.name, None)
 
 
+# ---- unified ragged paged attention kernel --------------------------------
+# ONE batched-grid kernel over a flattened row space: decode lanes are
+# single-row segments, prefill lanes contribute their chunk's q-tiles,
+# CSR per-block segment metadata rides scalar prefetch. Parity bar is
+# BIT-IDENTITY against the composed kernels per row (the masked-page
+# online-softmax no-op argument), not allclose.
+
+def _dec_rows_meta(ctx, tq=8):
+    """CSR metadata for an all-decode row space (one single-row segment
+    per lane, lanes sharing TQ-row blocks)."""
+    b = len(ctx)
+    r_pad = -(-b // tq) * tq
+    n_blk = r_pad // tq
+    blk_seg = np.minimum(np.arange(n_blk + 1, dtype=np.int32) * tq, b)
+    lanes = np.arange(b, dtype=np.int32)
+    seg = np.stack([lanes, lanes % tq, np.ones(b, np.int32),
+                    np.asarray(ctx, np.int32) - 1], axis=1)
+    return r_pad, jnp.asarray(blk_seg), jnp.asarray(seg)
+
+
+def _ragged(q, kc, vc, layer, tables, blk_seg, seg_meta, bs=8,
+            window=None):
+    from production_stack_tpu.ops.pallas_attention import (
+        ragged_paged_attention,
+    )
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    return ragged_paged_attention(
+        q, kc, vc, jnp.int32(layer), tables, blk_seg, seg_meta,
+        block_size=bs, scale=scale, interpret=True, window=window,
+    )
+
+
+def test_ragged_tq_constants_agree():
+    """The runner packs lanes RAGGED_TQ-aligned and the kernel derives
+    its tile from the caller's shapes — the two module constants must
+    agree or a kernel-side retune silently never takes effect."""
+    from production_stack_tpu.engine import model_runner as mr
+    from production_stack_tpu.ops import pallas_attention as pa
+
+    assert mr.RAGGED_TQ == pa.RAGGED_TQ
+
+
+@pytest.mark.parametrize("layer", [0, 1])
+def test_ragged_kernel_decode_rows_bit_identical(layer):
+    """Decode-only row space (b=5 lanes sharing one 8-row block, one
+    ragged length per lane) is bit-identical to the composed per-
+    sequence-grid decode kernel."""
+    q, kc, vc, bt, ctx = make_case(0, b=5)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = paged_decode_attention(
+        q, kc, vc, jnp.int32(layer), bt, ctx,
+        block_size=8, scale=scale, interpret=True,
+    )
+    r_pad, blk_seg, seg = _dec_rows_meta(np.asarray(ctx))
+    qp = jnp.pad(q, ((0, r_pad - q.shape[0]), (0, 0), (0, 0)))
+    out = _ragged(qp, kc, vc, layer, bt, blk_seg, seg)[: q.shape[0]]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_ragged_kernel_prefill_rows_bit_identical():
+    """A 16-row chunk starting mid-page (ragged length straddling page
+    boundaries) as two 8-row segments is bit-identical to the composed
+    prefill kernel's one launch."""
+    q, kc, vc, table, q_start, total_len = make_prefill_case(1, t=16)
+    from production_stack_tpu.ops.pallas_attention import (
+        paged_prefill_attention,
+    )
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = paged_prefill_attention(
+        q, kc, vc, jnp.int32(0), table, jnp.int32(q_start),
+        block_size=8, scale=scale, interpret=True,
+    )
+    g = q.shape[0] // 8
+    blk_seg = jnp.arange(g + 1, dtype=jnp.int32)
+    seg = np.stack([
+        np.zeros(g, np.int32), np.zeros(g, np.int32),
+        np.full(g, 8, np.int32),
+        q_start + 8 * np.arange(g, dtype=np.int32),
+    ], axis=1)
+    out = _ragged(q, kc, vc, 0, table[None], blk_seg, jnp.asarray(seg))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("window", [None, 7, 100])
+def test_ragged_kernel_mixed_rows(window):
+    """THE lane-mix case: one 16-row prefill chunk + 4 decode lanes
+    with ragged context lengths share ONE grid; every region matches
+    its composed-kernel reference bit for bit (windowed variants
+    included — the windowed page-walk start is per segment)."""
+    from production_stack_tpu.ops.pallas_attention import (
+        paged_prefill_attention,
+    )
+
+    rng = np.random.RandomState(3)
+    bs, nkv, g, d = 8, 2, 2, 128
+    nq = nkv * g
+    # prefill lane: chunk of 16 at q_start mid-page over its own pages
+    qp, kc, vc, pf_table, q_start, total_len = make_prefill_case(
+        3, t=16, prefix_pages=2, nkv=nkv, g=g, d=d
+    )
+    # decode lanes: 4 lanes over DISTINCT trailing slots of the same
+    # cache (disjoint tables, like disjoint sequences in a round)
+    b = 4
+    pages = 2
+    extra = rng.randn(2, nkv, (1 + b * pages) * bs, d).astype(
+        np.float32
+    )
+    kc2 = jnp.concatenate([kc, jnp.asarray(extra)], axis=2)
+    vc2 = jnp.concatenate(
+        [vc, jnp.asarray(rng.randn(*extra.shape).astype(np.float32))],
+        axis=2,
+    )
+    base = kc.shape[2] // bs
+    dec_tables = (
+        base + 1 + np.arange(b * pages, dtype=np.int32).reshape(b, pages)
+    )
+    dec_ctx = np.asarray([1, 7, 9, 16], np.int32)  # straddle pages
+    qd = jnp.asarray(rng.randn(b, nq, d).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+
+    ref_pf = paged_prefill_attention(
+        qp, kc2, vc2, jnp.int32(1), pf_table, jnp.int32(q_start),
+        block_size=bs, scale=scale, interpret=True, window=window,
+    )
+    ref_dec = paged_decode_attention(
+        qd, kc2, vc2, jnp.int32(1), jnp.asarray(dec_tables),
+        jnp.asarray(dec_ctx), block_size=bs, scale=scale,
+        interpret=True, window=window,
+    )
+
+    # one grid: 2 prefill blocks + 1 decode block
+    r_pf = qp.shape[0]
+    n_pf_blk = r_pf // 8
+    n_pages = max(pf_table.shape[0], pages)
+    tables = np.zeros((1 + b, n_pages), np.int32)
+    tables[0, : pf_table.shape[0]] = np.asarray(pf_table)
+    tables[1:, :pages] = dec_tables
+    pf_seg = np.stack([
+        np.zeros(n_pf_blk, np.int32), np.zeros(n_pf_blk, np.int32),
+        np.full(n_pf_blk, 8, np.int32),
+        q_start + 8 * np.arange(n_pf_blk, dtype=np.int32),
+    ], axis=1)
+    lanes = np.arange(b, dtype=np.int32)
+    dec_seg = np.stack([
+        1 + lanes, lanes % 8, np.ones(b, np.int32), dec_ctx - 1,
+    ], axis=1)
+    seg = np.concatenate([pf_seg, dec_seg])
+    blk_seg = np.concatenate([
+        np.arange(n_pf_blk + 1, dtype=np.int32),
+        np.asarray([n_pf_blk + b], np.int32),
+    ])
+    q_all = jnp.concatenate(
+        [qp, qd, jnp.zeros((8 - b, nq, d), jnp.float32)]
+    )
+    out = _ragged(
+        q_all, kc2, vc2, 1, jnp.asarray(tables),
+        jnp.asarray(blk_seg), jnp.asarray(seg), bs=bs, window=window,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out[:r_pf]), np.asarray(ref_pf)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out[r_pf: r_pf + b]), np.asarray(ref_dec)
+    )
+
+
+def test_ragged_kernel_idle_segments_and_blocks():
+    """Zero-row segments (idle lanes) and blocks with no segments walk
+    no pages and leave other rows' outputs untouched — real rows stay
+    bit-identical to a run without the idle entries."""
+    q, kc, vc, bt, ctx = make_case(2, b=3)
+    r_pad, blk_seg, seg = _dec_rows_meta(np.asarray(ctx))
+    qp = jnp.pad(q, ((0, r_pad - 3), (0, 0), (0, 0)))
+    out_ref = _ragged(qp, kc, vc, 0, bt, blk_seg, seg)[:3]
+    # same rows + an idle zero-row segment + a trailing empty block
+    seg_idle = jnp.concatenate([
+        seg, jnp.asarray([[0, 3, 0, 0]], jnp.int32)
+    ])
+    blk_idle = jnp.asarray([0, 4, 4], jnp.int32)  # block 1: no segs
+    q_idle = jnp.concatenate([qp, jnp.zeros_like(qp)])
+    out = _ragged(q_idle, kc, vc, 0, bt, blk_idle, seg_idle)[:3]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_ref))
+
+
+def test_ragged_kernel_tp_shard_map_parity():
+    """The shard_mapped TP ragged kernel (8-device CPU mesh, kv heads
+    sharded) matches the single-device composed decode reference."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from production_stack_tpu.ops.pallas_attention import (
+        ragged_paged_attention_tp,
+    )
+    from production_stack_tpu.parallel.sharding import make_mesh
+
+    q, kc, vc, bt, ctx = make_case(4, b=4, nkv=8, g=2, d=128)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = reference(q, kc, vc, 1, bt, ctx, 8, scale)
+    r_pad, blk_seg, seg = _dec_rows_meta(np.asarray(ctx))
+    qp = jnp.pad(q, ((0, r_pad - 4), (0, 0), (0, 0)))
+    mesh = make_mesh(8)
+    kc_sh = jax.device_put(
+        kc, NamedSharding(mesh, P(None, None, "tp", None))
+    )
+    vc_sh = jax.device_put(
+        vc, NamedSharding(mesh, P(None, None, "tp", None))
+    )
+    q_sh = jax.device_put(qp, NamedSharding(mesh, P(None, "tp", None)))
+    out = ragged_paged_attention_tp(
+        q_sh, kc_sh, vc_sh, jnp.int32(1), bt, blk_seg, seg,
+        mesh=mesh, block_size=8, scale=scale, interpret=True,
+    )[:4]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_engine_single_kernel_vs_composed_and_xla():
+    """Whole-engine greedy decode is identical across the XLA path,
+    the composed kernels (--no-ragged-kernel), and the single-kernel
+    mode — chunked prompts + multi-step decode so the packed-prefill
+    rows program AND the kernel-mode decode loop both run."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+
+    kw = dict(
+        model="pst-tiny-debug", tokenizer="byte", dtype="float32",
+        cache_dtype="float32", block_size=8, num_kv_blocks=64,
+        max_num_seqs=2, max_prefill_chunk=8, seed=0,
+        num_scheduler_steps=4, async_decode=False,
+    )
+    sp = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    prompts = ["a chunked prompt long enough for several chunks",
+               "short one"]
+    out_x = [o.token_ids for o in LLMEngine(
+        EngineConfig(attention_impl="xla", **kw)).generate(prompts, sp)]
+    e_c = LLMEngine(EngineConfig(
+        attention_impl="pallas", ragged_kernel=False, **kw
+    ))
+    assert not e_c.runner.ragged_kernel
+    out_c = [o.token_ids for o in e_c.generate(prompts, sp)]
+    e_k = LLMEngine(EngineConfig(attention_impl="pallas", **kw))
+    assert e_k.runner.ragged_kernel
+    out_k = [o.token_ids for o in e_k.generate(prompts, sp)]
+    assert out_c == out_x
+    assert out_k == out_x
+
+
 def test_engine_multistep_pallas_path():
     """pallas + num_scheduler_steps>1 (the TPU default serving config)
     must trace and match the XLA engine — regression for the undefined

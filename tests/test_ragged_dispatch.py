@@ -479,6 +479,252 @@ def test_ragged_engine_gates():
     assert not e._ragged_dispatch
 
 
+# -- (f) single-kernel ragged paged attention (PR 11) ------------------------
+# The Pallas path now serves ANY lane mix with ONE ragged_paged_
+# attention launch (decode rows + prefill q-tiles share the grid) and
+# keys the packed-prefill/ragged program variants on padded ROW-count
+# buckets. These tests pin the engine-level parity, the one-launch
+# contract, and the variant-space shrink vs the PR 7 lane-mix grid.
+
+def test_single_kernel_mixed_round_parity():
+    """Kernel-mode ragged engine vs kernel-mode split engine (both
+    attention_impl=pallas, interpret on CPU): tokens + logical KV
+    bit-identical through mixed rounds with device stops."""
+    sp = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    e_r, _, _ = _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (3, "c", MED)], sp,
+        engine_kw=dict(attention_impl="pallas"),
+    )
+    assert e_r.runner.ragged_kernel
+    assert e_r._ragged_rounds_total > 0
+
+
+def test_single_kernel_exotic_sampling_parity():
+    """Penalties, logprobs, and stop ids all ride the fused rows
+    round's shared decode core: kernel-mode ragged vs kernel-mode
+    split, token streams and logprob entries identical."""
+    learn = SamplingParams(max_tokens=10, temperature=0.0,
+                           ignore_eos=True)
+    stream = _engine(False, k=1).generate([SHORT], learn)[0].token_ids
+    sps = {
+        "a": SamplingParams(max_tokens=10, temperature=0.7, seed=3,
+                            repetition_penalty=1.3, ignore_eos=True),
+        "b": SamplingParams(max_tokens=8, temperature=0.0, logprobs=2,
+                            ignore_eos=True),
+        "c": SamplingParams(max_tokens=10, temperature=0.0,
+                            ignore_eos=True,
+                            stop_token_ids=[stream[4]]),
+    }
+    _, out_r, out_s = _assert_parity(
+        [(0, "a", SHORT), (2, "b", LONG), (2, "c", MED)], sps,
+        engine_kw=dict(attention_impl="pallas"), check_kv=False,
+    )
+    lp_r, lp_s = out_r["b"][1], out_s["b"][1]
+    assert len(lp_r) == len(lp_s) > 0
+    for x, y in zip(lp_r, lp_s):
+        assert x["token_id"] == y["token_id"]
+        assert abs(x["logprob"] - y["logprob"]) < 1e-4
+
+
+def test_single_kernel_vs_composed_kernels_parity():
+    """Kernel-mode vs composed-kernel (--no-ragged-kernel) ragged
+    engines: same staggered mixed workload, bit-identical tokens AND
+    logical KV — the A/B the bench @norpakernel control measures."""
+    sp = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    arrivals = [(0, "a", SHORT), (2, "b", LONG)]
+    e_k = _engine(True, attention_impl="pallas")
+    out_k = _run_staggered(e_k, arrivals, sp)
+    e_c = _engine(True, attention_impl="pallas", ragged_kernel=False)
+    out_c = _run_staggered(e_c, arrivals, sp)
+    assert e_k.runner.ragged_kernel and not e_c.runner.ragged_kernel
+    assert {r: t for r, (t, _) in out_k.items()} == {
+        r: t for r, (t, _) in out_c.items()
+    }
+    c_k, c_c = _cached_kv_by_hash(e_k), _cached_kv_by_hash(e_c)
+    assert set(c_k) == set(c_c) and c_k
+    for h in c_k:
+        np.testing.assert_array_equal(c_k[h][0], c_c[h][0])
+        np.testing.assert_array_equal(c_k[h][1], c_c[h][1])
+
+
+def _mixed_dispatch(runner, n_pf, chunk_len, k=4, total_len=16):
+    """Drive one mixed ragged_dispatch on a fresh runner: n_pf prefill
+    lanes, each mid-prefill with `chunk_len` tokens of a `total_len`
+    prompt, beside a full decode batch (trash tables at the top of
+    the pool, the precompile pattern). Fixing total_len across mixes
+    keeps the prefill ctx bucket constant so only the LANE MIX varies
+    between calls."""
+    b = runner.config.max_num_seqs
+    bs = runner.block_size
+    nb = runner.num_blocks
+    temps = np.zeros((b,), np.float32)
+    top_ps = np.ones((b,), np.float32)
+    top_ks = np.full((b,), -1, np.int32)
+    keys = np.zeros((b, 2), np.uint32)
+    c_pad = runner._ctx_bucket(16 + k - 1)
+    npages = c_pad // bs
+    dec_table = list(range(nb - npages, nb))
+    pf_pages = runner._ctx_bucket(total_len) // bs
+    pf_tabs = [
+        list(range(nb - npages - (i + 1) * pf_pages,
+                   nb - npages - i * pf_pages))
+        for i in range(n_pf)
+    ]
+    ctx = c_pad - (k - 1)
+    out = runner.ragged_dispatch(
+        [[1] * chunk_len] * n_pf,
+        [total_len - chunk_len] * n_pf, pf_tabs,
+        [total_len] * n_pf,
+        [1] * b, [ctx - 1] * b, [dec_table] * b, [ctx] * b, k,
+        temps, top_ps, top_ks, keys,
+    )
+    import jax
+    jax.block_until_ready(out)
+
+
+def test_single_kernel_one_launch_per_lane_mix():
+    """THE acceptance contract: under the single kernel, a mixed
+    round's traced program contains a LANE-COUNT-INDEPENDENT number of
+    ragged kernel launches (one per layer for the fused step-0
+    forward, one per layer inside the decode loop) and ZERO composed
+    prefill/decode kernel launches; the composed control's prefill
+    launches scale with the lane count."""
+    from production_stack_tpu.ops import pallas_attention as pa
+
+    import jax
+
+    def launches(ragged_kernel, n_pf):
+        e = _engine(True, attention_impl="pallas",
+                    ragged_kernel=ragged_kernel, num_kv_blocks=256)
+        # the kernel entries are themselves jitted and jax's trace
+        # cache is process-global: clear it so each program's launch
+        # count is measured fresh, not deduped against a prior engine
+        jax.clear_caches()
+        pa.reset_launch_counts()
+        _mixed_dispatch(e.runner, n_pf, chunk_len=4)
+        return pa.launch_counts()
+
+    l1 = launches(True, 1)
+    l2 = launches(True, 4)
+    # layers run under lax.scan, so the traced program holds exactly
+    # TWO ragged launches — the fused step-0 forward's and the decode
+    # loop body's — regardless of the lane mix
+    assert l1["ragged"] == l2["ragged"] == 2
+    assert l1["prefill"] == l1["decode"] == 0
+    assert l2["prefill"] == l2["decode"] == 0
+
+    c1 = launches(False, 1)
+    c2 = launches(False, 4)
+    assert c1["ragged"] == c2["ragged"] == 0
+    # composed control: the packed-prefill half unrolls one kernel per
+    # PADDED lane inside the layer scan — launches scale with the mix
+    assert c2["prefill"] == 4 * c1["prefill"] > 0
+    assert c1["decode"] == c2["decode"] > 0
+
+
+def test_single_kernel_variant_space_shrinks():
+    """Precompile-variant acceptance: lane mixes that pack to the same
+    row bucket share ONE program under the single kernel, so both the
+    live lane-mix matrix and precompile_ragged compile strictly fewer
+    ragged variants than the PR 7 (group, chunk) grid."""
+    # live matrix: (lanes x chunk_len) mixes — composed keys
+    # (s_pad, t_pad, ...) = 4 variants, rows keys r_pad = 3
+    mixes = [(1, 4), (2, 4), (1, 12), (2, 12)]
+
+    def variants(ragged_kernel):
+        e = _engine(True, attention_impl="pallas",
+                    ragged_kernel=ragged_kernel, num_kv_blocks=256,
+                    max_prefill_chunk=16)
+        for n_pf, clen in mixes:
+            _mixed_dispatch(e.runner, n_pf, clen)
+        return len(e.runner._ragged_fns)
+
+    n_rows = variants(True)
+    n_mix = variants(False)
+    assert n_rows < n_mix, (n_rows, n_mix)
+    assert (n_rows, n_mix) == (3, 4)
+
+    # the split packed-prefill path collapses the same way: its
+    # program keys on (r_pad, pc_pad) instead of (s_pad, t_pad, c_pad)
+    def pf_variants(ragged_kernel):
+        e = _engine(True, attention_impl="pallas",
+                    ragged_kernel=ragged_kernel, num_kv_blocks=256,
+                    max_prefill_chunk=16)
+        r = e.runner
+        nb = r.num_blocks
+        pgs = r._ctx_bucket(16) // r.block_size
+        for n_pf, clen in mixes:
+            tabs = [
+                list(range(nb - (i + 1) * pgs, nb - i * pgs))
+                for i in range(n_pf)
+            ]
+            out = r.prefill_batch(
+                [[1] * clen] * n_pf, [16 - clen] * n_pf, tabs,
+                [16] * n_pf,
+            )
+            import jax
+            jax.block_until_ready(out)
+        return len(r._prefill_batch_fns)
+
+    assert pf_variants(True) < pf_variants(False)
+
+    # precompile grid: with a uniform warm chunk the per-(ctx, k)
+    # group dedupe is 1:1, so the warm pass never compiles MORE —
+    # the precompile_serving group grid (multiple chunk buckets) is
+    # where the row-bucket dedupe strictly shrinks, pinned above
+    def precompiled(ragged_kernel):
+        e = _engine(True, attention_impl="pallas",
+                    ragged_kernel=ragged_kernel, num_kv_blocks=256,
+                    max_prefill_chunk=16, max_prefill_seqs=4)
+        e.runner.precompile_ragged(
+            [16], [4], max_groups=4, chunk_len=16,
+        )
+        return len(e.runner._ragged_fns)
+
+    assert precompiled(True) <= precompiled(False)
+
+
+def test_single_kernel_staged_prefetch_hits_and_parity():
+    """The h2d-prefetched next-round buffer (rows layout) is consumed
+    under the single kernel (hits > 0) with streams identical to the
+    unprefetched kernel-mode engine."""
+    sp = SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True)
+    long_prompt = list(range(1, 60))
+    arrivals = [(0, "a", SHORT), (3, "b", long_prompt)]
+
+    def run(prefetch):
+        e = _engine(True, attention_impl="pallas", max_num_seqs=2,
+                    num_kv_blocks=256, prefetch_decode=prefetch)
+        return e, _run_staggered(e, arrivals, sp)
+
+    e_on, out_on = run(True)
+    e_off, out_off = run(False)
+    assert {r: t for r, (t, _) in out_on.items()} == {
+        r: t for r, (t, _) in out_off.items()
+    }
+    assert e_on._ragged_staged_hits_total > 0
+
+
+def test_compile_events_counted_and_in_stats():
+    """Compile-count observability: every program-variant build ticks
+    the runner counter, rides the stats snapshot (-> tpu:compile_
+    events_total), and distinguishes kernel-mode builder kinds."""
+    sp = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    e = _engine(True, attention_impl="pallas")
+    _run_staggered(e, [(0, "a", SHORT), (2, "b", LONG)], sp)
+    assert e.runner.compile_events_total > 0
+    assert "ragged_rows" in e.runner.compile_events
+    s = e.stats()
+    assert s.compile_events_total == e.runner.compile_events_total
+    assert s.compile_events == e.runner.compile_events
+    # the counter is a monotonic total: re-running an already-warmed
+    # workload shape adds nothing
+    _run_staggered(e, [(0, "d", SHORT)], sp)
+    before = e.runner.compile_events_total
+    _run_staggered(e, [(0, "e", SHORT)], sp)
+    assert e.runner.compile_events_total == before
+
+
 def test_stochastic_parity_in_mixed_rounds():
     """Sampled streams (per-iteration keys (seed, generated_len + i))
     stay bit-identical through lane-typed rounds."""

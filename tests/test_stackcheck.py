@@ -646,6 +646,10 @@ def test_ragged_dispatch_hot_marks_present():
     want = {
         "model_runner.py": {
             "ragged_dispatch", "stage_ragged", "_fill_ragged_pack",
+            # single-kernel mode (PR 11): the ragged-ROWS pack/
+            # dispatch helpers and the one attention dispatch seam
+            "_ragged_rows_dispatch", "_fill_ragged_rows_pack",
+            "_fill_rows_prefill_pack", "_attn",
         },
         "scheduler.py": {"plan_ragged_round"},
     }
