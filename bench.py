@@ -145,6 +145,29 @@ SYNC_KV = os.environ.get("PST_BENCH_SYNC_KV", "0") == "1"
 # @noremotekv pins the local-tiers-only control (the @kvoff default).
 # Slots: BENCH_SWEEP_kvremote.json vs the matching @noremotekv control
 KV_REMOTE = os.environ.get("PST_BENCH_KV_REMOTE", "0") == "1"
+# long-context scenario (@longctx): instead of the multi-round QA
+# workload, sweep ONE prompt per length over 8k -> 128k tokens and
+# record TTFT vs length + per-phase attribution (ring / d2h / land /
+# overflow) + the HBM high-water mark. @nolongctx runs the same sweep
+# with the ring lane OFF (chunked-prefill control — the A/B the staged
+# BENCH_SWEEP_longctx.json entry in PERF.md measures).
+LONGCTX = os.environ.get("PST_BENCH_LONGCTX", "0") == "1"
+LONGCTX_RING = os.environ.get("PST_BENCH_LONGCTX_RING", "1") == "1"
+LONGCTX_SP = int(os.environ.get("PST_BENCH_LONGCTX_SP", "4"))
+LONGCTX_THRESHOLD = int(
+    os.environ.get("PST_BENCH_LONGCTX_THRESHOLD", "4096")
+)
+LONGCTX_CHUNK = int(os.environ.get("PST_BENCH_LONGCTX_CHUNK", "2048"))
+LONGCTX_LENS = [
+    int(x)
+    for x in os.environ.get(
+        "PST_BENCH_LONGCTX_LENS", "8192,16384,32768,65536,131072"
+    ).split(",")
+    if x.strip()
+]
+LONGCTX_ANSWER_TOK = int(
+    os.environ.get("PST_BENCH_LONGCTX_ANSWER_TOK", "16")
+)
 CPU_OFFLOAD_MB = int(os.environ.get("PST_BENCH_CPU_OFFLOAD_MB", "2048"))
 DISK_OFFLOAD_DIR = os.environ.get(
     "PST_BENCH_DISK_DIR", "/tmp/pst-bench-kv"
@@ -293,13 +316,21 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 overrides["PST_BENCH_PD"] = "1"
             elif m == "nopd":
                 overrides["PST_BENCH_PD"] = "0"
+            elif m == "longctx":
+                # long-context scenario: 8k -> 128k prompt-length sweep
+                # served by the context-parallel ring lane
+                overrides["PST_BENCH_LONGCTX"] = "1"
+            elif m == "nolongctx":
+                # same sweep on the chunked-prefill control (the A/B)
+                overrides["PST_BENCH_LONGCTX"] = "1"
+                overrides["PST_BENCH_LONGCTX_RING"] = "0"
             else:
                 raise ValueError(
                     f"bad sweep label modifier {m!r} in {label!r}: want "
                     "qps<F> | u<N> | r<N> | chunk<N> | nopfx | nopfpipe "
                     "| trace | elastic | noelastic | ragged | noragged "
                     "| rpa | norpakernel | kvoff | synckv | remotekv "
-                    "| noremotekv | pd | nopd"
+                    "| noremotekv | pd | nopd | longctx | nolongctx"
                 )
         if ("PST_BENCH_SYNC_KV" in overrides
                 and "PST_BENCH_KV_OFFLOAD" not in overrides):
@@ -328,7 +359,8 @@ def _parse_sweep_labels(spec: str) -> list[tuple]:
                 "k<N>-{sync|async}-{packed|nopack}[@qps<F>|@u<N>|@r<N>"
                 "|@chunk<N>|@nopfx|@nopfpipe|@trace|@elastic"
                 "|@noelastic|@ragged|@noragged|@rpa|@norpakernel"
-                "|@kvoff|@synckv|@remotekv|@noremotekv|@pd|@nopd]"
+                "|@kvoff|@synckv|@remotekv|@noremotekv|@pd|@nopd"
+                "|@longctx|@nolongctx]"
             )
         configs.append((
             label,
@@ -677,11 +709,204 @@ class _PDPrefiller:
         self.engine.shutdown()
 
 
+def _run_longctx(label: str) -> dict:
+    """@longctx scenario: serve ONE prompt per length over the 8k ->
+    128k sweep, recording TTFT vs prompt length, the long-prefill
+    per-phase attribution, and the HBM high-water mark. The ring lane
+    is on by default (@longctx); @nolongctx pins the chunked-prefill
+    control for the A/B."""
+    import gc
+
+    import jax
+
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.llm_engine import LLMEngine
+    from production_stack_tpu.engine.sampling_params import SamplingParams
+    from production_stack_tpu.models.config import get_model_config
+
+    watchdog = _arm_watchdog(
+        float(os.environ.get("PST_BENCH_RUN_DEADLINE", "1200")),
+        f"longctx[{label}]",
+    )
+    mc = get_model_config(MODEL)
+    lens = [x for x in LONGCTX_LENS
+            if x + LONGCTX_ANSWER_TOK <= mc.max_model_len]
+    if not lens:
+        raise SystemExit(
+            f"model {MODEL} (max_model_len={mc.max_model_len}) admits "
+            f"none of the sweep lengths {LONGCTX_LENS}"
+        )
+    ring = LONGCTX_RING
+    config = EngineConfig(
+        model=MODEL,
+        tokenizer="byte",
+        dtype="bfloat16",
+        cache_dtype="bfloat16",
+        block_size=32,
+        hbm_utilization=0.85,
+        max_model_len=max(lens) + LONGCTX_ANSWER_TOK,
+        max_num_seqs=4,
+        max_prefill_chunk=PREFILL_CHUNK,
+        tensor_parallel_size=TP,
+        num_scheduler_steps=SCHED_STEPS,
+        device_stop=ELASTIC,
+        adaptive_decode_k=ELASTIC,
+        long_prefill_threshold=LONGCTX_THRESHOLD if ring else None,
+        context_parallel_size=LONGCTX_SP if ring else 0,
+        long_prefill_chunk=LONGCTX_CHUNK,
+        seed=0,
+    )
+    t_setup = time.time()
+    engine = LLMEngine(config)
+    ring_live = engine.long_prefill is not None
+    print(
+        f"# longctx engine up in {time.time() - t_setup:.1f}s, ring "
+        f"{'LIVE' if ring_live else 'OFF'}, "
+        f"{engine.runner.num_blocks} KV blocks",
+        file=sys.stderr,
+    )
+    rng = np.random.RandomState(0)
+    vocab = engine.runner.model_config.vocab_size
+    sp = SamplingParams(
+        max_tokens=LONGCTX_ANSWER_TOK, temperature=0.0, ignore_eos=True
+    )
+    # warm the small buckets so the first sweep point is not all compile
+    engine.generate(
+        [rng.randint(0, vocab, 256).tolist()],
+        SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True),
+    )
+
+    def _peak_bytes() -> int:
+        try:
+            return int(
+                (jax.devices()[0].memory_stats() or {}).get(
+                    "peak_bytes_in_use", 0
+                )
+            )
+        except Exception:  # noqa: BLE001 — CPU backends have no stats
+            return 0
+
+    rows = []
+    pool_tokens = engine.runner.num_blocks * config.block_size
+    for L in lens:
+        rid = f"lc{L}"
+        if L + LONGCTX_ANSWER_TOK > pool_tokens:
+            rows.append({
+                "prompt_tokens": L, "admitted": False,
+                "reason": f"KV pool holds {pool_tokens} tokens",
+            })
+            continue
+        prompt = rng.randint(0, vocab, L).tolist()
+        snap = engine.stats()
+        hbm_hw = 0.0
+        ttft = None
+        t0 = time.time()
+        engine.add_request(rid, prompt_token_ids=prompt,
+                           sampling_params=sp)
+        while engine.has_unfinished():
+            outs = engine.step()
+            hbm_hw = max(hbm_hw, engine.block_manager.usage)
+            if ttft is None and any(
+                o.request_id == rid and o.token_ids for o in outs
+            ):
+                ttft = time.time() - t0
+        e2e = time.time() - t0
+        st = engine.stats()
+        rows.append({
+            "prompt_tokens": L,
+            "admitted": True,
+            "ttft_s": round(ttft, 3) if ttft is not None else -1,
+            "e2e_s": round(e2e, 3),
+            # a ring claim that FAILED back to chunked prefill must not
+            # pollute the ring-vs-chunked A/B rows as "ring"
+            "served_via": (
+                "chunked"
+                if st.long_prefill_requests_total
+                == snap.long_prefill_requests_total
+                else "ring"
+                if st.long_prefill_fallbacks_total
+                == snap.long_prefill_fallbacks_total
+                else "ring-fallback"
+            ),
+            "hbm_highwater_frac": round(hbm_hw, 4),
+            "hbm_peak_bytes": _peak_bytes(),
+            "phase_s": {
+                "ring": round(
+                    st.long_prefill_ring_seconds_total
+                    - snap.long_prefill_ring_seconds_total, 3),
+                "d2h": round(
+                    st.long_prefill_d2h_seconds_total
+                    - snap.long_prefill_d2h_seconds_total, 3),
+                "land": round(
+                    st.long_prefill_land_seconds_total
+                    - snap.long_prefill_land_seconds_total, 3),
+                "overflow": round(
+                    st.long_prefill_overflow_seconds_total
+                    - snap.long_prefill_overflow_seconds_total, 3),
+            },
+        })
+        print(f"# longctx {L}: {rows[-1]}", file=sys.stderr)
+    st = engine.stats()
+    served = [r for r in rows if r.get("admitted")]
+    result = {
+        "metric": (
+            f"long-context TTFT sweep ({mc.name}, "
+            f"{lens[0]}-{lens[-1]} tok prompts, "
+            f"{'ring sp=' + str(LONGCTX_SP) if ring_live else 'chunked'}"
+            f", {TP} chip(s))"
+        ),
+        "value": served[-1]["ttft_s"] if served else -1,
+        "unit": f"s_ttft@{served[-1]['prompt_tokens']}tok"
+        if served else "s_ttft",
+        "vs_baseline": -1,
+        "detail": {
+            "config_label": label,
+            "sweep": rows,
+            "long_prefill": {
+                "enabled": ring,
+                "live": ring_live,
+                "sp": LONGCTX_SP if ring_live else 0,
+                "threshold": LONGCTX_THRESHOLD if ring_live else None,
+                "chunk_tokens": (
+                    engine.long_prefill.chunk if ring_live else None
+                ),
+                "requests": st.long_prefill_requests_total,
+                "chunks": st.long_prefill_chunks_total,
+                "fallbacks": st.long_prefill_fallbacks_total,
+                "phase_s": {
+                    "ring": round(st.long_prefill_ring_seconds_total, 3),
+                    "d2h": round(st.long_prefill_d2h_seconds_total, 3),
+                    "land": round(st.long_prefill_land_seconds_total, 3),
+                    "overflow": round(
+                        st.long_prefill_overflow_seconds_total, 3),
+                },
+            },
+            "compiles": {
+                "total": engine.runner.compile_events_total,
+                "by_kind": dict(sorted(
+                    engine.runner.compile_events.items()
+                )),
+            },
+        },
+    }
+    watchdog.cancel()
+    engine.shutdown()
+    del engine
+    gc.collect()
+    return result
+
+
 def run_config(sched_steps: int, prefill_seqs: int, async_decode: bool,
                label: str) -> dict:
     import gc
 
     import jax  # noqa: F401 — backend already initialized
+
+    if LONGCTX:
+        # @longctx replaces the multi-round QA workload with the
+        # prompt-length sweep (the base k/pack label still selects the
+        # decode config the answers run under)
+        return _run_longctx(label)
 
     watchdog = _arm_watchdog(
         float(os.environ.get("PST_BENCH_RUN_DEADLINE", "1200")),

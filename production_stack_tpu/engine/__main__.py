@@ -139,6 +139,15 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="enable_prefix_caching", action="store_false")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1)
+    p.add_argument("--context-parallel-size", type=int, default=0,
+                   help="sp mesh axis for the long-prefill ring "
+                   "(tp x sp devices; 0 = no ring)")
+    p.add_argument("--long-prefill-threshold", type=int, default=None,
+                   help="prompts whose uncached remainder exceeds this "
+                   "many tokens run as context-parallel ring prefill "
+                   "(requires --context-parallel-size > 1)")
+    p.add_argument("--long-prefill-chunk", type=int, default=2048,
+                   help="ring prefill chunk length in tokens")
     p.add_argument("--enable-lora", action="store_true")
     p.add_argument("--max-loras", type=int, default=4)
     p.add_argument("--enable-sleep-mode", action="store_true",
@@ -261,6 +270,9 @@ def config_from_args(args: argparse.Namespace) -> EngineConfig:
         enable_prefix_caching=args.enable_prefix_caching,
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
+        context_parallel_size=args.context_parallel_size,
+        long_prefill_threshold=args.long_prefill_threshold,
+        long_prefill_chunk=args.long_prefill_chunk,
         multihost=args.multihost,
         served_model_name=args.served_model_name,
         enable_lora=args.enable_lora,

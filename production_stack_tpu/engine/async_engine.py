@@ -220,6 +220,12 @@ class AsyncLLMEngine:
         return self.engine.timeline
 
     @property
+    def long_prefill(self):
+        """Long-prefill ring manager (None = lane off) — the server's
+        /v1/models card advertises sp capability from it."""
+        return self.engine.long_prefill
+
+    @property
     def tracer(self):
         """Engine-side span tracer (tracing.RequestTracer)."""
         return self.engine.tracer

@@ -163,6 +163,29 @@ class EngineConfig:
     ngram_prompt_lookup_max: int = 3
     ngram_prompt_lookup_min: int = 1
 
+    # long-context serving (context-parallel ring prefill,
+    # engine/long_prefill.py): a prompt whose UNCACHED remainder
+    # exceeds this many tokens leaves the chunked-prefill lane and runs
+    # as sp-sharded ring chunks on a ("tp", "sp") mesh
+    # (parallel/long_context.py), its layer-stacked KV landing in the
+    # paged cache through the PR 4 donated-import primitives — decode
+    # afterwards is the normal paged path, tokens bit-identical to a
+    # chunked-prefill control (tests/test_long_context_serving.py).
+    # The long lane never blocks ragged/decode rounds for other users:
+    # one enqueue-only chunk dispatch (plus at most one landed block
+    # batch) per engine step. None = off. Requires
+    # context_parallel_size > 1; single-process engines only (multihost
+    # and pipeline-parallel engines always serve chunked).
+    long_prefill_threshold: int | None = None
+    # ring chunk length in tokens (rounded up to a multiple of the ring
+    # size and the KV block size); the padded sequence ladder is
+    # chunk x pow2, so program variants stay O(log max_model_len)
+    long_prefill_chunk: int = 2048
+    # sp mesh axis size for the ring (0/1 = no sp mesh). The ring uses
+    # tensor_parallel_size x context_parallel_size devices, preferring
+    # devices past the serving one(s) when the host has spares.
+    context_parallel_size: int = 0
+
     # parallelism (tensor-parallel size over the ICI mesh)
     tensor_parallel_size: int = 1
     # pipeline parallelism: layers (and their KV) shard over a pp mesh
@@ -254,6 +277,18 @@ class EngineConfig:
     kv_restore_wait_s: float = 2.0
 
     def __post_init__(self) -> None:
+        if self.long_prefill_threshold is not None:
+            if self.long_prefill_threshold <= 0:
+                raise ValueError(
+                    "long_prefill_threshold must be positive (None "
+                    "disables the long-prefill lane)"
+                )
+            if self.context_parallel_size <= 1:
+                raise ValueError(
+                    "long_prefill_threshold requires "
+                    "context_parallel_size > 1 (the ring needs an sp "
+                    "mesh axis)"
+                )
         if self.scheduling_policy not in ("fcfs", "priority"):
             raise ValueError(
                 "scheduling_policy must be 'fcfs' or 'priority'"

@@ -216,6 +216,42 @@ class LLMEngine:
         self._ragged_prefill_lanes_total = 0
         self._ragged_decode_lanes_total = 0
         self._ragged_lane_mix_hist: dict[str, int] = {}
+        # long-prefill lane (context-parallel ring prefill,
+        # engine/long_prefill.py): prompts past long_prefill_threshold
+        # ring on a ("tp", "sp") mesh while decode/ragged rounds keep
+        # running, and their KV lands through the PR 4 import
+        # primitives. Multihost and pipeline-parallel engines are out
+        # (the ring manager drives single-process device enqueues); a
+        # host without tp*sp devices degrades loudly to chunked-only.
+        self.long_prefill = None
+        if (
+            config.long_prefill_threshold is not None
+            and config.context_parallel_size > 1
+            and not config.multihost
+            and config.pipeline_parallel_size == 1
+        ):
+            from production_stack_tpu.engine.long_prefill import (
+                LongPrefillManager,
+            )
+
+            try:
+                self.long_prefill = LongPrefillManager(
+                    self.runner,
+                    chunk_tokens=config.long_prefill_chunk,
+                )
+            except Exception as e:  # noqa: BLE001 — not enough devices
+                # for the ring mesh, or a mesh build failure: serve
+                # every prompt chunked instead of refusing to boot
+                logger.warning(
+                    "long-prefill lane DISABLED (%s); prompts past "
+                    "%d tokens will serve via chunked prefill",
+                    e, config.long_prefill_threshold,
+                )
+            else:
+                self.scheduler.config.long_prefill_threshold = (
+                    config.long_prefill_threshold
+                )
+                self.scheduler.long_prefill = self._begin_long_prefill
         # speculative decoding works under multihost too: verify_batch
         # is part of the broadcast protocol (multihost_engine.py), so
         # followers replay the same packed verify host 0 dispatches
@@ -302,6 +338,17 @@ class LLMEngine:
         self._kv_restore_bytes_total = 0
         self._kv_restore_fallbacks_total = 0
         self._kv_export_sync_fallbacks_total = 0
+        # wall seconds spent in SYNCHRONOUS tier exports (backlog-cap
+        # degradations + --sync-kv-offload): the overflow-export slice
+        # of a long prefill's TTFT attribution reads the delta of this
+        # + the worker-side export seconds over the job's lifetime
+        self._kv_export_sync_seconds_total = 0.0
+        # high-water anchor for that attribution: overlapping long
+        # jobs must not each claim the SAME export seconds (the
+        # cumulative tpu:prefill_overflow_export_seconds would outgrow
+        # the actual export wall) — each finalize claims only the
+        # window past the last claim
+        self._long_overflow_anchor = 0.0
         if self.offload is not None and (
             self.offload.tiers or self.offload.remote is not None
         ):
@@ -344,6 +391,7 @@ class LLMEngine:
         slicing."""
         if not pairs:
             return
+        t0 = time.monotonic()
         data = self.runner.export_blocks([bid for bid, _ in pairs])
         # per-block contiguous copies: a view of the batched export array
         # would pin the WHOLE export alive in the CPU tier until every
@@ -354,6 +402,7 @@ class LLMEngine:
                 for i, (_, h) in enumerate(pairs)
             ]
         )
+        self._kv_export_sync_seconds_total += time.monotonic() - t0
 
     def _queue_freed_exports(self, pairs: list[tuple[int, int]]) -> None:
         """Deferred export (the zero-stall path): freed-but-cached
@@ -914,6 +963,118 @@ class LLMEngine:
                 break
         return exp, rst
 
+    # -- long-prefill lane (context-parallel ring prefill) ------------------
+    def _begin_long_prefill(self, seq: Sequence) -> bool:
+        """Scheduler admission hook: claim an admitted long prompt for
+        the ring lane. Declines (-> chunked path) for adapter requests
+        (the ring runs base weights only) and prompt_logprobs (the ring
+        fetches only the final row's logits)."""
+        mgr = self.long_prefill
+        if mgr is None:
+            return False
+        if seq.lora_name is not None:
+            return False
+        if seq.sampling_params.prompt_logprobs is not None:
+            return False
+        # anchor for the overflow-export attribution: tier-export
+        # seconds that accrue while this job is in flight are the HBM
+        # headroom the landed chain displaced
+        export_s0 = (
+            self._kv_export_seconds_total
+            + self._kv_export_sync_seconds_total
+        )
+        if not mgr.start(seq, export_s0=export_s0):
+            return False
+        seq.long_prefill_active = True
+        if seq.metrics.first_scheduled_time is None:
+            seq.metrics.first_scheduled_time = time.time()
+        return True
+
+    def _advance_long_prefills(self) -> tuple[list[Sequence], bool]:
+        """One engine step's worth of long-prefill progress (chunk
+        dispatch / batch landing — see LongPrefillManager.advance) plus
+        finalization of completed jobs: the sequence's chain is fully
+        landed in the paged cache, so sample its first token host-side
+        and hand it to the normal decode path. Returns (stepped
+        sequences, progressed)."""
+        mgr = self.long_prefill
+        done, failed, progressed = mgr.advance()
+        stepped: list[Sequence] = []
+        for rec in failed:
+            seq = rec["seq"]
+            if seq.request_id in self._seqs and not seq.finished:
+                # the block table is already allocated; the chunked
+                # planners pick the sequence up next schedule()
+                seq.long_prefill_active = False
+                logger.warning(
+                    "long prefill failed for %s; serving via chunked "
+                    "prefill", seq.request_id,
+                )
+        for rec in done:
+            seq = rec["seq"]
+            if (
+                seq.finished
+                or seq.request_id not in self._seqs
+                or not seq.long_prefill_active
+            ):
+                continue  # aborted/preempted while the last batch landed
+            seq.long_prefill_active = False
+            new_tokens = seq.num_prompt_tokens - seq.num_computed_tokens
+            seq.num_computed_tokens = seq.num_prompt_tokens
+            self._prompt_tokens_total += max(0, new_tokens)
+            export_now = (
+                self._kv_export_seconds_total
+                + self._kv_export_sync_seconds_total
+            )
+            # claim only the export window past BOTH this job's start
+            # and the last claim — overlapping jobs share the seconds
+            # instead of each counting them (see _long_overflow_anchor)
+            anchor = max(
+                rec.get("export_s0", export_now),
+                self._long_overflow_anchor,
+            )
+            overflow_s = max(0.0, export_now - anchor)
+            self._long_overflow_anchor = export_now
+            mgr.phase_s["overflow"] += overflow_s
+            # first token: host-sampled from the ring's final-row
+            # logits (the same host path post-preemption penalty
+            # finals take in _run_prefill_works)
+            sampled, used_logits = self._sample(
+                [seq], rec["logits"][None], return_logits=True
+            )
+            entry = None
+            n_lp = seq.sampling_params.logprobs
+            if n_lp is not None:
+                entry = self._host_logprob_entry(
+                    np.asarray(used_logits)[0], int(sampled[0]), n_lp
+                )
+            if self._tl_enabled:
+                self.timeline.event(
+                    seq.request_id, "long_prefill",
+                    {
+                        "prompt_tokens": rec["n"],
+                        "chunk_tokens": mgr.chunk,
+                        "chunks": rec["ring_end"] // mgr.chunk,
+                        "blocks_landed": rec["landed_blocks"],
+                        "cached_prompt_tokens": (
+                            rec["start_block"] * mgr.block_size
+                        ),
+                        "ring_s": round(rec["ring_s"], 6),
+                        "d2h_s": round(rec["d2h_s"], 6),
+                        "land_s": round(rec["land_s"], 6),
+                        "overflow_s": round(overflow_s, 6),
+                    },
+                )
+            self._append_token(seq, int(sampled[0]), entry)
+            stepped.append(seq)
+        return stepped, progressed
+
+    def _cancel_long_prefill(self, seq: Sequence) -> None:
+        """Drop a sequence's ring job (abort / preemption)."""
+        if self.long_prefill is not None:
+            self.long_prefill.cancel(seq.request_id)
+        seq.long_prefill_active = False
+
     # -- request lifecycle ------------------------------------------------
     def add_request(
         self,
@@ -1065,6 +1226,8 @@ class LLMEngine:
             return False
         if self._kv_restores:
             self._drop_kv_restore(request_id)
+        if self.long_prefill is not None:
+            self._cancel_long_prefill(seq)
         aborted = self.scheduler.abort(request_id)
         self.timeline.finish(request_id, "abort")
         return aborted
@@ -1173,11 +1336,14 @@ class LLMEngine:
         if self.scheduler.waiting:
             return False  # admission will change the lane set
         if self._ragged_dispatch and any(
-            not s.prefill_done for s in self.scheduler.running
+            not s.prefill_done and not s.long_prefill_active
+            for s in self.scheduler.running
         ):
             return False  # the next round is lane-typed (ragged): the
             # ragged stage covers it; a pure-decode stage would only
-            # be dropped at the next schedule()
+            # be dropped at the next schedule(). A long-lane runner is
+            # NOT a ragged lane — its ring runs outside the round, so
+            # pure-decode staging stays live under it
         if any(self._is_guided(s) for s in seqs):
             return False  # per-round DFA state re-init (see _can_chain)
         return self._reserve_next_round(seqs, k)
@@ -1361,6 +1527,15 @@ class LLMEngine:
             # while their requests sit in the waiting queue (the upload
             # then overlaps this step's compute)
             self._poll_kv_restores()
+        # long-prefill lane: advance ring chunks / KV landing BEFORE
+        # scheduling, so a job whose chain just finished landing is
+        # decode-ready in THIS round's plan (its first token rides the
+        # same step). One enqueue per job per step — never a device
+        # fetch — so the decode/ragged rounds below keep their cadence.
+        long_stepped: list[Sequence] = []
+        long_progress = True
+        if self.long_prefill is not None and self.long_prefill.active:
+            long_stepped, long_progress = self._advance_long_prefills()
         sched_out = self.scheduler.schedule()
         if sched_out.preempted or sched_out.prefills or sched_out.aborted:
             # any table free/reassignment or lane-set change invalidates
@@ -1379,6 +1554,16 @@ class LLMEngine:
             # refuse the buffer anyway, never a dispatch error
             self._ragged_staged_misses_total += 1
             self._staged_ragged = None
+        if sched_out.preempted and self.long_prefill is not None:
+            # a preempted long-lane sequence lost its block table: its
+            # ring job is stale — drop it (reset_for_recompute already
+            # cleared the lane flag). A sequence preempted AND
+            # re-admitted inside this same schedule() carries the flag
+            # again with a FRESH job (manager.start replaced the stale
+            # record) — that one must not be cancelled.
+            for seq in sched_out.preempted:
+                if not seq.long_prefill_active:
+                    self.long_prefill.cancel(seq.request_id)
         if sched_out.preempted:
             # same rule for the staged PREFILL buffer: preemption frees
             # tables that can be re-handed. (Admission ABORTS don't
@@ -1404,12 +1589,25 @@ class LLMEngine:
             else "idle"
         )
         if sched_out.is_empty:
+            if long_stepped:
+                # a long prefill finished with nothing else scheduled:
+                # emit its first-token output now
+                return self._finalize_stepped(long_stepped)
             if self._kv_restores and not self.scheduler.running:
                 # every waiting request is restore-deferred and nothing
                 # is dispatchable: yield briefly instead of pegging the
                 # step thread (and the async-engine lock) at 100%
                 # against the offload worker doing the actual fetch
                 time.sleep(0.001)
+            elif (
+                self.long_prefill is not None
+                and self.long_prefill.active
+                and not long_progress
+            ):
+                # only long-prefill work exists and it is waiting on
+                # the materialization worker: yield instead of pegging
+                # the step thread against the worker's d2h
+                time.sleep(0.0005)
             return []
 
         outputs: list[RequestOutput] = []
@@ -1422,7 +1620,7 @@ class LLMEngine:
                 self._drop_kv_restore(seq.request_id)
             self.timeline.finish(seq.request_id, seq.finish_reason)
 
-        stepped: list[Sequence] = []
+        stepped: list[Sequence] = list(long_stepped)
         if sched_out.is_ragged:
             # unified ragged dispatch: prefill-chunk lanes + the decode
             # batch in ONE lane-typed device round (split execution for
@@ -1475,6 +1673,15 @@ class LLMEngine:
                 self._run_decode_round(seqs, sched_out.decode.k)
             )
 
+        if long_stepped and len(stepped) > len(long_stepped):
+            # a just-finalized long prefill may ALSO have ridden this
+            # round's decode batch (its first token made it
+            # decode-ready before schedule()): finalize it once
+            seen: set[int] = set()
+            stepped = [
+                s for s in stepped
+                if not (id(s) in seen or seen.add(id(s)))
+            ]
         outputs.extend(self._finalize_stepped(stepped))
         return outputs
 
@@ -3302,6 +3509,8 @@ class LLMEngine:
     def shutdown(self) -> None:
         if hasattr(self.runner, "shutdown_followers"):
             self.runner.shutdown_followers()
+        if self.long_prefill is not None:
+            self.long_prefill.close()
         if self.offload is not None:
             self.offload.close()  # also closes the PD PeerTier
         if self.kv_reporter is not None:
@@ -3359,6 +3568,34 @@ class LLMEngine:
             prefill_staged_hits_total=self._pf_staged_hits_total,
             prefill_staged_misses_total=self._pf_staged_misses_total,
             prefill_chained_chunks_total=self._pf_chained_chunks_total,
+            long_prefill_requests_total=(
+                self.long_prefill.requests_total
+                if self.long_prefill is not None else 0
+            ),
+            long_prefill_chunks_total=(
+                self.long_prefill.chunks_total
+                if self.long_prefill is not None else 0
+            ),
+            long_prefill_fallbacks_total=(
+                self.long_prefill.fallbacks_total
+                if self.long_prefill is not None else 0
+            ),
+            long_prefill_ring_seconds_total=(
+                self.long_prefill.phase_s["ring"]
+                if self.long_prefill is not None else 0.0
+            ),
+            long_prefill_d2h_seconds_total=(
+                self.long_prefill.phase_s["d2h"]
+                if self.long_prefill is not None else 0.0
+            ),
+            long_prefill_land_seconds_total=(
+                self.long_prefill.phase_s["land"]
+                if self.long_prefill is not None else 0.0
+            ),
+            long_prefill_overflow_seconds_total=(
+                self.long_prefill.phase_s["overflow"]
+                if self.long_prefill is not None else 0.0
+            ),
             decode_rounds_total=self._decode_rounds_total,
             decode_overshoot_tokens_total=(
                 self._decode_overshoot_tokens_total

@@ -119,6 +119,47 @@ class EngineMetrics:
             "Prefill chunks dispatched via cold-prompt chaining "
             "(no host round-trip between chunks)", label, registry=reg,
         )
+        # long-prefill lane (context-parallel ring prefill): per-phase
+        # TTFT attribution for prompts served by the sp-sharded ring —
+        # ring compute, device->host KV materialization, paged-cache
+        # landing, and the tier-export overflow that ran under the job
+        self.long_prefill_requests = Counter(
+            "tpu:long_prefill_requests",
+            "Prompts served via the context-parallel ring prefill lane",
+            label, registry=reg,
+        )
+        self.long_prefill_chunks = Counter(
+            "tpu:long_prefill_chunks",
+            "Ring prefill chunk dispatches", label, registry=reg,
+        )
+        self.long_prefill_fallbacks = Counter(
+            "tpu:long_prefill_fallbacks",
+            "Long prefills that failed back to chunked prefill",
+            label, registry=reg,
+        )
+        self.prefill_ring_s = Counter(
+            "tpu:prefill_ring_seconds",
+            "Long-prefill ring compute wall time (job start -> ring "
+            "drained; overlaps other users' decode rounds)",
+            label, registry=reg,
+        )
+        self.prefill_ring_d2h_s = Counter(
+            "tpu:prefill_ring_d2h_seconds",
+            "Long-prefill device->host KV materialization wall time "
+            "(on the long-prefill worker)", label, registry=reg,
+        )
+        self.prefill_kv_land_s = Counter(
+            "tpu:prefill_kv_land_seconds",
+            "Long-prefill paged-cache landing wall time (first parked "
+            "batch -> last donated import enqueued)",
+            label, registry=reg,
+        )
+        self.prefill_overflow_export_s = Counter(
+            "tpu:prefill_overflow_export_seconds",
+            "Tier-export seconds attributed to in-flight long prefills "
+            "(HBM headroom the landed chain displaced)",
+            label, registry=reg,
+        )
         # zero-stall KV tiering (PR 4): deferred-export batch wall time
         # (measured ON THE OFFLOAD WORKER — overlapped activity, never a
         # step-loop stall), staged-restore enqueue->landed time, and
@@ -379,6 +420,27 @@ class EngineMetrics:
         self.prefill_chained_chunks.labels(m).inc(max(
             0, s.prefill_chained_chunks_total
             - prev.prefill_chained_chunks_total))
+        self.long_prefill_requests.labels(m).inc(max(
+            0, s.long_prefill_requests_total
+            - prev.long_prefill_requests_total))
+        self.long_prefill_chunks.labels(m).inc(max(
+            0, s.long_prefill_chunks_total
+            - prev.long_prefill_chunks_total))
+        self.long_prefill_fallbacks.labels(m).inc(max(
+            0, s.long_prefill_fallbacks_total
+            - prev.long_prefill_fallbacks_total))
+        self.prefill_ring_s.labels(m).inc(max(
+            0.0, s.long_prefill_ring_seconds_total
+            - prev.long_prefill_ring_seconds_total))
+        self.prefill_ring_d2h_s.labels(m).inc(max(
+            0.0, s.long_prefill_d2h_seconds_total
+            - prev.long_prefill_d2h_seconds_total))
+        self.prefill_kv_land_s.labels(m).inc(max(
+            0.0, s.long_prefill_land_seconds_total
+            - prev.long_prefill_land_seconds_total))
+        self.prefill_overflow_export_s.labels(m).inc(max(
+            0.0, s.long_prefill_overflow_seconds_total
+            - prev.long_prefill_overflow_seconds_total))
         self.decode_rounds.labels(m).inc(max(
             0, s.decode_rounds_total - prev.decode_rounds_total))
         self.decode_overshoot.labels(m).inc(max(

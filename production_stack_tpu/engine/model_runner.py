@@ -4037,3 +4037,43 @@ class ModelRunner:
         self.import_staged_blocks(
             block_ids, handle, list(range(len(block_ids)))
         )
+
+    # -- long-context ring prefill (engine/long_prefill.py) ----------------
+    def build_long_prefiller(self):
+        """Construct the ("tp", "sp") ring prefiller for the long-
+        prefill lane: tp matches the serving tensor-parallel size, sp =
+        EngineConfig.context_parallel_size. The ring mesh prefers
+        devices PAST the serving one(s) when the host has spares, so
+        ring compute does not queue behind decode dispatches on the
+        serving chip; with exactly tp*sp devices it shares them. The
+        prefiller holds its own (re-placed) copy of the weights — the
+        memory price of running two meshes, stated in tutorial 18.
+        Raises when the host lacks tp*sp devices (the engine then
+        serves long prompts on the chunked path)."""
+        from production_stack_tpu.parallel.long_context import (
+            LongContextPrefiller,
+            make_sp_mesh,
+        )
+
+        cfg = self.config
+        sp = cfg.context_parallel_size
+        tp = max(1, cfg.tensor_parallel_size)
+        if sp <= 1:
+            raise ValueError("context_parallel_size must be > 1")
+        devs = jax.devices()
+        need = tp * sp
+        serving = self.mesh.size if self.mesh is not None else 1
+        if len(devs) >= serving + need:
+            pool = devs[serving: serving + need]
+        elif len(devs) >= need:
+            pool = devs[:need]
+        else:
+            raise ValueError(
+                f"context_parallel_size={sp} x tp={tp} needs {need} "
+                f"devices; host has {len(devs)}"
+            )
+        mesh = make_sp_mesh(tp, sp, devices=pool)
+        return LongContextPrefiller(
+            self.model_config, self.params, mesh,
+            cache_dtype=self.cache_dtype,
+        )

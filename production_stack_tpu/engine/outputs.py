@@ -57,6 +57,21 @@ class EngineStatsSnapshot:
     prefill_staged_hits_total: int = 0
     prefill_staged_misses_total: int = 0
     prefill_chained_chunks_total: int = 0
+    # long-prefill lane (context-parallel ring prefill, engine/
+    # long_prefill.py): requests served by the ring, ring chunks
+    # dispatched, ring failures that fell back to chunked prefill, and
+    # the per-phase TTFT attribution — ring compute, device->host KV
+    # materialization, paged-cache landing, and tier-export overflow
+    # seconds that ran while long jobs were in flight —
+    # tpu:prefill_ring/d2h/land/overflow_* in /metrics and the bench
+    # `long_prefill` detail slot
+    long_prefill_requests_total: int = 0
+    long_prefill_chunks_total: int = 0
+    long_prefill_fallbacks_total: int = 0
+    long_prefill_ring_seconds_total: float = 0.0
+    long_prefill_d2h_seconds_total: float = 0.0
+    long_prefill_land_seconds_total: float = 0.0
+    long_prefill_overflow_seconds_total: float = 0.0
     # elastic fused decode: rounds dispatched, sampled-then-discarded
     # overshoot tokens (~0 with device stops, except host-resolved stop
     # strings), and whole-round device early exits — tpu:decode_* in

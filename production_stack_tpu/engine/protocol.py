@@ -277,6 +277,8 @@ def model_card(
     name: str, root: str | None = None,
     kv_instance_id: str | None = None,
     kv_role: str | None = None,
+    max_model_len: int | None = None,
+    sp_size: int | None = None,
 ) -> dict:
     card = {
         "id": name,
@@ -285,9 +287,17 @@ def model_card(
         "owned_by": "production-stack-tpu",
         "root": root or name,
         "parent": None,
-        "max_model_len": None,
+        # the engine's admitted context window: the router's
+        # context-window filter skips backends whose window is smaller
+        # than the prompt and 413s when no backend qualifies
+        "max_model_len": max_model_len,
         "permission": [],
     }
+    if sp_size:
+        # long-prefill capability: the ring's sp mesh axis size (the
+        # engine serves 64k-128k prompts as context-parallel ring
+        # prefill rather than one-chip chunked prefill)
+        card["sp_size"] = sp_size
     if kv_instance_id is not None:
         # advertised so the router's kvaware/ttft logic can map KV
         # controller matches to this endpoint without relying on the

@@ -149,6 +149,14 @@ class SchedulerConfig:
     # dissolves the interleave streak and the admission-K clamp for
     # in-round prefill work (plan_ragged_round / pick_decode_k)
     ragged_dispatch: bool = False
+    # long-prefill lane (EngineConfig.long_prefill_threshold, set by
+    # the engine only when its ring manager actually built): an
+    # admitted prompt whose uncached remainder exceeds this many
+    # tokens is handed to the `long_prefill` hook instead of the
+    # chunked lanes — the engine drives its ring chunks and KV landing
+    # itself, one enqueue per step, so decode/ragged rounds for other
+    # users keep running. 0 = off.
+    long_prefill_threshold: int = 0
 
 
 def decode_k_buckets(cap: int, adaptive: bool) -> list[int]:
@@ -213,6 +221,15 @@ class Scheduler:
         # snapshot is device-ordered before any later dispatch's
         # writes), so calling it mid-schedule costs no stall.
         self.kv_flush = None
+        # optional hook (LLMEngine._begin_long_prefill): claim an
+        # admitted sequence for the long-prefill lane (context-parallel
+        # ring prefill). The hook marks seq.long_prefill_active and
+        # returns truthy when it takes the sequence; a declined
+        # sequence (LoRA, prompt_logprobs, ring unavailable) serves on
+        # the ordinary chunked lanes. Long-lane sequences are skipped
+        # by BOTH prefill planners below — the engine drives their
+        # chunks outside schedule().
+        self.long_prefill = None
         # optional request-lifecycle recorder (tracing.TimelineRecorder,
         # set by LLMEngine): admit/resume/preempt events for the
         # per-request timeline; None/disabled costs one check
@@ -342,6 +359,27 @@ class Scheduler:
             self.waiting.popleft()
             self.running.append(seq)
             self._note_admitted(seq)
+            if (
+                self.long_prefill is not None
+                and self.config.long_prefill_threshold > 0
+                and seq.num_uncomputed_prompt_tokens
+                > self.config.long_prefill_threshold
+            ):
+                # long-prefill lane: the ring prefill computes this
+                # prompt off the chunked path (admission still gated
+                # the FULL chain's block allocation above — a prompt
+                # the pool cannot hold was rejected/deferred, the
+                # cluster-level gate is the router's context-window
+                # filter on the /v1/models card)
+                try:
+                    self.long_prefill(seq)
+                except Exception:  # noqa: BLE001 — the claim is
+                    # best-effort: a ring failure must never kill the
+                    # step loop; the chunked planners serve the prompt
+                    logger.exception(
+                        "long-prefill claim failed for %s; serving "
+                        "via chunked prefill", seq.request_id,
+                    )
         # priority policy: a waiting higher-priority request CLAIMS a
         # lane from a running lower-priority one (vLLM preempts for
         # priority, not just for block exhaustion) — without this,
@@ -402,7 +440,9 @@ class Scheduler:
                 else 1
             )
             for seq in self.running:
-                if seq.prefill_done:
+                if seq.prefill_done or seq.long_prefill_active:
+                    # long-lane sequences ring outside schedule(); a
+                    # chunked dispatch for them would double-compute
                     continue
                 if len(out.prefills) >= group_cap:
                     break
@@ -516,7 +556,9 @@ class Scheduler:
             else 1
         )
         for seq in self.running:
-            if seq.prefill_done or seq.finished:
+            if seq.prefill_done or seq.finished or seq.long_prefill_active:
+                # long-lane sequences never claim a ragged prefill lane
+                # (the engine rings them one enqueue per step)
                 continue
             if len(out.prefills) >= group_cap:
                 break
@@ -571,8 +613,13 @@ class Scheduler:
             if self.waiting:
                 k = min(k, self.ADMISSION_K_CLAMP)
         elif self.waiting or any(
-            not s.prefill_done for s in self.running
+            not s.prefill_done and not s.long_prefill_active
+            for s in self.running
         ):
+            # a long-lane runner is mid-prefill for SECONDS (the whole
+            # ring) and advances one enqueue per step regardless of K —
+            # clamping every decode round under it was exactly the
+            # starvation the lane exists to avoid
             k = min(k, self.ADMISSION_K_CLAMP)
         rem = 0
         mml = self.config.max_model_len

@@ -96,6 +96,11 @@ class Sequence:
         self.block_table: list[int] = []
         # tokens whose K/V are already in the cache (prefix-cache hits count)
         self.num_computed_tokens = 0
+        # long-prefill lane (engine/long_prefill.py): True while the
+        # context-parallel ring computes this prompt — the scheduler's
+        # chunked-prefill planners skip the sequence and the engine
+        # drives its ring chunks + KV landing outside schedule()
+        self.long_prefill_active = False
 
         # incremental prefix-cache hashing state (chain hashes of the
         # sequence's full blocks registered so far)
@@ -193,6 +198,7 @@ class Sequence:
         self.num_computed_tokens = 0
         self.block_table = []
         self.block_hashes = []
+        self.long_prefill_active = False
         self.status = SequenceStatus.PREEMPTED
         self.metrics.num_preemptions += 1
         self.metrics.last_preempt_time = time.time()

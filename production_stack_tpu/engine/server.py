@@ -1160,6 +1160,12 @@ class EngineServer:
             self.model_name,
             kv_instance_id=self.config.kv_instance_id,
             kv_role=self.config.pd_role(),
+            max_model_len=self.config.resolved_max_model_len(),
+            sp_size=(
+                self.config.context_parallel_size
+                if getattr(self.engine, "long_prefill", None) is not None
+                else None
+            ),
         )]
         cards += [
             proto.model_card(name, root=path)

@@ -121,6 +121,17 @@ TINY_CTX1K_DEBUG = _register(
     )
 )
 
+# tiny widths with a LONG logical context: CPU tests drive the
+# long-prefill ring lane (tests/test_long_context_serving.py), deep
+# logical chains, and the tier-overflow path without big-model compute
+TINY_CTX64K_DEBUG = _register(
+    dataclasses.replace(
+        TINY_DEBUG,
+        name="pst-tiny-ctx64k-debug",
+        max_model_len=65536,
+    )
+)
+
 TINY_MOE_DEBUG = _register(
     dataclasses.replace(
         TINY_DEBUG,

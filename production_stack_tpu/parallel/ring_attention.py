@@ -73,15 +73,26 @@ def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    q_offset: jax.Array | int | None = None,
     *,
     axis_name: str = SP_AXIS,
     causal: bool = True,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-chip body: call inside shard_map with seq sharded on axis_name.
 
     q: [b, lq, h, d]; k, v: [b, lk, hk, d] (local blocks). Returns
     [b, lq, h, d] attention output for the local query block, in q.dtype.
+
+    `q_offset` (optional, traced) shifts the query blocks' GLOBAL
+    positions: chunked long-context prefill runs a [start, start+C)
+    query slice against the full-sequence KV cache, so the causal mask
+    must compare start-relative query rows to absolute key rows. None =
+    the classic full-sequence ring (q and kv cover the same span).
+    `window` applies HF sliding-window semantics (keys j with
+    q_pos - window < j <= q_pos — ops/attention.py), so the ring
+    reproduces what the engine's windowed prefill computes.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -90,6 +101,8 @@ def ring_attention_local(
     me = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     qpos = me * lq + lax.iota(jnp.int32, lq)
+    if q_offset is not None:
+        qpos = qpos + q_offset
 
     # derive the accumulators from q so they carry q's varying-axis type
     # (works for any enclosing mesh: plain sp ring or 2D tp x sp); fresh
@@ -107,6 +120,8 @@ def ring_attention_local(
         if causal:
             kpos = src * lk + lax.iota(jnp.int32, lk)
             mask = qpos[:, None] >= kpos[None, :]
+            if window is not None:
+                mask = mask & (kpos[None, :] > qpos[:, None] - window)
             s = jnp.where(mask[None, None], s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1))
         # rows with every position masked so far keep m == -inf; exp(s - m)
@@ -164,7 +179,7 @@ def ring_attention(
 
 def attention_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
-    scale: float | None = None,
+    scale: float | None = None, window: int | None = None,
 ) -> jax.Array:
     """Unsharded oracle for tests: plain softmax attention with GQA."""
     d = q.shape[-1]
@@ -172,9 +187,11 @@ def attention_reference(
     s = _grouped_scores(q, k) * scale
     if causal:
         n, lk = q.shape[1], k.shape[1]
-        mask = lax.iota(jnp.int32, n)[:, None] >= lax.iota(
-            jnp.int32, lk
-        )[None, :]
+        qpos = lax.iota(jnp.int32, n)[:, None]
+        kpos = lax.iota(jnp.int32, lk)[None, :]
+        mask = qpos >= kpos
+        if window is not None:
+            mask = mask & (kpos > qpos - window)
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return _grouped_values(p, v).astype(q.dtype)

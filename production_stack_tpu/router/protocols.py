@@ -59,6 +59,15 @@ class EndpointInfo:
     # kvaware/ttft routing match KV controller results on it (falling
     # back to the id == host:port convention when absent)
     kv_instance_id: str | None = None
+    # the engine's admitted context window (resolved_max_model_len on
+    # its /v1/models card): the router-wide context filter skips
+    # backends whose window is smaller than the prompt's token count
+    # and 413s when no backend qualifies. None (card absent / old
+    # engine) = unknown — never filtered out.
+    max_model_len: int | None = None
+    # long-prefill capability: the engine's context-parallel ring size
+    # (sp mesh axis) when its long-prefill lane is live
+    sp_size: int | None = None
     added_timestamp: float = field(default_factory=time.time)
     sleep: bool = False
     pod_name: str | None = None

@@ -68,13 +68,20 @@ class ServiceDiscovery(abc.ABC):
 
 async def _probe_endpoint(
     url: str, timeout_s: float = 5.0
-) -> tuple[list[str], dict[str, ModelInfo], str | None, str | None] | None:
+) -> tuple[
+    list[str], dict[str, ModelInfo], str | None, str | None,
+    int | None, int | None,
+] | None:
     """GET <url>/v1/models; returns (model_names, model_info,
-    kv_instance_id, kv_role) or None. The kv instance id is the
-    engine-advertised card metadata that lets kvaware routing map
-    controller matches to this endpoint without the id == host:port
-    convention; kv_role (prefill/decode/both) labels the endpoint for
-    the `pd` routing policy without k8s label plumbing."""
+    kv_instance_id, kv_role, max_model_len, sp_size) or None. The kv
+    instance id is the engine-advertised card metadata that lets
+    kvaware routing map controller matches to this endpoint without
+    the id == host:port convention; kv_role (prefill/decode/both)
+    labels the endpoint for the `pd` routing policy without k8s label
+    plumbing; max_model_len is the engine's admitted context window
+    (the router's context-window filter skips too-small backends and
+    413s oversized prompts); sp_size advertises the long-prefill
+    ring's context-parallel capability."""
     try:
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout_s)
@@ -87,6 +94,7 @@ async def _probe_endpoint(
         logger.debug("model probe failed for %s: %s", url, e)
         return None
     names, info, kv_iid, kv_role = [], {}, None, None
+    max_len, sp_size = None, None
     for card in data.get("data", []):
         mi = ModelInfo.from_dict(card)
         names.append(mi.id)
@@ -97,7 +105,13 @@ async def _probe_endpoint(
             "prefill", "decode", "both"
         ):
             kv_role = card["kv_role"]
-    return names, info, kv_iid, kv_role
+        if max_len is None and isinstance(
+            card.get("max_model_len"), int
+        ):
+            max_len = card["max_model_len"]
+        if sp_size is None and isinstance(card.get("sp_size"), int):
+            sp_size = card["sp_size"]
+    return names, info, kv_iid, kv_role, max_len, sp_size
 
 
 async def _probe_sleep(url: str, timeout_s: float = 3.0) -> bool:
@@ -181,6 +195,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 ep.model_names, ep.model_info = probed[0], probed[1]
             ep.kv_instance_id = probed[2]
             ep.pd_role = probed[3]
+            ep.max_model_len = probed[4]
+            ep.sp_size = probed[5]
 
         await asyncio.gather(
             *(_probe_into(ep) for ep in self._endpoints)
@@ -317,7 +333,7 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
         probed = await _probe_endpoint(url)
         if probed is None:
             return
-        names, info, kv_iid, kv_role = probed
+        names, info, kv_iid, kv_role, max_len, sp_size = probed
         sleeping = await _probe_sleep(url)
         async with self._lock:
             self._endpoints[pod_name] = EndpointInfo(
@@ -327,6 +343,8 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                 model_label=model_label,
                 pd_role=kv_role,
                 kv_instance_id=kv_iid,
+                max_model_len=max_len,
+                sp_size=sp_size,
                 sleep=sleeping,
                 pod_name=pod_name,
                 namespace=self.namespace,
@@ -357,6 +375,8 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
                         e.model_names, e.model_info = probed[0], probed[1]
                         e.kv_instance_id = probed[2]
                         e.pd_role = probed[3]
+                        e.max_model_len = probed[4]
+                        e.sp_size = probed[5]
                         e.sleep = sleeping
 
     def get_endpoint_info(self) -> list[EndpointInfo]:
@@ -423,7 +443,7 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
             probed = await _probe_endpoint(url)
             if probed is None:
                 continue
-            names, info, kv_iid, kv_role = probed
+            names, info, kv_iid, kv_role, max_len, sp_size = probed
             label = (
                 svc.get("metadata", {}).get("labels", {}).get("model")
             )
@@ -431,6 +451,7 @@ class K8sServiceNameServiceDiscovery(ServiceDiscovery):
                 url=url, model_names=names, model_info=info,
                 model_label=label, pd_role=kv_role, pod_name=name,
                 namespace=self.namespace, kv_instance_id=kv_iid,
+                max_model_len=max_len, sp_size=sp_size,
             )
 
     def get_endpoint_info(self) -> list[EndpointInfo]:

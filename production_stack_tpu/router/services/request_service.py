@@ -191,6 +191,45 @@ class RequestService:
                 serving.append(e)
         return serving, resolved
 
+    @staticmethod
+    def _context_window_filter(
+        candidates: list[EndpointInfo], body: dict
+    ) -> tuple[list[EndpointInfo], web.Response | None]:
+        """Skip backends whose advertised context window
+        (EndpointInfo.max_model_len, from the /v1/models card) is
+        smaller than the prompt's token count — an oversized prompt
+        must not burn a routing pick only to 400 at the engine. When NO
+        backend qualifies, returns a 413 naming the cluster's max
+        admitted context instead of letting the request fail opaquely
+        downstream. Backends without a card window (None) are never
+        filtered; the estimate is a deliberate lower bound
+        (estimate_prompt_tokens), so borderline prompts pass through
+        to the engine's own gate."""
+        est = _estimate_prompt_tokens(body)
+        if est <= 1 or not candidates:
+            return candidates, None
+        fits = [
+            e for e in candidates
+            if e.max_model_len is None or e.max_model_len >= est
+        ]
+        if fits:
+            return fits, None
+        cluster_max = max(e.max_model_len or 0 for e in candidates)
+        return [], web.json_response(
+            {
+                "error": {
+                    "message": (
+                        f"prompt (~{est} tokens) exceeds every "
+                        "backend's context window; the cluster's max "
+                        f"admitted context is {cluster_max} tokens"
+                    ),
+                    "type": "invalid_request_error",
+                    "code": "context_length_exceeded",
+                }
+            },
+            status=413,
+        )
+
     # -- main entry (reference: request.py:141) ----------------------------
     # stackcheck: hot-path — per-request proxy entry; no blocking calls
     async def route_general_request(
@@ -242,6 +281,14 @@ class RequestService:
                            "type": "service_unavailable"}},
                 status=503,
             )
+        # context-window gate: too-small backends drop out of the pick;
+        # a prompt no backend can admit 413s HERE with the cluster max
+        # instead of failing opaquely at the chosen engine
+        candidates, too_long = self._context_window_filter(
+            candidates, body
+        )
+        if too_long is not None:
+            return too_long
 
         engine_stats = get_engine_stats_scraper().get_engine_stats()
         request_stats = get_request_stats_monitor().get_request_stats()
@@ -677,6 +724,13 @@ class RequestService:
         assert isinstance(router, (DisaggregatedPrefillRouter, PDRouter))
         endpoints = get_service_discovery().get_endpoint_info()
         endpoints = [e for e in endpoints if not e.sleep]
+        # same context-window gate as the general route: neither PD
+        # phase can serve a prompt past its backend's window
+        endpoints, too_long = self._context_window_filter(
+            endpoints, body
+        )
+        if too_long is not None:
+            return too_long
         try:
             if isinstance(router, PDRouter):
                 rr = RouterRequest(
@@ -833,15 +887,10 @@ class RequestService:
 
 
 def _estimate_prompt_tokens(body: dict) -> int:
-    """Cheap prompt-size signal for the stats monitor (~4 chars/token)."""
-    if "prompt" in body:
-        p = body["prompt"]
-        if isinstance(p, list):
-            return len(p)
-        return max(1, len(str(p)) // 4)
-    if "messages" in body:
-        total = sum(
-            len(str(m.get("content", ""))) for m in body["messages"]
-        )
-        return max(1, total // 4)
-    return 1
+    """Cheap prompt-size signal for the stats monitor and the
+    context-window filter — exact for token-id prompts, ~4 chars/token
+    (a deliberate lower bound) for text. One copy:
+    router.utils.estimate_prompt_tokens."""
+    from production_stack_tpu.router.utils import estimate_prompt_tokens
+
+    return max(1, estimate_prompt_tokens(body))

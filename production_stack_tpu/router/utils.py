@@ -110,3 +110,51 @@ async def is_model_healthy(
     except Exception as e:  # noqa: BLE001 — unreachable == unhealthy
         logger.debug("health probe failed for %s %s: %s", url, model, e)
         return False
+
+
+def estimate_prompt_tokens(body: dict) -> int:
+    """Conservative (lower-bound) token estimate for a request body's
+    prompt — the router-wide context-window filter compares it against
+    each backend's advertised `max_model_len`.
+
+    Token-id prompts (`prompt` as a list of ints, or a batch of such
+    lists) count exactly. Text prompts estimate at ~1 token per 4
+    characters — a deliberate UNDER-estimate for every real tokenizer
+    family, so a borderline prompt is never falsely 413'd at the
+    router (the engine's own max_model_len gate still applies); the
+    filter exists to reject prompts that are hopeless on every
+    backend, orders of magnitude past the window."""
+    def _text_est(t: str) -> int:
+        return len(t) // 4
+
+    p = body.get("prompt")
+    if isinstance(p, list):
+        if p and all(isinstance(t, int) for t in p):
+            return len(p)
+        # batch: the LARGEST item must fit the chosen backend
+        n = 0
+        for item in p:
+            if isinstance(item, list) and all(
+                isinstance(t, int) for t in item
+            ):
+                n = max(n, len(item))
+            elif isinstance(item, str):
+                n = max(n, _text_est(item))
+        return n
+    if isinstance(p, str):
+        return _text_est(p)
+    msgs = body.get("messages")
+    if isinstance(msgs, list):
+        total = 0
+        for m in msgs:
+            if not isinstance(m, dict):
+                continue
+            c = m.get("content", "")
+            if isinstance(c, list):
+                c = " ".join(
+                    x.get("text", "") for x in c if isinstance(x, dict)
+                )
+            if isinstance(c, str):
+                total += len(c)
+        return total // 4
+    return 0

@@ -29,8 +29,11 @@ class FakeEngine:
         model_label: str | None = None,
         engine_id: str | None = None,
         kv_instance_id: str | None = None,
+        max_model_len: int | None = None,
     ):
         self.kv_instance_id = kv_instance_id
+        # advertised context window (router context-window filter tests)
+        self.max_model_len = max_model_len
         self.model = model
         # stamped into responses as system_fingerprint so routing e2e tests
         # can measure request distribution; unique per instance by default
@@ -185,6 +188,8 @@ class FakeEngine:
                 "owned_by": "fake-engine"}
         if self.kv_instance_id is not None:
             card["kv_instance_id"] = self.kv_instance_id
+        if self.max_model_len is not None:
+            card["max_model_len"] = self.max_model_len
         return web.json_response({"object": "list", "data": [card]})
 
     async def metrics(self, request: web.Request):

@@ -1385,3 +1385,110 @@ def test_kvaware_skips_probe_when_local_match_covers_chain(monkeypatch):
     )
     assert url == "http://e1:8000"
     assert hints.lookups == 0  # probe skipped entirely
+
+
+# -- context-window filter (long-context satellite) -------------------------
+class TestContextWindowFilter:
+    """Router-wide context gate: backends whose advertised
+    max_model_len is smaller than the prompt drop out of the pick, and
+    a prompt NO backend can admit 413s with the cluster max instead of
+    failing opaquely at the chosen engine."""
+
+    def test_estimate_prompt_tokens(self):
+        from production_stack_tpu.router.utils import (
+            estimate_prompt_tokens,
+        )
+
+        assert estimate_prompt_tokens({"prompt": [1, 2, 3]}) == 3
+        # batch of token-id lists: the LARGEST item must fit
+        assert estimate_prompt_tokens(
+            {"prompt": [[1] * 10, [2] * 40]}
+        ) == 40
+        # text: conservative ~4 chars/token LOWER bound
+        assert estimate_prompt_tokens({"prompt": "x" * 400}) == 100
+        assert estimate_prompt_tokens({"messages": [
+            {"role": "user", "content": "y" * 200},
+            {"role": "user", "content": [{"text": "z" * 200}]},
+        ]}) == 100
+        assert estimate_prompt_tokens({}) == 0
+
+    def test_filter_skips_small_windows_and_413s(self):
+        from production_stack_tpu.router.services.request_service import (
+            RequestService,
+        )
+
+        eps = [
+            EndpointInfo(url="http://small", max_model_len=512),
+            EndpointInfo(url="http://big", max_model_len=8192),
+            EndpointInfo(url="http://unknown"),  # no card window
+        ]
+        body = {"prompt": [1] * 1000}
+        fits, err = RequestService._context_window_filter(eps, body)
+        assert err is None
+        assert {e.url for e in fits} == {"http://big", "http://unknown"}
+        # nothing fits -> 413 naming the cluster max
+        body = {"prompt": [1] * 10_000}
+        fits, err = RequestService._context_window_filter(
+            eps[:2], body
+        )
+        assert fits == [] and err is not None
+        assert err.status == 413
+        assert "8192" in err.text
+
+    def test_e2e_oversized_prompt_routes_and_413s(self, reset_singletons):
+        """Against live fake engines: a prompt only the big-window
+        backend admits always lands there; a prompt neither admits
+        413s at the router."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from production_stack_tpu.router.app import build_app
+
+        async def run():
+            small = FakeEngine(model="fake-model", max_model_len=512)
+            big = FakeEngine(model="fake-model", max_model_len=8192)
+            for e in (small, big):
+                await e.start()
+            args = parsers.parse_args([
+                "--service-discovery", "static",
+                "--static-backends", f"{small.url},{big.url}",
+                "--static-models", "fake-model,fake-model",
+                "--routing-logic", "roundrobin",
+            ])
+            client = TestClient(TestServer(build_app(args).app))
+            await client.start_server()
+            try:
+                for _ in range(4):
+                    r = await client.post("/v1/completions", json={
+                        "model": "fake-model",
+                        "prompt": list(range(1000)),
+                        "max_tokens": 1,
+                    })
+                    assert r.status == 200
+                # roundrobin would have split 2/2; the window filter
+                # kept every oversized-for-small prompt on `big`
+                assert len(small.requests_seen) == 0
+                assert len(big.requests_seen) == 4
+                r = await client.post("/v1/completions", json={
+                    "model": "fake-model",
+                    "prompt": list(range(10_000)),
+                    "max_tokens": 1,
+                })
+                assert r.status == 413
+                data = await r.json()
+                assert "8192" in data["error"]["message"]
+                assert data["error"]["code"] == "context_length_exceeded"
+                # short prompts still spread over both backends
+                for _ in range(4):
+                    r = await client.post("/v1/completions", json={
+                        "model": "fake-model",
+                        "prompt": [1, 2, 3],
+                        "max_tokens": 1,
+                    })
+                    assert r.status == 200
+                assert len(small.requests_seen) == 2
+            finally:
+                await client.close()
+                for e in (small, big):
+                    await e.stop()
+
+        asyncio.run(run())

@@ -711,3 +711,62 @@ def test_timeline_recording_stays_off_hot_paths():
     assert report.unsuppressed == [], "\n".join(
         f.format() for f in report.unsuppressed
     )
+
+
+def test_long_prefill_stays_off_hot_paths():
+    """Long-prefill lane (context-parallel ring prefill): the chunk
+    dispatch / token staging / batch landing that run on the engine
+    step thread (long_prefill.advance -> _dispatch_next_chunk /
+    _land_one_batch, long_context.stage_tokens / prefill_chunk) must
+    keep device syncs and blocking IO off the scheduler thread — the
+    ring wait, logits fetch, and KV d2h belong to the long-prefill
+    worker (_materialize), mirroring the kv/offload.py split. Zero
+    unsuppressed device-sync-hot + blocking-async over engine/ (now
+    including long_prefill.py) and parallel/."""
+    report = analyze_paths(
+        [
+            str(PACKAGE / "engine"),
+            str(PACKAGE / "parallel"),
+        ],
+        select=["device-sync-hot", "blocking-async"],
+    )
+    # engine/ gained long_prefill.py; parallel/ must actually be
+    # INSIDE the sweep (the ring chunk dispatch lives there)
+    assert report.files_scanned >= 29
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_long_prefill_hot_marks_present():
+    """The sweep above only bites while the long-prefill dispatch /
+    staging / landing functions carry the hot-path mark — a dropped
+    mark would pass silently. The worker-side _materialize must NOT be
+    marked: it is the sanctioned home of the blocking ring wait + KV
+    d2h."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    want = {
+        ("engine", "long_prefill.py"): {
+            "advance", "_dispatch_next_chunk", "_land_one_batch",
+        },
+        ("parallel", "long_context.py"): {
+            "stage_tokens", "prefill_chunk",
+        },
+    }
+    for (sub, fname), funcs in want.items():
+        path = PACKAGE / sub / fname
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {
+            f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)
+        }
+        missing = funcs - hot
+        assert not missing, f"{fname}: unmarked hot paths {missing}"
+        if fname == "long_prefill.py":
+            assert "_materialize" not in hot, (
+                "_materialize is the worker body (blocking by design) "
+                "and must stay unmarked"
+            )
