@@ -15,6 +15,11 @@ from aiohttp import web
 
 from production_stack_tpu import __version__
 from production_stack_tpu.router import parsers
+from production_stack_tpu.router.admission import (
+    TenantLimits,
+    get_admission_controller,
+    initialize_admission_controller,
+)
 from production_stack_tpu.router.dynamic_config import (
     initialize_dynamic_config_watcher,
 )
@@ -133,6 +138,37 @@ class RouterApp:
         initialize_engine_health_board(
             ewma_alpha=getattr(args, "health_ewma_alpha", 0.1)
         )
+        # admission control: flags set the defaults; per-tenant budgets
+        # arrive (and retune live) via the dynamic config watcher's
+        # `admission:` section
+        initialize_admission_controller(
+            enabled=getattr(args, "admission_control", True),
+            tenant_header=getattr(
+                args, "admission_tenant_header", "x-tenant-id"
+            ),
+            default_limits=TenantLimits(
+                rate=getattr(args, "admission_default_rate", 0.0),
+                burst=getattr(args, "admission_default_burst", 0.0),
+                max_concurrency=getattr(
+                    args, "admission_default_concurrency", 0
+                ),
+            ),
+            engine_inflight_target=getattr(
+                args, "admission_inflight_target", 512
+            ),
+            engine_queue_target=getattr(
+                args, "admission_queue_target", 256
+            ),
+            delay_target_s=getattr(
+                args, "admission_delay_target_s", 2.0
+            ),
+            shed_threshold=getattr(
+                args, "admission_shed_threshold", 1.0
+            ),
+            asleep_retry_s=getattr(
+                args, "admission_asleep_retry_s", 10.0
+            ),
+        )
 
         tokenizer = None
         if args.tokenizer:
@@ -226,6 +262,7 @@ class RouterApp:
         r.add_get("/metrics", self.handle_metrics)
         r.add_get("/engines", self.handle_engines)
         r.add_get("/debug/engines", self.handle_debug_engines)
+        r.add_get("/debug/admission", self.handle_debug_admission)
         r.add_get("/debug/requests", self.handle_debug_requests)
         r.add_post("/sleep", self._sleep_wake_handler)
         r.add_post("/wake_up", self._sleep_wake_handler)
@@ -281,6 +318,9 @@ class RouterApp:
                 otlp_flush_loop(self.tracer), "router-trace-flush")
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        watcher = _get_watcher()
+        if watcher is not None:
+            await watcher.close()
         if self._log_stats_task:
             self._log_stats_task.cancel()
         if self._trace_flush_task is not None:
@@ -422,6 +462,19 @@ class RouterApp:
             )
             out.append(row)
         return web.json_response({"engines": out})
+
+    async def handle_debug_admission(
+        self, request: web.Request
+    ) -> web.Response:
+        """Admission-control introspection: the live cluster load
+        signals (per-engine in-flight / queue depth / scheduling
+        delay, sleeping exclusions), the configured thresholds +
+        priority ladder, and every tenant's budget state (bucket fill,
+        in-flight, shed totals by reason). The operator-side view of
+        every 429 the router returns."""
+        return web.json_response(
+            get_admission_controller().snapshot(detail=True)
+        )
 
     async def handle_debug_requests(
         self, request: web.Request

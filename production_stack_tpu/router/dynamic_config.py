@@ -42,6 +42,11 @@ class DynamicRouterConfig:
     kv_controller_url: str | None = None
     prefix_chunk_size: int | None = None
     callbacks: str | None = None
+    # admission control: per-tenant budgets + overload thresholds
+    # (shape: AdmissionController.apply_config). The only section also
+    # applied at STARTUP — CLI flags cannot express per-tenant maps,
+    # so the file is their sole source.
+    admission: dict | None = None
 
     @staticmethod
     def from_file(path: str) -> "DynamicRouterConfig":
@@ -77,7 +82,28 @@ class DynamicConfigWatcher:
             logger.exception(
                 "failed to load initial dynamic config %s", self.config_path
             )
+        # the admission section applies at startup too: CLI flags only
+        # carry the defaults, so a file-declared tenant budget must be
+        # live before the first request — the rest of the file stays
+        # delta-only (discovery/routing were just built FROM the flags;
+        # re-initializing them here would churn identical singletons)
+        if self._current is not None and self._current.admission is not None:
+            try:
+                self._apply_admission(self._current.admission)
+            except Exception:
+                logger.exception(
+                    "initial admission config invalid; keeping flag "
+                    "defaults"
+                )
         self._task = spawn_watched(self._watch_loop(), "dynamic-config-watch")
+
+    @staticmethod
+    def _apply_admission(raw: dict) -> None:
+        from production_stack_tpu.router.admission import (
+            get_admission_controller,
+        )
+
+        get_admission_controller().apply_config(raw)
 
     async def close(self) -> None:
         if self._task:
@@ -107,6 +133,16 @@ class DynamicConfigWatcher:
                 logger.exception("reconfiguration failed; keeping old")
 
     async def reconfigure_all(self, cfg: DynamicRouterConfig) -> None:
+        # admission FIRST: apply_config validates before swapping, so
+        # a malformed section raises HERE — before any discovery/
+        # routing teardown. Were it applied last, a bad admission
+        # section after valid discovery keys would re-churn the
+        # discovery singleton (probe restarts, health-state wipe) on
+        # EVERY poll until the file is fixed, since _current only
+        # advances on full success.
+        if cfg.admission is not None:
+            self._apply_admission(cfg.admission)
+
         # discovery (reference: dynamic_config.py:157)
         if cfg.service_discovery == "static" and cfg.static_backends:
             await sd.reconfigure_service_discovery(

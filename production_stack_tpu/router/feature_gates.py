@@ -34,6 +34,10 @@ KNOWN_FEATURES: dict[str, Feature] = {
         Feature("SemanticCache", Stage.ALPHA, False),
         Feature("PIIDetection", Stage.ALPHA, False),
         Feature("KVOffload", Stage.BETA, False),
+        # boot-time kill switch for router/admission/ (the dynamic
+        # config's `admission.enabled` key is the LIVE one): default on
+        # because an unconfigured controller admits everything
+        Feature("AdmissionControl", Stage.BETA, True),
     ]
 }
 

@@ -135,6 +135,54 @@ def build_parser() -> argparse.ArgumentParser:
     rout.add_argument("--decode-model-labels", type=str, default=None,
                       help="comma-separated labels marking decode pods")
 
+    adm = p.add_argument_group("admission control / overload protection")
+    adm.add_argument("--admission-control", default=True,
+                     action=argparse.BooleanOptionalAction,
+                     help="SLO-aware admission: per-tenant token-bucket "
+                          "rate limits + concurrency caps and cluster-"
+                          "load shedding (429 + Retry-After) BEFORE "
+                          "routing. Per-tenant budgets live in the "
+                          "dynamic config file's `admission:` section "
+                          "(live-reloadable); these flags set the "
+                          "defaults. --no-admission-control (or the "
+                          "AdmissionControl=false feature gate) "
+                          "disables it entirely")
+    adm.add_argument("--admission-tenant-header", type=str,
+                     default="x-tenant-id",
+                     help="header carrying the tenant identity; "
+                          "fallback order: this header, hashed API "
+                          "key, client IP")
+    adm.add_argument("--admission-default-rate", type=float, default=0.0,
+                     help="default per-tenant admission budget in "
+                          "requests/s (0 = unlimited)")
+    adm.add_argument("--admission-default-burst", type=float, default=0.0,
+                     help="default token-bucket capacity (0 = derive "
+                          "max(rate, 1))")
+    adm.add_argument("--admission-default-concurrency", type=int,
+                     default=0,
+                     help="default per-tenant in-flight request cap "
+                          "(0 = unlimited)")
+    adm.add_argument("--admission-inflight-target", type=int, default=512,
+                     help="per-engine in-flight depth the load score "
+                          "normalizes against (score 1.0 = awake fleet "
+                          "at target)")
+    adm.add_argument("--admission-queue-target", type=int, default=256,
+                     help="per-engine scraped queue depth "
+                          "(vllm:num_requests_waiting) the load score "
+                          "normalizes against")
+    adm.add_argument("--admission-delay-target-s", type=float, default=2.0,
+                     help="recent engine scheduling delay "
+                          "(tpu:scheduling_delay_seconds windowed avg) "
+                          "considered saturated by the load score")
+    adm.add_argument("--admission-shed-threshold", type=float, default=1.0,
+                     help="load score at which INTERACTIVE traffic "
+                          "sheds; batch sheds at 75%% and normal at "
+                          "90%% of it (the priority ladder)")
+    adm.add_argument("--admission-asleep-retry-s", type=float,
+                     default=10.0,
+                     help="Retry-After advertised on fleet_asleep "
+                          "sheds (every pool member asleep/draining)")
+
     ext = p.add_argument_group("extensions")
     ext.add_argument("--callbacks", type=str, default=None,
                      help="module path of custom callback handler "

@@ -157,6 +157,78 @@ shared_cache_routed = Counter(
     ["server"], registry=ROUTER_REGISTRY,
 )
 
+# -- admission control (router/admission/) -----------------------------------
+# tenant labels are ONLY configured tenant names or "(other)" (the
+# controller folds IP/API-key fallback identities into one label so a
+# scanning client cannot explode the Prometheus label set)
+admission_sheds = Counter(
+    "tpu_router:admission_sheds",
+    "Requests shed by admission control, by tenant and reason "
+    "(tenant_limit | tenant_concurrency | overload | fleet_asleep)",
+    ["tenant", "reason"], registry=ROUTER_REGISTRY,
+)
+admission_admitted = Counter(
+    "tpu_router:admission_admitted",
+    "Requests admitted by admission control, by tenant",
+    ["tenant"], registry=ROUTER_REGISTRY,
+)
+admission_bucket_occupancy = Histogram(
+    "tpu_router:admission_bucket_occupancy",
+    "Token-bucket fill fraction (0..1) observed at each admission "
+    "decision for rate-limited tenants",
+    ["tenant"], registry=ROUTER_REGISTRY,
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+)
+admission_retry_after = Histogram(
+    "tpu_router:admission_retry_after_seconds",
+    "Computed Retry-After advertised on shed (429) responses "
+    "(bucket refill deficit + backpressure term)",
+    ["reason"], registry=ROUTER_REGISTRY,
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0),
+)
+admission_load_score = Gauge(
+    "tpu_router:admission_load_score",
+    "Cluster load score driving overload shedding (1.0 = awake fleet "
+    "at its configured target; -1 = fleet fully asleep)",
+    registry=ROUTER_REGISTRY,
+)
+admission_shed_seconds = Histogram(
+    "tpu_router:shed_seconds",
+    "Router time spent on a shed request (the tiled `shed` phase: "
+    "body parse + admission decision + 429 build)",
+    registry=ROUTER_REGISTRY, buckets=_LATENCY_BUCKETS,
+)
+
+
+def observe_admission_shed(
+    tenant_label: str,
+    reason: str,
+    retry_after_s: float,
+    occupancy: float | None = None,
+    load_score: float | None = None,
+) -> None:
+    """Fold one shed decision into the admission counters (called via
+    AdmissionController._shed on the proxy hot path)."""
+    admission_sheds.labels(tenant=tenant_label, reason=reason).inc()
+    admission_retry_after.labels(reason=reason).observe(retry_after_s)
+    if occupancy is not None:
+        admission_bucket_occupancy.labels(
+            tenant=tenant_label
+        ).observe(occupancy)
+    if load_score is not None:
+        admission_load_score.set(load_score)
+
+
+def observe_admission_admitted(
+    tenant_label: str, occupancy: float | None = None
+) -> None:
+    admission_admitted.labels(tenant=tenant_label).inc()
+    if occupancy is not None:
+        admission_bucket_occupancy.labels(
+            tenant=tenant_label
+        ).observe(occupancy)
+
+
 # engine health scoreboard gauges (mirror of GET /debug/engines; pushed
 # by stats/log_stats.py on each render so /metrics scrapes stay fresh)
 engine_ewma_latency = _g(

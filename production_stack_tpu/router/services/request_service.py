@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import re
 import time
 import uuid
@@ -33,6 +34,10 @@ import uuid
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.admission import (
+    ShedDecision,
+    get_admission_controller,
+)
 from production_stack_tpu.router.protocols import EndpointInfo, RouterRequest
 from production_stack_tpu.router.routing_logic import (
     DisaggregatedPrefillRouter,
@@ -52,6 +57,7 @@ from production_stack_tpu.router.stats.health import (
     PhaseClock,
     get_engine_health_board,
     record_proxy_observation,
+    record_shed_observation,
 )
 from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
@@ -109,6 +115,23 @@ def _mark_open_phase(
         return "ttft"
     clock.mark("stream_relay")
     return "stream"
+
+
+def _shed_error_body(shed: ShedDecision) -> dict:
+    """The ONE 429 body for an admission shed (general, PD, and batch
+    paths must classify identically): tenant-budget sheds are
+    ``rate_limit_exceeded``, cluster-state sheds are ``overloaded``."""
+    kind = (
+        "rate_limit_exceeded"
+        if shed.reason in ("tenant_limit", "tenant_concurrency")
+        else "overloaded"
+    )
+    return {"error": {
+        "message": shed.message,
+        "type": kind,
+        "code": shed.reason,
+        "retry_after_s": round(shed.retry_after_s, 3),
+    }}
 
 
 def _forward_headers(request: web.Request) -> dict[str, str]:
@@ -174,13 +197,18 @@ class RequestService:
     @staticmethod
     def _filter_endpoints(
         endpoints: list[EndpointInfo], model: str | None
-    ) -> tuple[list[EndpointInfo], str | None]:
+    ) -> tuple[list[EndpointInfo], str | None, int]:
         """Filter by requested model (resolving aliases), drop sleeping pods.
 
-        Returns (endpoints, resolved_model)."""
+        Returns (endpoints, resolved_model, asleep_count) where
+        ``asleep_count`` is how many pool members WOULD serve the
+        model but are asleep/draining — an empty candidate list with a
+        nonzero asleep count is the ``fleet_asleep`` shed (429 +
+        Retry-After until wake), not a 503/502."""
         awake = [e for e in endpoints if not e.sleep]
+        asleep = [e for e in endpoints if e.sleep]
         if not model:
-            return awake, model
+            return awake, model, len(asleep)
         resolved = model
         serving = []
         for e in awake:
@@ -189,7 +217,11 @@ class RequestService:
             elif model in e.aliases:
                 resolved = e.aliases[model]
                 serving.append(e)
-        return serving, resolved
+        asleep_serving = sum(
+            1 for e in asleep
+            if model in e.model_names or model in e.aliases
+        )
+        return serving, resolved, asleep_serving
 
     @staticmethod
     def _context_window_filter(
@@ -230,6 +262,65 @@ class RequestService:
             status=413,
         )
 
+    # -- load shedding (router/admission/) ---------------------------------
+    def _shed_response(
+        self,
+        clock: PhaseClock,
+        shed: ShedDecision,
+        request_id: str,
+    ) -> web.Response:
+        """Build the 429 for an admission shed: the whole router time
+        tiles as ONE ``shed`` phase (closure holds for sheds too), the
+        Retry-After header is the computed finite value (HTTP wants
+        integer seconds — ceil, never 0), and — tracing on — the
+        decision exports as an ``admission_shed`` span event so shed
+        requests appear in /debug/requests beside served ones."""
+        clock.mark("shed")
+        record_shed_observation(clock, shed.tenant, shed.reason)
+        if self.tracer.enabled:
+            load = (
+                shed.load_score
+                if shed.load_score != float("inf") else -1.0
+            )
+            span = self.tracer.start_span(
+                "proxy_request",
+                attributes={
+                    "request_id": request_id,
+                    "http.status": 429,
+                    "shed_reason": shed.reason,
+                    "tenant": shed.tenant_label,
+                    "priority": shed.priority,
+                },
+            )
+            span.add_event("admission_shed", {
+                "reason": shed.reason,
+                "retry_after_s": round(shed.retry_after_s, 3),
+                "load_score": round(load, 4),
+            })
+            self.tracer.finish(span, status="SHED")
+        return web.json_response(
+            _shed_error_body(shed),
+            status=429,
+            headers={
+                "Retry-After": str(max(1, math.ceil(shed.retry_after_s))),
+            },
+        )
+
+    @staticmethod
+    def _shed_fleet_asleep(admission, ticket, tenant=None) -> ShedDecision:
+        """The ONE fleet-asleep sequence shared by the general, PD,
+        and batch paths: build the ``fleet_asleep`` decision, then
+        refund the token this request's admit consumed (a tenant
+        retrying against a parked fleet must not drain its budget).
+        Callers render the decision — ``_shed_response`` on HTTP
+        paths, the (status, body) tuple in ``execute_internal``."""
+        shed = admission.shed_fleet_asleep(
+            tenant if tenant is not None
+            else (ticket.name if ticket is not None else None)
+        )
+        admission.refund(ticket)
+        return shed
+
     # -- main entry (reference: request.py:141) ----------------------------
     # stackcheck: hot-path — per-request proxy entry; no blocking calls
     async def route_general_request(
@@ -249,76 +340,109 @@ class RequestService:
             "x-request-id", uuid.uuid4().hex
         )
 
-        # PD branch (reference: request.py:159-163). PDRouter requests
-        # may still serve single-phase (prefix-affine resume / degenerate
-        # fleet) — route_disaggregated_prefill_request decides.
-        router = get_routing_logic()
-        if isinstance(router, (DisaggregatedPrefillRouter, PDRouter)):
-            return await self.route_disaggregated_prefill_request(
-                request, endpoint_path, body, request_id
-            )
-
-        # pre-request callback (reference: request.py:175-181)
-        if self.callbacks is not None:
-            maybe = self.callbacks.pre_request(request, body, request_id)
-            if maybe is not None:
-                body = maybe
-
-        # request rewriter (reference: request.py:192-206)
-        if self.rewriter is not None:
-            body = self.rewriter.rewrite_request(
-                body, endpoint_path, request_id
-            )
-
-        endpoints = get_service_discovery().get_endpoint_info()
-        model = body.get("model")
-        candidates, resolved_model = self._filter_endpoints(endpoints, model)
-        if resolved_model != model and resolved_model is not None:
-            body["model"] = resolved_model
-        if not candidates:
-            return web.json_response(
-                {"error": {"message": f"no endpoint serving model {model!r}",
-                           "type": "service_unavailable"}},
-                status=503,
-            )
-        # context-window gate: too-small backends drop out of the pick;
-        # a prompt no backend can admit 413s HERE with the cluster max
-        # instead of failing opaquely at the chosen engine
-        candidates, too_long = self._context_window_filter(
-            candidates, body
+        # admission control FIRST — before callbacks, rewriting, or any
+        # routing work: overload protection only protects if a shed
+        # costs microseconds, and the concurrency ticket must span the
+        # whole request (PD flows included)
+        admission = get_admission_controller()
+        ticket, shed = admission.admit(
+            request.headers, remote=request.remote
         )
-        if too_long is not None:
-            return too_long
-
-        engine_stats = get_engine_stats_scraper().get_engine_stats()
-        request_stats = get_request_stats_monitor().get_request_stats()
-        rr = RouterRequest(
-            headers=dict(request.headers), body=body, endpoint=endpoint_path
-        )
-        clock.mark("receive")
+        if shed is not None:
+            return self._shed_response(clock, shed, request_id)
         try:
-            url = await router.route_request(
-                candidates, engine_stats, request_stats, rr
+            # PD branch (reference: request.py:159-163). PDRouter
+            # requests may still serve single-phase (prefix-affine
+            # resume / degenerate fleet) —
+            # route_disaggregated_prefill_request decides.
+            router = get_routing_logic()
+            if isinstance(router, (DisaggregatedPrefillRouter, PDRouter)):
+                return await self.route_disaggregated_prefill_request(
+                    request, endpoint_path, body, request_id,
+                    ticket=ticket,
+                )
+
+            # pre-request callback (reference: request.py:175-181)
+            if self.callbacks is not None:
+                maybe = self.callbacks.pre_request(
+                    request, body, request_id
+                )
+                if maybe is not None:
+                    body = maybe
+
+            # request rewriter (reference: request.py:192-206)
+            if self.rewriter is not None:
+                body = self.rewriter.rewrite_request(
+                    body, endpoint_path, request_id
+                )
+
+            endpoints = get_service_discovery().get_endpoint_info()
+            model = body.get("model")
+            candidates, resolved_model, asleep = self._filter_endpoints(
+                endpoints, model
             )
-        except RuntimeError as e:
-            return web.json_response(
-                {"error": {"message": str(e), "type":
-                           "service_unavailable"}},
-                status=503,
+            if resolved_model != model and resolved_model is not None:
+                body["model"] = resolved_model
+            if not candidates:
+                if asleep and admission.active:
+                    # the pool exists but every member is asleep or
+                    # draining: a retryable 429 with the wake horizon,
+                    # NOT a 502/503 — a reason clients can tell apart
+                    # from their own budget, with the admit's token
+                    # refunded. (Admission disabled keeps the
+                    # pre-admission 503 below.)
+                    return self._shed_response(
+                        clock,
+                        self._shed_fleet_asleep(admission, ticket),
+                        request_id,
+                    )
+                return web.json_response(
+                    {"error": {
+                        "message": f"no endpoint serving model {model!r}",
+                        "type": "service_unavailable"}},
+                    status=503,
+                )
+            # context-window gate: too-small backends drop out of the
+            # pick; a prompt no backend can admit 413s HERE with the
+            # cluster max instead of failing opaquely at the engine
+            candidates, too_long = self._context_window_filter(
+                candidates, body
             )
-        clock.mark("route_decision")
-        logger.info(
-            "Routing request %s to %s at endpoint %s",
-            request_id, url, endpoint_path,
-        )
-        # connect-stage failures may fall over to the other candidates
-        alternates = [
-            e.url for e in candidates if e.url != url
-        ][:MAX_CONNECT_RETRIES]
-        return await self.process_request(
-            request, body, url, endpoint_path, request_id,
-            clock=clock, alternates=alternates,
-        )
+            if too_long is not None:
+                return too_long
+
+            engine_stats = get_engine_stats_scraper().get_engine_stats()
+            request_stats = get_request_stats_monitor().get_request_stats()
+            rr = RouterRequest(
+                headers=dict(request.headers), body=body,
+                endpoint=endpoint_path,
+            )
+            clock.mark("receive")
+            try:
+                url = await router.route_request(
+                    candidates, engine_stats, request_stats, rr
+                )
+            except RuntimeError as e:
+                return web.json_response(
+                    {"error": {"message": str(e), "type":
+                               "service_unavailable"}},
+                    status=503,
+                )
+            clock.mark("route_decision")
+            logger.info(
+                "Routing request %s to %s at endpoint %s",
+                request_id, url, endpoint_path,
+            )
+            # connect-stage failures may fall over to the others
+            alternates = [
+                e.url for e in candidates if e.url != url
+            ][:MAX_CONNECT_RETRIES]
+            return await self.process_request(
+                request, body, url, endpoint_path, request_id,
+                clock=clock, alternates=alternates,
+            )
+        finally:
+            admission.release(ticket)
 
     def _emit_phase_spans(
         self, span: Span, clock: PhaseClock, request_id: str,
@@ -653,64 +777,95 @@ class RequestService:
         body = dict(body)
         body.pop("stream", None)
         clock = PhaseClock()
-        endpoints = get_service_discovery().get_endpoint_info()
-        candidates, resolved_model = self._filter_endpoints(
-            endpoints, body.get("model")
+        # batch-API work is the canonical shed-first traffic: one
+        # shared tenant at `batch` priority, so under overload the
+        # batch processor backs off (it retries 429s on its own clock)
+        # before any interactive request is touched
+        admission = get_admission_controller()
+        ticket, shed = admission.admit(
+            {"x-priority": "batch"}, tenant="batch-api"
         )
-        if resolved_model is not None and resolved_model != body.get("model"):
-            body["model"] = resolved_model
-        if not candidates:
-            return 503, {"error": {
-                "message": f"no endpoint serving model {body.get('model')!r}",
-                "type": "service_unavailable"}}
-        router = get_routing_logic()
-        monitor = get_request_stats_monitor()
-        clock.mark("receive")
+        if shed is not None:
+            clock.mark("shed")
+            record_shed_observation(clock, shed.tenant, shed.reason)
+            return 429, _shed_error_body(shed)
         try:
-            url = await router.route_request(
-                candidates,
-                get_engine_stats_scraper().get_engine_stats(),
-                monitor.get_request_stats(),
-                RouterRequest(headers={}, body=body, endpoint=endpoint_path),
+            endpoints = get_service_discovery().get_endpoint_info()
+            candidates, resolved_model, asleep = self._filter_endpoints(
+                endpoints, body.get("model")
             )
-        except RuntimeError as e:
-            return 503, {"error": {"message": str(e),
-                                   "type": "service_unavailable"}}
-        clock.mark("route_decision")
-        monitor.on_new_request(
-            url, request_id, num_prompt_tokens=_estimate_prompt_tokens(body)
-        )
-        board = get_engine_health_board()
-        board.on_request_start(url)
-        self.in_flight += 1
-        ok, kind = False, "connect"
-        try:
-            async with self.session.post(
-                f"{url}{endpoint_path}", json=body,
-                headers={REQUEST_ID_HEADER: request_id},
-            ) as upstream:
-                clock.mark("upstream_connect")
-                monitor.on_request_response(url, request_id)
-                kind = "stream"
-                payload = await upstream.json(content_type=None)
-                clock.mark("stream_relay")
-                ok = upstream.status < 500
-                kind = None if ok else f"http_{upstream.status}"
-                return upstream.status, payload
-        except (aiohttp.ClientError, ConnectionResetError,
-                asyncio.TimeoutError, json.JSONDecodeError,
-                UnicodeDecodeError) as e:
-            return 502, {"error": {"message": f"backend error: {e}",
-                                   "type": "bad_gateway"}}
+            if (resolved_model is not None
+                    and resolved_model != body.get("model")):
+                body["model"] = resolved_model
+            if not candidates:
+                if asleep and admission.active:
+                    fleet_shed = self._shed_fleet_asleep(
+                        admission, ticket, tenant="batch-api"
+                    )
+                    clock.mark("shed")
+                    record_shed_observation(
+                        clock, fleet_shed.tenant, fleet_shed.reason
+                    )
+                    return 429, _shed_error_body(fleet_shed)
+                return 503, {"error": {
+                    "message": (
+                        f"no endpoint serving model "
+                        f"{body.get('model')!r}"),
+                    "type": "service_unavailable"}}
+            router = get_routing_logic()
+            monitor = get_request_stats_monitor()
+            clock.mark("receive")
+            try:
+                url = await router.route_request(
+                    candidates,
+                    get_engine_stats_scraper().get_engine_stats(),
+                    monitor.get_request_stats(),
+                    RouterRequest(
+                        headers={}, body=body, endpoint=endpoint_path
+                    ),
+                )
+            except RuntimeError as e:
+                return 503, {"error": {"message": str(e),
+                                       "type": "service_unavailable"}}
+            clock.mark("route_decision")
+            monitor.on_new_request(
+                url, request_id,
+                num_prompt_tokens=_estimate_prompt_tokens(body),
+            )
+            board = get_engine_health_board()
+            board.on_request_start(url)
+            self.in_flight += 1
+            ok, kind = False, "connect"
+            try:
+                async with self.session.post(
+                    f"{url}{endpoint_path}", json=body,
+                    headers={REQUEST_ID_HEADER: request_id},
+                ) as upstream:
+                    clock.mark("upstream_connect")
+                    monitor.on_request_response(url, request_id)
+                    kind = "stream"
+                    payload = await upstream.json(content_type=None)
+                    clock.mark("stream_relay")
+                    ok = upstream.status < 500
+                    kind = None if ok else f"http_{upstream.status}"
+                    return upstream.status, payload
+            except (aiohttp.ClientError, ConnectionResetError,
+                    asyncio.TimeoutError, json.JSONDecodeError,
+                    UnicodeDecodeError) as e:
+                return 502, {"error": {"message": f"backend error: {e}",
+                                       "type": "bad_gateway"}}
+            finally:
+                monitor.on_request_complete(url, request_id)
+                # batch requests are whole-body reads: no relay
+                # throughput, and no sample ring entry (the ring is the
+                # loadgen's view of the streaming proxy path)
+                record_proxy_observation(
+                    url, clock, ok=ok, error_kind=kind,
+                    record_sample=False
+                )
+                self.in_flight -= 1
         finally:
-            monitor.on_request_complete(url, request_id)
-            # batch requests are whole-body reads: no relay throughput,
-            # and no sample ring entry (the ring is the loadgen's view
-            # of the streaming proxy path)
-            record_proxy_observation(
-                url, clock, ok=ok, error_kind=kind, record_sample=False
-            )
-            self.in_flight -= 1
+            admission.release(ticket)
 
     # -- disaggregated prefill (reference: request.py:349-441) -------------
     async def route_disaggregated_prefill_request(
@@ -719,11 +874,35 @@ class RequestService:
         endpoint_path: str,
         body: dict,
         request_id: str,
+        ticket=None,
     ) -> web.StreamResponse:
         router = get_routing_logic()
         assert isinstance(router, (DisaggregatedPrefillRouter, PDRouter))
-        endpoints = get_service_discovery().get_endpoint_info()
-        endpoints = [e for e in endpoints if not e.sleep]
+        discovered = get_service_discovery().get_endpoint_info()
+        endpoints = [e for e in discovered if not e.sleep]
+        if not endpoints and discovered:
+            # whole PD fleet asleep/draining: same retryable 429 +
+            # Retry-After + token-refund contract as the general
+            # route (admission off keeps the legacy 503 from the
+            # empty-pool RuntimeError below; the caller still
+            # release()s the ticket)
+            admission = get_admission_controller()
+            if admission.active:
+                # direct PD entries (no ticket) resolve the tenant
+                # from the request for shed attribution
+                tenant = (
+                    None if ticket is not None
+                    else admission.resolve_tenant(
+                        request.headers, request.remote
+                    )
+                )
+                return self._shed_response(
+                    PhaseClock(),
+                    self._shed_fleet_asleep(
+                        admission, ticket, tenant=tenant
+                    ),
+                    request_id,
+                )
         # same context-window gate as the general route: neither PD
         # phase can serve a prompt past its backend's window
         endpoints, too_long = self._context_window_filter(
@@ -872,15 +1051,34 @@ class RequestService:
                     async with self.session.get(
                         f"{ep.url}{path}"
                     ) as r:
+                        status = r.status
                         results[ep.url] = await r.json()
                 else:
                     async with self.session.post(
                         f"{ep.url}{path}",
                         params=dict(request.query),
                     ) as r:
+                        status = r.status
                         results[ep.url] = await r.json()
             except aiohttp.ClientError as e:
                 results[ep.url] = {"error": str(e)}
+                continue
+            if status != 200:
+                continue
+            # reflect the verb's outcome into discovery IMMEDIATELY:
+            # the sleep filter and the admission fleet_asleep path must
+            # see an operator-initiated sleep on the very next request,
+            # not after the discovery reprobe interval
+            if path == "/sleep":
+                ep.sleep = True
+            elif path == "/wake_up":
+                ep.sleep = False
+            elif path == "/is_sleeping" and isinstance(
+                results[ep.url], dict
+            ):
+                ep.sleep = bool(
+                    results[ep.url].get("is_sleeping", ep.sleep)
+                )
         if url:
             return web.json_response(results[url])
         return web.json_response(results)

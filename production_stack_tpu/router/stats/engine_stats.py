@@ -31,6 +31,17 @@ class EngineStats:
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hits_total: int = 0
     gpu_prefix_cache_queries_total: int = 0
+    # lifetime sum/count of the engine's tpu:scheduling_delay_seconds
+    # histogram (enqueue -> scheduler admission wait, PR 3): the
+    # scraper turns consecutive scrapes' deltas into the WINDOWED
+    # average below — the admission load score's earliest
+    # TTFT-blowup signal
+    scheduling_delay_sum: float = 0.0
+    scheduling_delay_count: int = 0
+    # average scheduling delay over the LAST scrape interval (0.0 when
+    # no request was admitted in the window); computed by the scraper,
+    # not parsed
+    recent_scheduling_delay_s: float = 0.0
 
     @staticmethod
     def from_prometheus_text(text: str) -> "EngineStats":
@@ -51,6 +62,10 @@ class EngineStats:
                     hits = float(value)
                 elif name == "vllm:gpu_prefix_cache_queries_total":
                     queries = float(value)
+                elif name == "tpu:scheduling_delay_seconds_sum":
+                    s.scheduling_delay_sum = float(value)
+                elif name == "tpu:scheduling_delay_seconds_count":
+                    s.scheduling_delay_count = int(value)
         if hits is not None and queries:
             s.gpu_prefix_cache_hits_total = int(hits)
             s.gpu_prefix_cache_queries_total = int(queries)
@@ -62,6 +77,10 @@ class EngineStatsScraper:
     def __init__(self, scrape_interval_s: float = 10.0):
         self.scrape_interval_s = scrape_interval_s
         self._stats: dict[str, EngineStats] = {}
+        # previous scrape's (delay_sum, delay_count) per url: the
+        # windowed scheduling-delay average comes from the delta, so
+        # an hours-old stall cannot keep the load score pinned high
+        self._prev_delay: dict[str, tuple[float, int]] = {}
         self._task: asyncio.Task | None = None
         self._session: aiohttp.ClientSession | None = None
 
@@ -105,11 +124,36 @@ class EngineStatsScraper:
 
         board = get_engine_health_board()
         fresh: dict[str, EngineStats] = {}
+        prev_delay: dict[str, tuple[float, int]] = {}
         for ep, res in zip(endpoints, results):
             if isinstance(res, EngineStats):
+                res.recent_scheduling_delay_s = self._windowed_delay(
+                    ep.url, res
+                )
+                prev_delay[ep.url] = (
+                    res.scheduling_delay_sum, res.scheduling_delay_count
+                )
                 fresh[ep.url] = res
             board.note_scrape(ep.url, ok=isinstance(res, EngineStats))
         self._stats = fresh
+        self._prev_delay = prev_delay
+
+    def _windowed_delay(self, url: str, res: EngineStats) -> float:
+        """Average scheduling delay over the last scrape interval,
+        from consecutive lifetime-histogram (sum, count) deltas. No
+        prior scrape (first contact, or a scrape hiccup dropped the
+        url) reports 0.0 — NOT the lifetime average, whose ancient
+        stalls are exactly what the windowing exists to forget. An
+        engine restart (counters went backwards) also resets."""
+        prev = self._prev_delay.get(url)
+        if prev is None:
+            return 0.0
+        prev_sum, prev_count = prev
+        d_sum = res.scheduling_delay_sum - prev_sum
+        d_count = res.scheduling_delay_count - prev_count
+        if d_count <= 0 or d_sum < 0:
+            return 0.0
+        return d_sum / d_count
 
     async def _scrape_one(self, url: str) -> EngineStats | None:
         assert self._session is not None

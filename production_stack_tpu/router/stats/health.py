@@ -50,6 +50,10 @@ PROXY_PHASES = (
     "upstream_ttft",     # headers -> first body byte (incl. client prepare)
     "stream_relay",      # first byte -> eof written to the client
     "finalize",          # cache store, callbacks, span bookkeeping
+    # terminal phase of an admission-SHED request (429 + Retry-After):
+    # the one mark closes body-parse + admission decision + response
+    # build as `shed`, so sum(phases) == e2e holds for sheds too
+    "shed",
 )
 
 
@@ -309,6 +313,38 @@ def record_proxy_observation(
         url, phases, e2e_s, ok,
         error_kind=error_kind, tokens=tokens, engine_fault=engine_fault,
     )
+
+
+def record_shed_observation(
+    clock: PhaseClock, tenant: str, reason: str
+) -> None:
+    """The sink for an admission-SHED request: a tiled sample in the
+    board's ring (so the loadgen closure gate covers shed requests —
+    ``shed: True`` keeps them out of per-engine error accounting; no
+    backend was ever touched, so no scoreboard row moves) plus the
+    ``tpu_router:shed_seconds`` histogram."""
+    from production_stack_tpu.router.services.metrics_service import (
+        admission_shed_seconds,
+    )
+
+    phases = clock.phases
+    # read the independent e2e IMMEDIATELY: a shed request is
+    # microseconds long, so every instruction between the final mark
+    # and this read is relative closure error
+    e2e_s = clock.elapsed_s
+    admission_shed_seconds.observe(phases.get("shed", 0.0))
+    get_engine_health_board().samples.append({
+        "url": None,
+        "shed": True,
+        "ok": True,  # the ROUTER did its job; not an upstream error
+        "error": None,
+        "shed_reason": reason,
+        "tenant": tenant,
+        "e2e_s": e2e_s,
+        "ttft_s": None,
+        "tokens": 0,
+        "phases": phases,
+    })
 
 
 # -- singleton lifecycle -----------------------------------------------------

@@ -34,6 +34,17 @@ def update_prometheus_and_render() -> str:
 
     ms.healthy_pods_total.labels(server="all").set(len(endpoints))
 
+    # admission control: refresh the load-score gauge and prune idle
+    # IP-fallback tenant rows (same unbounded-growth hygiene as the
+    # health-board prune below)
+    from production_stack_tpu.router.admission import (
+        get_admission_controller,
+    )
+
+    admission = get_admission_controller()
+    admission.export_gauges()
+    admission.prune()
+
     # health scoreboard gauges (mirror of /debug/engines; histograms
     # observe on the hot path, gauges refresh here on render/scrape)
     board = get_engine_health_board()

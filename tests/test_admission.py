@@ -1,0 +1,769 @@
+"""Admission control & overload protection (router/admission/).
+
+Unit tier: token-bucket refill math under monotonic-clock discipline
+(every method takes an explicit ``now`` — pinned like
+test_request_stats.py pins the stats monitors), priority-ladder shed
+order, Retry-After computation (bucket deficit + backpressure),
+concurrent-tenant isolation, cluster load-score aggregation with
+sleeping-backend exclusion, live config swaps, and the PhaseClock
+``shed`` phase tiling.
+
+E2E tier: the real router app + fake engines over HTTP — per-tenant
+429s with Retry-After headers, the ``fleet_asleep`` shed via the
+existing ``/sleep`` verb (distinct reason from ``tenant_limit``), and
+the ``/debug/admission`` surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.admission import (
+    AdmissionController,
+    LoadSignals,
+    TenantLimits,
+    TokenBucket,
+    _reset_admission_controller,
+    compute_load,
+    get_admission_controller,
+)
+from production_stack_tpu.router.admission.controller import (
+    RETRY_AFTER_MAX_S,
+)
+from production_stack_tpu.router.feature_gates import (
+    _reset_feature_gates,
+    initialize_feature_gates,
+)
+from production_stack_tpu.router.protocols import EndpointInfo
+from production_stack_tpu.router.routing_logic import _reset_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    _reset_service_discovery,
+)
+from production_stack_tpu.router.stats.engine_stats import EngineStats
+from production_stack_tpu.router.stats.health import (
+    EngineHealthBoard,
+    PhaseClock,
+    _reset_engine_health_board,
+    get_engine_health_board,
+    record_shed_observation,
+)
+
+from tests.fake_engine import FakeEngine
+
+T0 = 1000.0  # pinned monotonic origin for clock-discipline tests
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    _reset_routing_logic()
+    _reset_service_discovery()
+    _reset_engine_health_board()
+    _reset_admission_controller()
+    _reset_feature_gates()
+
+
+# -- clock discipline --------------------------------------------------------
+def test_no_wall_clock_in_admission_sources():
+    """Same pin as test_request_stats.py: budget refill/starvation must
+    never ride wall-clock steps — time.time() is banned from the
+    package."""
+    pkg = (
+        Path(__file__).resolve().parent.parent
+        / "production_stack_tpu" / "router" / "admission"
+    )
+    for src in sorted(pkg.glob("*.py")):
+        assert "time.time()" not in src.read_text(), (
+            f"{src.name} uses wall-clock time"
+        )
+
+
+# -- token bucket ------------------------------------------------------------
+class TestTokenBucket:
+    def test_starts_full_and_drains(self):
+        b = TokenBucket(rate=2.0, burst=4.0, now=T0)
+        assert b.occupancy == 1.0
+        for _ in range(4):
+            assert b.try_acquire(now=T0)
+        assert not b.try_acquire(now=T0)
+        assert b.tokens == 0.0
+
+    def test_refill_math_exact(self):
+        b = TokenBucket(rate=2.0, burst=4.0, now=T0)
+        for _ in range(4):
+            b.try_acquire(now=T0)
+        # 0.25s at 2 tokens/s = 0.5 tokens: still not enough for 1
+        assert not b.try_acquire(now=T0 + 0.25)
+        assert b.tokens == pytest.approx(0.5)
+        # deficit: 0.5 missing at 2/s = 0.25s
+        assert b.deficit_s(now=T0 + 0.25) == pytest.approx(0.25)
+        assert b.try_acquire(now=T0 + 0.5)
+        assert b.tokens == pytest.approx(0.0)
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(rate=10.0, burst=3.0, now=T0)
+        b.try_acquire(now=T0)
+        b._refill(now=T0 + 100.0)
+        assert b.tokens == 3.0
+
+    def test_clock_never_runs_backwards(self):
+        """A smaller now must not refill or starve (monotonic
+        discipline holds even if a caller re-uses a stale stamp)."""
+        b = TokenBucket(rate=1.0, burst=2.0, now=T0)
+        b.try_acquire(now=T0 + 1.0)
+        tokens = b.tokens
+        b._refill(now=T0)  # stale stamp: no-op
+        assert b.tokens == tokens
+
+    def test_deficit_zero_when_available(self):
+        b = TokenBucket(rate=1.0, burst=2.0, now=T0)
+        assert b.deficit_s(now=T0) == 0.0
+
+
+# -- tenant resolution / priority -------------------------------------------
+class TestTenantResolution:
+    def test_resolution_order(self):
+        c = AdmissionController()
+        # explicit header wins over everything
+        assert c.resolve_tenant(
+            {"x-tenant-id": "team-a", "authorization": "Bearer sk-x"},
+            remote="1.2.3.4",
+        ) == "team-a"
+        # api key next — hashed, never the raw key
+        key_tenant = c.resolve_tenant(
+            {"authorization": "Bearer sk-secret"}, remote="1.2.3.4"
+        )
+        assert key_tenant.startswith("key:")
+        assert "sk-secret" not in key_tenant
+        # same key -> same tenant; x-api-key accepted too
+        assert c.resolve_tenant(
+            {"authorization": "Bearer sk-secret"}
+        ) == key_tenant
+        # ip fallback, then anonymous
+        assert c.resolve_tenant({}, remote="1.2.3.4") == "ip:1.2.3.4"
+        assert c.resolve_tenant({}) == "(anonymous)"
+
+    def test_priority_header_lowers_never_raises(self):
+        c = AdmissionController(
+            tenants={
+                "vip": TenantLimits(priority="interactive"),
+                "bulk": TenantLimits(priority="batch"),
+            },
+        )
+        vip = c._state("vip", T0)
+        bulk = c._state("bulk", T0)
+        assert c._priority(vip, {}) == "interactive"
+        assert c._priority(vip, {"x-priority": "batch"}) == "batch"
+        # a batch tenant cannot self-promote
+        assert c._priority(
+            bulk, {"x-priority": "interactive"}
+        ) == "bulk".replace("bulk", "batch")
+        # unknown names keep the configured priority
+        assert c._priority(vip, {"x-priority": "urgent!!"}) == "interactive"
+
+
+# -- admission decisions -----------------------------------------------------
+def quiet_controller(**kw) -> AdmissionController:
+    """Controller whose load score is pinned to 0 (no discovery in
+    unit tests; admit() must not read singletons implicitly)."""
+    c = AdmissionController(**kw)
+    c._load = LoadSignals(score=0.0)
+    c._load_stamp = T0 + 1e9  # cache forever
+    return c
+
+
+class TestAdmitDecisions:
+    def test_rate_limit_shed_and_retry_after(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=2.0, burst=2.0)},
+        )
+        hdr = {"x-tenant-id": "a"}
+        for _ in range(2):
+            ticket, shed = c.admit(hdr, now=T0)
+            assert ticket is not None and shed is None
+        ticket, shed = c.admit(hdr, now=T0)
+        assert ticket is None
+        assert shed.reason == "tenant_limit"
+        # retry-after IS the bucket deficit: 1 token at 2/s = 0.5s
+        assert shed.retry_after_s == pytest.approx(0.5)
+        assert math.isfinite(shed.retry_after_s)
+        # and the budget refills on the monotonic clock
+        ticket, shed = c.admit(hdr, now=T0 + 0.5)
+        assert ticket is not None
+
+    def test_concurrent_tenant_isolation(self):
+        """Tenant A draining its bucket must not move tenant B's
+        admission by one token."""
+        c = quiet_controller(
+            tenants={
+                "a": TenantLimits(rate=1.0, burst=1.0),
+                "b": TenantLimits(rate=1.0, burst=1.0),
+            },
+        )
+        assert c.admit({"x-tenant-id": "a"}, now=T0)[0] is not None
+        for _ in range(5):
+            _, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+            assert shed is not None and shed.reason == "tenant_limit"
+        # B still has its full budget
+        ticket, shed = c.admit({"x-tenant-id": "b"}, now=T0)
+        assert ticket is not None and shed is None
+
+    def test_concurrency_cap_and_release(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(max_concurrency=2)},
+        )
+        hdr = {"x-tenant-id": "a"}
+        t1, _ = c.admit(hdr, now=T0)
+        t2, _ = c.admit(hdr, now=T0)
+        _, shed = c.admit(hdr, now=T0)
+        assert shed.reason == "tenant_concurrency"
+        assert math.isfinite(shed.retry_after_s)
+        c.release(t1)
+        t3, shed = c.admit(hdr, now=T0)
+        assert t3 is not None and shed is None
+        c.release(t2)
+        c.release(t3)
+        assert c._states["a"].in_flight == 0
+        c.release(None)  # no-op contract
+
+    def test_unconfigured_tenants_use_default_limits(self):
+        c = quiet_controller(
+            default_limits=TenantLimits(rate=1.0, burst=1.0),
+        )
+        assert c.admit({}, remote="9.9.9.9", now=T0)[0] is not None
+        _, shed = c.admit({}, remote="9.9.9.9", now=T0)
+        assert shed is not None and shed.reason == "tenant_limit"
+        # a different ip is a different bucket
+        assert c.admit({}, remote="9.9.9.8", now=T0)[0] is not None
+
+    def test_disabled_admits_everything(self):
+        c = quiet_controller(
+            enabled=False,
+            tenants={"a": TenantLimits(rate=0.001, burst=0.001)},
+        )
+        for _ in range(20):
+            ticket, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+            assert ticket is None and shed is None
+
+    def test_feature_gate_kill_switch(self, reset_singletons):
+        initialize_feature_gates("AdmissionControl=false")
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=0.001, burst=0.001)},
+        )
+        assert c.admit({"x-tenant-id": "a"}, now=T0) == (None, None)
+        # flipping the gate back on is immediately visible: the
+        # near-zero budget sheds again
+        initialize_feature_gates("AdmissionControl=true")
+        _, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+        assert shed is not None and shed.reason == "tenant_limit"
+
+
+class TestPriorityLadder:
+    def make(self, score: float) -> AdmissionController:
+        c = quiet_controller(
+            shed_threshold=1.0,
+            tenants={
+                "bulk": TenantLimits(priority="batch"),
+                "web": TenantLimits(priority="normal"),
+                "chat": TenantLimits(priority="interactive"),
+            },
+        )
+        c._load = LoadSignals(score=score, dominant="in_flight")
+        return c
+
+    def admitted(self, c, tenant):
+        ticket, shed = c.admit({"x-tenant-id": tenant}, now=T0)
+        if ticket is not None:
+            c.release(ticket)
+            return True
+        assert shed.reason == "overload"
+        return False
+
+    def test_shed_order_batch_first_interactive_last(self):
+        # below every shed point: everyone admitted
+        c = self.make(0.5)
+        assert all(
+            self.admitted(c, t) for t in ("bulk", "web", "chat")
+        )
+        # 0.8: past batch's 0.75 point only
+        c = self.make(0.8)
+        assert not self.admitted(c, "bulk")
+        assert self.admitted(c, "web")
+        assert self.admitted(c, "chat")
+        # 0.95: past normal's 0.9 point; interactive still served
+        c = self.make(0.95)
+        assert not self.admitted(c, "bulk")
+        assert not self.admitted(c, "web")
+        assert self.admitted(c, "chat")
+        # 1.1: past the full threshold — everyone sheds
+        c = self.make(1.1)
+        assert not any(
+            self.admitted(c, t) for t in ("bulk", "web", "chat")
+        )
+
+    def test_overload_retry_after_scales_with_backpressure(self):
+        shallow = self.make(0.80)
+        deep = self.make(1.6)
+        _, s1 = shallow.admit({"x-tenant-id": "bulk"}, now=T0)
+        _, s2 = deep.admit({"x-tenant-id": "bulk"}, now=T0)
+        assert s1.reason == s2.reason == "overload"
+        assert s2.retry_after_s > s1.retry_after_s
+        assert s2.retry_after_s <= RETRY_AFTER_MAX_S
+
+    def test_fleet_asleep_reason_and_finite_retry(self):
+        c = self.make(0.0)
+        c._load = LoadSignals(score=float("inf"),
+                              dominant="fleet_asleep")
+        shed = c.shed_fleet_asleep("team-a")
+        assert shed.reason == "fleet_asleep"
+        assert math.isfinite(shed.retry_after_s)
+        assert shed.retry_after_s == pytest.approx(c.asleep_retry_s)
+
+    def test_refund_restores_the_token(self):
+        """A parked fleet must not drain budgets: the fleet_asleep
+        path refunds the token the admit consumed, so the tenant's
+        full budget is there when the fleet wakes."""
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=1.0, burst=2.0)},
+        )
+        hdr = {"x-tenant-id": "a"}
+        for _ in range(2):
+            ticket, shed = c.admit(hdr, now=T0)
+            assert ticket is not None
+            c.refund(ticket)
+            c.release(ticket)
+        # without refunds the bucket would be empty; with them the
+        # full burst is still available
+        assert c._states["a"].bucket.tokens == pytest.approx(2.0)
+        assert c._states["a"].refunded_total == 2
+        assert c.refunded_total == 2
+        assert c._states["a"].in_flight == 0
+        c.refund(None)  # no-op contract
+
+
+# -- cluster load score ------------------------------------------------------
+class TestLoadScore:
+    def eps(self, n=4, asleep=0):
+        out = [
+            EndpointInfo(url=f"http://e{i}:8000", model_names=["m"])
+            for i in range(n)
+        ]
+        for e in out[:asleep]:
+            e.sleep = True
+        return out
+
+    def test_empty_fleet_scores_zero(self):
+        sig = compute_load([], EngineHealthBoard(), {}, 512, 256, 2.0)
+        assert sig.score == 0.0
+
+    def test_all_asleep_scores_infinite(self):
+        sig = compute_load(
+            self.eps(2, asleep=2), EngineHealthBoard(), {}, 512, 256, 2.0
+        )
+        assert sig.score == float("inf")
+        assert sig.dominant == "fleet_asleep"
+
+    def test_inflight_signal_normalized_per_awake_engine(self):
+        eps = self.eps(4)
+        board = EngineHealthBoard()
+        for e in eps:
+            for _ in range(8):
+                board.on_request_start(e.url)
+        sig = compute_load(eps, board, {}, 16, 256, 2.0)
+        # 32 in flight over 4 engines at target 16 = 0.5
+        assert sig.score == pytest.approx(0.5)
+        assert sig.dominant == "in_flight"
+        assert sig.total_in_flight == 32
+
+    def test_sleeping_backends_excluded_from_capacity(self):
+        """Same absolute in-flight depth, half the fleet asleep →
+        the score doubles: sleepers' capacity is not counted."""
+        eps = self.eps(4)
+        board = EngineHealthBoard()
+        for e in eps[2:]:  # load only the awake half
+            for _ in range(8):
+                board.on_request_start(e.url)
+        before = compute_load(eps, board, {}, 16, 256, 2.0).score
+        eps[0].sleep = eps[1].sleep = True
+        after = compute_load(eps, board, {}, 16, 256, 2.0).score
+        assert after == pytest.approx(2 * before)
+
+    def test_queue_depth_and_delay_signals(self):
+        eps = self.eps(2)
+        stats = {
+            eps[0].url: EngineStats(num_queuing_requests=96),
+            eps[1].url: EngineStats(num_queuing_requests=32),
+        }
+        sig = compute_load(eps, EngineHealthBoard(), stats, 512, 64, 2.0)
+        # 128 queued over 2 engines at target 64 = 1.0
+        assert sig.score == pytest.approx(1.0)
+        assert sig.dominant == "queue_depth"
+        # scheduling delay is a per-engine WORST, not an average: one
+        # saturated engine trips the signal alone
+        stats[eps[1].url].recent_scheduling_delay_s = 3.0
+        sig = compute_load(eps, EngineHealthBoard(), stats, 512, 64, 2.0)
+        assert sig.score == pytest.approx(1.5)
+        assert sig.dominant == "scheduling_delay"
+
+    def test_windowed_scheduling_delay_from_scrapes(self):
+        """The scraper derives the RECENT average from consecutive
+        lifetime (sum, count) deltas; counter resets (engine restart)
+        reset the window instead of going negative."""
+        from production_stack_tpu.router.stats.engine_stats import (
+            EngineStatsScraper,
+        )
+
+        scraper = EngineStatsScraper()
+        first = EngineStats(
+            scheduling_delay_sum=10.0, scheduling_delay_count=10
+        )
+        # FIRST contact has no window: report 0, NOT the lifetime
+        # average (an ancient stall in the lifetime sum must not shed
+        # interactive traffic on router boot)
+        assert scraper._windowed_delay("u", first) == 0.0
+        scraper._prev_delay["u"] = (10.0, 10)
+        second = EngineStats(
+            scheduling_delay_sum=10.4, scheduling_delay_count=12
+        )
+        assert scraper._windowed_delay("u", second) == pytest.approx(0.2)
+        # no new admissions in the window -> 0, not the lifetime avg
+        scraper._prev_delay["u"] = (10.4, 12)
+        assert scraper._windowed_delay("u", second) == 0.0
+        # restart: counters went backwards
+        restarted = EngineStats(
+            scheduling_delay_sum=0.1, scheduling_delay_count=1
+        )
+        assert scraper._windowed_delay("u", restarted) == 0.0
+
+    def test_scheduling_delay_parsed_from_prometheus(self):
+        text = (
+            "# TYPE tpu:scheduling_delay_seconds histogram\n"
+            'tpu:scheduling_delay_seconds_bucket{le="1.0"} 3\n'
+            'tpu:scheduling_delay_seconds_bucket{le="+Inf"} 4\n'
+            "tpu:scheduling_delay_seconds_sum 2.5\n"
+            "tpu:scheduling_delay_seconds_count 4\n"
+        )
+        s = EngineStats.from_prometheus_text(text)
+        assert s.scheduling_delay_sum == pytest.approx(2.5)
+        assert s.scheduling_delay_count == 4
+
+
+# -- live config swaps -------------------------------------------------------
+class TestApplyConfig:
+    def test_swap_and_in_flight_preserved(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=10.0, max_concurrency=8)},
+        )
+        t1, _ = c.admit({"x-tenant-id": "a"}, now=T0)
+        assert c._states["a"].in_flight == 1
+        c.apply_config({
+            "tenants": {"a": {"rate": 5.0, "max_concurrency": 1}},
+        })
+        # the live request still counts against the NEW cap
+        _, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+        assert shed is not None and shed.reason == "tenant_concurrency"
+        c.release(t1)
+        assert c.admit({"x-tenant-id": "a"}, now=T0)[0] is not None
+
+    def test_malformed_keeps_last_good(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=7.0)},
+        )
+        for bad in (
+            {"tenants": {"a": {"rate": -1}}},
+            {"tenants": {"a": {"priority": "vip"}}},
+            {"tenants": {"a": {"unknown_key": 1}}},
+            {"typo_section": True},
+            {"shed_threshold": -0.5},
+            "not-a-mapping",
+        ):
+            with pytest.raises((ValueError, TypeError)):
+                c.apply_config(bad)
+            assert c.tenant_limits["a"].rate == 7.0
+
+    def test_dropped_tenant_falls_back_to_default(self):
+        c = quiet_controller(
+            default_limits=TenantLimits(rate=100.0),
+            tenants={"a": TenantLimits(rate=1.0, burst=1.0)},
+        )
+        c.admit({"x-tenant-id": "a"}, now=T0)
+        assert c.admit({"x-tenant-id": "a"}, now=T0)[1] is not None
+        c.apply_config({"tenants": {}, "default": {"rate": 100.0}})
+        # the retuned (default) budget applies to the live state row
+        ticket, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+        assert ticket is not None and shed is None
+        assert not c._states["a"].configured
+
+    def test_unchanged_budget_keeps_bucket_level(self):
+        """An edit to an UNRELATED config key (same budgets re-applied)
+        must not hand a throttled tenant a fresh full burst."""
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=1.0, burst=4.0)},
+        )
+        for _ in range(4):
+            c.admit({"x-tenant-id": "a"}, now=T0)
+        assert c._states["a"].bucket.tokens == 0.0
+        c.apply_config({
+            "tenants": {"a": {"rate": 1.0, "burst": 4.0}},
+            "shed_threshold": 2.0,  # the actual change
+        })
+        # same budget -> same bucket, still drained
+        _, shed = c.admit({"x-tenant-id": "a"}, now=T0)
+        assert shed is not None and shed.reason == "tenant_limit"
+        # a REAL budget change still restarts the bucket full
+        c.apply_config({"tenants": {"a": {"rate": 2.0, "burst": 4.0}}})
+        assert c.admit({"x-tenant-id": "a"}, now=T0)[0] is not None
+
+    def test_enabled_kill_switch_via_config(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=0.001, burst=0.001)},
+        )
+        c.apply_config({"enabled": False})
+        assert c.admit({"x-tenant-id": "a"}, now=T0) == (None, None)
+        c.apply_config({"enabled": True})
+        c.admit({"x-tenant-id": "a"}, now=T0)
+        assert c.admit({"x-tenant-id": "a"}, now=T0)[1] is not None
+
+    def test_prune_drops_only_idle_unconfigured(self):
+        c = quiet_controller(
+            tenants={"a": TenantLimits(rate=1.0)},
+        )
+        c.admit({"x-tenant-id": "a"}, now=T0)
+        ip_ticket, _ = c.admit({}, remote="8.8.8.8", now=T0)
+        c.admit({}, remote="8.8.4.4", now=T0)[0]
+        c.release(c._states["ip:8.8.4.4"])
+        dropped = c.prune(now=T0 + 10_000.0)
+        # configured row stays; the in-flight ip row stays; the idle
+        # unconfigured ip row goes
+        assert dropped == ["ip:8.8.4.4"]
+        assert "a" in c._states and "ip:8.8.8.8" in c._states
+        c.release(ip_ticket)
+
+
+# -- PhaseClock shed tiling --------------------------------------------------
+class TestShedPhase:
+    def test_shed_phase_tiles_to_e2e(self, reset_singletons):
+        clock = PhaseClock()
+        # simulate the real path: parse work happens, then ONE shed
+        # mark closes the whole window
+        sum(range(2000))
+        clock.mark("shed")
+        phases = clock.phases
+        assert set(phases) == {"shed"}
+        assert phases["shed"] == pytest.approx(
+            clock.elapsed_s, rel=0.25, abs=5e-4
+        )
+
+    def test_record_shed_observation_sample_shape(self, reset_singletons):
+        board = get_engine_health_board()
+        clock = PhaseClock()
+        clock.mark("shed")
+        record_shed_observation(clock, "team-a", "tenant_limit")
+        assert len(board.samples) == 1
+        s = board.samples[0]
+        assert s["shed"] is True and s["ok"] is True
+        assert s["url"] is None
+        assert s["shed_reason"] == "tenant_limit"
+        assert s["tenant"] == "team-a"
+        # tiling holds for the recorded sample
+        gap = abs(sum(s["phases"].values()) - s["e2e_s"])
+        assert gap / max(s["e2e_s"], 1e-3) <= 0.05
+        # no engine scoreboard row was invented for the shed
+        assert board.snapshot() == {}
+
+
+# -- e2e: real router + fake engines ----------------------------------------
+async def _start_stack(n_engines=2, extra_args=()):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+
+    engines = [FakeEngine(model="fake-model") for _ in range(n_engines)]
+    for e in engines:
+        await e.start()
+    argv = [
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", ",".join("fake-model" for _ in engines),
+        "--routing-logic", "roundrobin",
+        "--engine-stats-interval", "0.2",
+        *extra_args,
+    ]
+    args = parsers.parse_args(argv)
+    ra = build_app(args)
+    client = TestClient(TestServer(ra.app))
+    await client.start_server()
+    return client, engines
+
+
+async def _stop_stack(client, engines):
+    await client.close()
+    for e in engines:
+        await e.stop()
+
+
+class TestAdmissionE2E:
+    def test_tenant_rate_limit_429_with_retry_after(
+        self, reset_singletons
+    ):
+        async def run():
+            client, engines = await _start_stack()
+            get_admission_controller().apply_config({
+                "tenants": {
+                    "small": {"rate": 0.5, "burst": 1.0},
+                    "big": {"rate": 1000.0},
+                },
+            })
+            body = {"model": "fake-model", "prompt": "x",
+                    "max_tokens": 1}
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"x-tenant-id": "small"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"x-tenant-id": "small"},
+            )
+            assert r.status == 429
+            assert int(r.headers["Retry-After"]) >= 1
+            err = (await r.json())["error"]
+            assert err["code"] == "tenant_limit"
+            assert err["type"] == "rate_limit_exceeded"
+            assert math.isfinite(err["retry_after_s"])
+            # another tenant is untouched
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={"x-tenant-id": "big"},
+            )
+            assert r.status == 200
+            # the shed never reached an engine
+            assert sum(len(e.requests_seen) for e in engines) == 2
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_fleet_asleep_returns_429_not_502(self, reset_singletons):
+        """Satellite contract: all pool members asleep (via the
+        existing /sleep verb) → same 429+Retry-After surface as a
+        tenant shed, with a DISTINCT reason; waking restores service."""
+        async def run():
+            client, engines = await _start_stack()
+            body = {"model": "fake-model", "prompt": "x",
+                    "max_tokens": 1}
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 200
+            # put the WHOLE fleet to sleep through the router verb
+            r = await client.post("/sleep")
+            assert r.status == 200
+            assert all(e.sleeping for e in engines)
+            # force a FRESH load score (the cached pre-sleep 0.0 would
+            # mask the asleep-fleet +inf): the infinite score must NOT
+            # be shed as `overload` — the reason a client sees cannot
+            # depend on cache age (regression: live drive saw
+            # `overload` after 1.2s, `fleet_asleep` before)
+            get_admission_controller()._load_stamp = None
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 429, await r.text()
+            err = (await r.json())["error"]
+            assert err["code"] == "fleet_asleep"
+            assert err["code"] != "tenant_limit"
+            assert int(r.headers["Retry-After"]) >= 1
+            assert math.isfinite(err["retry_after_s"])
+            # the sleeping engines saw no traffic
+            assert sum(len(e.requests_seen) for e in engines) == 1
+            # and the admit's token was refunded (parked fleet must
+            # not drain budgets)
+            assert get_admission_controller().refunded_total == 1
+            # /debug/admission stays STRICT-JSON-parseable with the
+            # fleet asleep: the +inf score maps to the -1 sentinel
+            r = await client.get("/debug/admission")
+            data = json.loads(await r.text())  # strict parse
+            assert data["load"]["score"] == -1.0
+            assert data["load"]["dominant_signal"] == "fleet_asleep"
+            assert data["refunded_total"] == 1
+            r = await client.post("/wake_up")
+            assert r.status == 200
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 200
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_debug_admission_surface(self, reset_singletons):
+        async def run():
+            client, engines = await _start_stack()
+            get_admission_controller().apply_config({
+                "tenants": {"a": {"rate": 1.0, "burst": 1.0,
+                                  "priority": "interactive"}},
+            })
+            body = {"model": "fake-model", "prompt": "x",
+                    "max_tokens": 1}
+            hdr = {"x-tenant-id": "a"}
+            await client.post("/v1/completions", json=body, headers=hdr)
+            await client.post("/v1/completions", json=body, headers=hdr)
+            r = await client.get("/debug/admission")
+            assert r.status == 200
+            data = await r.json()
+            assert data["enabled"] and data["active"]
+            assert data["load"]["awake_backends"] == 2
+            assert data["admitted_total"] >= 1
+            assert data["shed_total"] >= 1
+            row = data["tenants"]["a"]
+            assert row["priority"] == "interactive"
+            assert row["sheds_by_reason"].get("tenant_limit", 0) >= 1
+            assert data["config"]["shed_threshold"] == 1.0
+            # metrics surface
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "tpu_router:admission_sheds" in text
+            assert "tpu_router:admission_load_score" in text
+            assert "tpu_router:shed_seconds" in text
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_fleet_asleep_with_admission_disabled_is_503(
+        self, reset_singletons
+    ):
+        """The kill switch disables ALL admission behavior: a parked
+        fleet degrades to the pre-admission 503, not a 429, and no
+        admission counters move."""
+        async def run():
+            client, engines = await _start_stack(
+                extra_args=("--no-admission-control",)
+            )
+            await client.post("/sleep")
+            r = await client.post("/v1/completions", json={
+                "model": "fake-model", "prompt": "x", "max_tokens": 1,
+            })
+            assert r.status == 503
+            ctrl = get_admission_controller()
+            assert ctrl.shed_total == 0 and ctrl.admitted_total == 0
+            await _stop_stack(client, engines)
+        asyncio.run(run())
+
+    def test_no_admission_control_flag_disables(self, reset_singletons):
+        async def run():
+            client, engines = await _start_stack(
+                extra_args=("--no-admission-control",)
+            )
+            get_admission_controller().apply_config({
+                "tenants": {"a": {"rate": 0.001, "burst": 0.001}},
+            })
+            # apply_config re-enables only the budgets, not the master
+            # switch — the CLI kill switch was explicit
+            get_admission_controller().enabled = False
+            body = {"model": "fake-model", "prompt": "x",
+                    "max_tokens": 1}
+            for _ in range(5):
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={"x-tenant-id": "a"},
+                )
+                assert r.status == 200
+            await _stop_stack(client, engines)
+        asyncio.run(run())

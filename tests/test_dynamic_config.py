@@ -1,0 +1,226 @@
+"""Direct unit tests for router/dynamic_config.py and
+router/feature_gates.py.
+
+Both were previously exercised only incidentally (helm/app wiring);
+admission control now DEPENDS on them — per-tenant budgets live in the
+dynamic config file's ``admission:`` section and the
+``AdmissionControl`` feature gate is the boot-time kill switch — so
+their contracts get pinned here: reload-on-change, malformed-file
+keeps-last-good (both at the file level and at the section level), and
+gate-flip visibility.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from production_stack_tpu.router.admission import (
+    _reset_admission_controller,
+    get_admission_controller,
+)
+from production_stack_tpu.router.dynamic_config import (
+    DynamicConfigWatcher,
+    DynamicRouterConfig,
+)
+from production_stack_tpu.router.feature_gates import (
+    FeatureGates,
+    _reset_feature_gates,
+    get_feature_gates,
+    initialize_feature_gates,
+)
+
+POLL_S = 0.05
+
+
+@pytest.fixture()
+def reset_admission():
+    yield
+    _reset_admission_controller()
+    _reset_feature_gates()
+
+
+async def _poll_until(cond, timeout_s=3.0, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout_s
+    while not cond():
+        assert asyncio.get_event_loop().time() < deadline, (
+            f"timed out waiting for {what}"
+        )
+        await asyncio.sleep(POLL_S / 2)
+
+
+# -- DynamicRouterConfig file parsing ----------------------------------------
+class TestConfigFile:
+    def test_from_yaml_and_json(self, tmp_path):
+        y = tmp_path / "c.yaml"
+        y.write_text(
+            "routing_logic: session\n"
+            "session_key: x-user-id\n"
+            "admission:\n"
+            "  tenants:\n"
+            "    a: {rate: 5}\n"
+        )
+        cfg = DynamicRouterConfig.from_file(str(y))
+        assert cfg.routing_logic == "session"
+        assert cfg.admission == {"tenants": {"a": {"rate": 5}}}
+
+        j = tmp_path / "c.json"
+        j.write_text(json.dumps(
+            {"routing_logic": "roundrobin",
+             "admission": {"enabled": False}}
+        ))
+        cfg = DynamicRouterConfig.from_file(str(j))
+        assert cfg.routing_logic == "roundrobin"
+        assert cfg.admission == {"enabled": False}
+
+    def test_unknown_keys_ignored_empty_file_defaults(self, tmp_path):
+        f = tmp_path / "c.yaml"
+        f.write_text("not_a_real_key: 1\n")
+        cfg = DynamicRouterConfig.from_file(str(f))
+        assert cfg == DynamicRouterConfig()
+        f.write_text("")
+        assert DynamicRouterConfig.from_file(str(f)).admission is None
+
+
+# -- watcher lifecycle -------------------------------------------------------
+class TestWatcher:
+    def test_initial_admission_applied_at_start(
+        self, tmp_path, reset_admission
+    ):
+        async def run():
+            f = tmp_path / "dyn.json"
+            f.write_text(json.dumps(
+                {"admission": {"tenants": {"a": {"rate": 9.0}}}}
+            ))
+            w = DynamicConfigWatcher(str(f), poll_interval_s=POLL_S)
+            await w.start()
+            assert w.get_health()
+            assert (
+                get_admission_controller().tenant_limits["a"].rate == 9.0
+            )
+            await w.close()
+        asyncio.run(run())
+
+    def test_reload_on_change(self, tmp_path, reset_admission):
+        async def run():
+            f = tmp_path / "dyn.json"
+            f.write_text(json.dumps(
+                {"admission": {"tenants": {"a": {"rate": 9.0}}}}
+            ))
+            w = DynamicConfigWatcher(str(f), poll_interval_s=POLL_S)
+            await w.start()
+            ctrl = get_admission_controller()
+            assert ctrl.tenant_limits["a"].rate == 9.0
+            # operator retunes the budget: no restart
+            f.write_text(json.dumps({"admission": {
+                "tenants": {"a": {"rate": 2.0,
+                                  "priority": "interactive"}},
+                "shed_threshold": 1.5,
+            }}))
+            await _poll_until(
+                lambda: ctrl.tenant_limits.get("a") is not None
+                and ctrl.tenant_limits["a"].rate == 2.0,
+                what="retuned tenant budget",
+            )
+            assert ctrl.tenant_limits["a"].priority == "interactive"
+            assert ctrl.shed_threshold == 1.5
+            assert w.get_current_config().admission["shed_threshold"] == 1.5
+            await w.close()
+        asyncio.run(run())
+
+    def test_malformed_file_keeps_last_good(
+        self, tmp_path, reset_admission
+    ):
+        async def run():
+            f = tmp_path / "dyn.yaml"
+            f.write_text("admission:\n  tenants:\n    a: {rate: 9}\n")
+            w = DynamicConfigWatcher(str(f), poll_interval_s=POLL_S)
+            await w.start()
+            ctrl = get_admission_controller()
+            good = w.get_current_config()
+            assert ctrl.tenant_limits["a"].rate == 9.0
+            # 1) unparseable file: watcher keeps the last-good config
+            f.write_text("admission: [unclosed\n  ")
+            await asyncio.sleep(POLL_S * 6)
+            assert w.get_current_config() == good
+            assert ctrl.tenant_limits["a"].rate == 9.0
+            # 2) parseable file, INVALID admission section: the
+            # validate-before-swap contract keeps the old budgets and
+            # the watcher keeps the old config
+            f.write_text(json.dumps(
+                {"admission": {"tenants": {"a": {"rate": -5}}}}
+            ))
+            await asyncio.sleep(POLL_S * 6)
+            assert ctrl.tenant_limits["a"].rate == 9.0
+            assert w.get_current_config() == good
+            # 3) recovery: a valid file applies again
+            f.write_text(json.dumps(
+                {"admission": {"tenants": {"a": {"rate": 4.0}}}}
+            ))
+            await _poll_until(
+                lambda: ctrl.tenant_limits["a"].rate == 4.0,
+                what="recovered config",
+            )
+            assert w.get_health()
+            await w.close()
+        asyncio.run(run())
+
+    def test_missing_initial_file_starts_degraded(
+        self, tmp_path, reset_admission
+    ):
+        async def run():
+            f = tmp_path / "nope.yaml"
+            w = DynamicConfigWatcher(str(f), poll_interval_s=POLL_S)
+            await w.start()  # logs, keeps running
+            assert w.get_current_config() is None
+            assert w.get_health()
+            f.write_text("admission:\n  tenants:\n    a: {rate: 3}\n")
+            ctrl = get_admission_controller()
+            await _poll_until(
+                lambda: "a" in ctrl.tenant_limits,
+                what="late-arriving config file",
+            )
+            await w.close()
+        asyncio.run(run())
+
+
+# -- feature gates -----------------------------------------------------------
+class TestFeatureGates:
+    def test_defaults(self, reset_admission):
+        gates = FeatureGates()
+        assert gates.enabled("AdmissionControl") is True
+        assert gates.enabled("SemanticCache") is False
+        assert gates.enabled("KVOffload") is False
+        assert gates.enabled("NotAFeature") is False
+
+    def test_spec_parsing_and_flip(self, reset_admission):
+        gates = FeatureGates(
+            "SemanticCache=true, AdmissionControl=false"
+        )
+        assert gates.enabled("SemanticCache") is True
+        assert gates.enabled("AdmissionControl") is False
+
+    def test_invalid_specs_raise(self):
+        with pytest.raises(ValueError):
+            FeatureGates("SemanticCache")  # no '='
+        with pytest.raises(ValueError):
+            FeatureGates("Bogus=true")  # unknown feature
+
+    def test_gate_flip_visible_through_singleton(self, reset_admission):
+        """Consumers read the gate lazily via the singleton — a
+        re-initialize (boot-time kill switch) is visible to every
+        later check, including the admission controller's."""
+        initialize_feature_gates("AdmissionControl=false")
+        assert get_feature_gates().enabled("AdmissionControl") is False
+        ctrl = get_admission_controller()
+        ctrl.enabled = True
+        assert ctrl.active is False  # gate kills it
+        initialize_feature_gates("AdmissionControl=true")
+        assert ctrl.active is True
+
+    def test_value_parsing_is_strict_true(self, reset_admission):
+        gates = FeatureGates("SemanticCache=TRUE,KVOffload=yes")
+        assert gates.enabled("SemanticCache") is True  # case-folded
+        assert gates.enabled("KVOffload") is False  # only true counts

@@ -1,0 +1,145 @@
+"""Tier-1 smoke gate for admission control under overload.
+
+Runs the loadgen overload scenario (scripts/router_loadgen.py
+--overload) in-process at a small scale and pins the acceptance
+contracts:
+
+- ISOLATION: a burst at 3x the noisy tenant's token-bucket budget must
+  not move the compliant tenants' p99 TTFT beyond the gated bound of
+  their unloaded baseline;
+- every shed response is a 429 carrying a FINITE Retry-After (integer
+  header >= 1 AND float retry_after_s in the body);
+- the compliant tenants are never shed, and the upstream engines see
+  ZERO errors (sheds happen at the router, before routing);
+- phase closure (sum(phases) == e2e within the gate) holds for both
+  served and SHED requests — the shed path's single tiled `shed` mark
+  is part of the closure contract;
+- the budgets reach the router through the dynamic config file (the
+  live-reload wiring is part of the scenario).
+
+Mirrors the PD-smoke pattern: when ROUTER_BENCH_OVERLOAD_PATH points
+at a bench file the CI job just wrote, that run is gated instead of
+re-running the scenario in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import json
+import logging
+import math
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "router_loadgen", REPO / "scripts" / "router_loadgen.py"
+)
+loadgen = importlib.util.module_from_spec(_spec)
+sys.modules["router_loadgen"] = loadgen
+_spec.loader.exec_module(loadgen)
+
+
+@pytest.fixture()
+def quiet_router_logs():
+    loadgen.quiet_logs()
+    yield
+    for name in list(logging.root.manager.loggerDict):
+        if name.startswith("production_stack_tpu"):
+            logging.getLogger(name).setLevel(logging.INFO)
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    from production_stack_tpu.router.admission import (
+        _reset_admission_controller,
+    )
+    from production_stack_tpu.router.routing_logic import (
+        _reset_routing_logic,
+    )
+    from production_stack_tpu.router.service_discovery import (
+        _reset_service_discovery,
+    )
+    from production_stack_tpu.router.stats.health import (
+        _reset_engine_health_board,
+    )
+
+    _reset_routing_logic()
+    _reset_service_discovery()
+    _reset_engine_health_board()
+    _reset_admission_controller()
+
+
+def test_overload_smoke(reset_singletons, quiet_router_logs):
+    bench_path = os.environ.get("ROUTER_BENCH_OVERLOAD_PATH")
+    if bench_path and Path(bench_path).exists():
+        r = json.loads(Path(bench_path).read_text())["overload"]
+    else:
+        cfg = loadgen.RunConfig(
+            engines=4, tokens=4, tokens_per_sec=4000.0,
+            overload=True,
+            ol_noisy_rate=20.0, ol_burst_factor=3.0,
+            ol_compliant_tenants=4, ol_compliant_rps=5.0,
+            ol_phase_s=4.0,
+        )
+        r = asyncio.run(loadgen.run_overload(cfg))
+
+    # the gate function IS the CI contract — assert it first so a
+    # violation names the specific gate
+    assert loadgen.overload_gates(r) == [], loadgen.overload_gates(r)
+
+    # belt-and-braces on the individual contracts (a gate-function
+    # edit that drops one of these fails here):
+    noisy = r["burst"]["noisy"]
+    compliant = r["burst"]["compliant"]
+    # the burst really was shed: ~2/3 of the noisy tenant's offered
+    # traffic is over budget
+    assert noisy["sheds"] >= noisy["served"] * 0.5
+    assert noisy["sheds"] == noisy["sheds_with_valid_retry_after"]
+    assert noisy["shed_reasons"].get("tenant_limit", 0) >= 1
+    # compliant tenants: zero sheds, zero errors, bounded p99 movement
+    assert compliant["sheds"] == 0 and compliant["errors"] == 0
+    base_p99 = r["baseline"]["compliant"]["ttft"]["p99_ms"]
+    burst_p99 = compliant["ttft"]["p99_ms"]
+    assert burst_p99 <= (
+        base_p99 * loadgen.ISOLATION_P99_FACTOR
+        + loadgen.ISOLATION_P99_SLACK_MS
+    )
+    # zero upstream errors: every shed happened BEFORE routing
+    assert r["upstream_errors_total"] == 0
+    assert r["router_errors"] == 0
+    # closure covered shed requests too
+    assert r["samples"]["shed"] >= 1
+    assert r["phase_closure"]["max_rel_err"] <= loadgen.CLOSURE_GATE
+    assert r["admission_metrics_exported"]
+    # retry-afters were real numbers, not the clamp ceiling
+    ra = noisy["retry_after"]
+    assert ra["count"] >= 1
+    assert math.isfinite(ra["p99_ms"]) and ra["p99_ms"] > 0
+
+
+def test_multiprocess_workers_merge(reset_singletons, quiet_router_logs):
+    """--workers N satellite: the forked-client mode must complete the
+    full request budget with zero errors and merged results — the
+    mechanism that pushes the harness past the single-process client
+    ceiling (ROADMAP: overload gates must run above the router's
+    saturation point)."""
+    cfg = loadgen.RunConfig(
+        requests=256, concurrency=64, workers=2, engines=2,
+        tokens=2, tokens_per_sec=8000.0,
+        algorithms=("roundrobin",),
+    )
+    results = asyncio.run(loadgen.run_suite(cfg))
+    r = results["algorithms"]["roundrobin"]
+    assert r["requests"] == 256
+    assert r["errors"] == 0 and r["router_errors"] == 0
+    assert loadgen.gates_pass(r) == []
+    # all engines saw traffic from both worker processes
+    assert sum(
+        row["requests_total"] for row in r["per_engine"]
+    ) == 256

@@ -680,6 +680,44 @@ def test_router_proxy_stays_off_blocking_paths():
     )
 
 
+def test_admission_stays_off_hot_paths():
+    """Admission control (PR 13) runs INSIDE the marked proxy hot path
+    on every request — one blocking call, swallowed exception, or
+    device sync there throttles the very traffic it is protecting:
+    router/admission/ stays at zero unsuppressed findings across the
+    blocking/silent-except/device-sync sweeps."""
+    report = analyze_paths(
+        [str(PACKAGE / "router" / "admission")],
+        select=["blocking-async", "silent-except", "device-sync-hot"],
+    )
+    assert report.files_scanned >= 4
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_admission_hot_marks_present():
+    """The sweep above only bites while the admission decision path
+    carries the hot-path mark — a dropped mark would pass silently."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    expected = {
+        "controller.py": {"admit", "release", "resolve_tenant",
+                          "load_score"},
+        "tenants.py": {"try_acquire", "_refill"},
+        "load.py": {"compute_load"},
+    }
+    for fname, needed in expected.items():
+        path = PACKAGE / "router" / "admission" / fname
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)}
+        missing = needed - hot
+        assert not missing, f"{fname}: unmarked hot paths {missing}"
+
+
 def test_router_proxy_hot_marks_present():
     """The sweep above only bites while the proxy entry points carry
     the hot-path mark — a dropped mark would pass silently."""
