@@ -36,6 +36,7 @@ on the hot path, and no wall-clock reads anywhere (monotonic only).
 from __future__ import annotations
 
 import hashlib
+import math
 import time
 from dataclasses import dataclass
 
@@ -55,9 +56,14 @@ from production_stack_tpu.router.admission.tenants import (
 from production_stack_tpu.router.feature_gates import get_feature_gates
 from production_stack_tpu.router.services.metrics_service import (
     admission_load_score,
+    fleet_awake_engines,
+    fleet_desired_replicas_hint,
+    fleet_load_score,
     observe_admission_admitted,
     observe_admission_shed,
 )
+# stats.slo imports only metrics_service — no cycle back into admission
+from production_stack_tpu.router.stats.slo import get_slo_tracker
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -96,7 +102,9 @@ class ShedDecision:
     """One load-shedding verdict: everything the 429 response, the
     metrics, and the span event need."""
 
-    reason: str  # tenant_limit | tenant_concurrency | overload | fleet_asleep
+    # tenant_limit | tenant_concurrency | overload | fleet_asleep |
+    # slo_burn
+    reason: str
     retry_after_s: float
     tenant: str
     tenant_label: str
@@ -119,6 +127,7 @@ class AdmissionController:
         delay_target_s: float = 2.0,
         shed_threshold: float = 1.0,
         asleep_retry_s: float = 10.0,
+        fleet_target_load: float = 0.75,
     ) -> None:
         self.enabled = enabled
         self.tenant_header = tenant_header.lower()
@@ -129,6 +138,10 @@ class AdmissionController:
         self.delay_target_s = delay_target_s
         self.shed_threshold = shed_threshold
         self.asleep_retry_s = asleep_retry_s
+        # load score the autoscale hint steers toward: the exported
+        # tpu_router:fleet_desired_replicas_hint is the replica count
+        # that would bring the score back to this target
+        self.fleet_target_load = fleet_target_load
         self._states: dict[str, TenantState] = {}
         self._load = LoadSignals()
         self._load_stamp: float | None = None
@@ -267,6 +280,35 @@ class AdmissionController:
                     f"in flight (cap {limits.max_concurrency})"
                 ),
             )
+
+        # SLO-budget protection (PR 13 follow-on d): a tenant burning
+        # its own fast-window error budget sheds its batch/normal
+        # traffic BEFORE the cluster-load ladder fires, protecting the
+        # tenant's interactive requests with its remaining budget. The
+        # signal reads only the latency/error objectives — never
+        # `availability`, which sheds feed (death-spiral guard in
+        # stats/slo.py) — and is off until the slo: config sets
+        # shed_burn_threshold > 0.
+        if prio != "interactive":
+            tracker = get_slo_tracker()
+            burn = tracker.shed_burn(tenant, now)
+            threshold = tracker.shed_burn_threshold
+            if burn is not None and burn >= threshold:
+                return None, self._shed(
+                    state, "slo_burn", prio, load,
+                    # no refill clock: advertise a backpressure nudge
+                    # proportional to how hot the budget is burning,
+                    # bounded well under the fast window
+                    base_retry_s=min(
+                        30.0, OVERLOAD_RETRY_SCALE_S * burn / threshold
+                    ),
+                    message=(
+                        f"tenant {tenant!r} is burning its SLO error "
+                        f"budget at {burn:.1f}x the sustainable rate "
+                        f"(threshold {threshold:g}); shedding "
+                        f"{prio}-priority traffic"
+                    ),
+                )
 
         shed_at = self.shed_threshold * PRIORITY_SHED_FRACTION.get(
             prio, PRIORITY_SHED_FRACTION["normal"]
@@ -425,7 +467,7 @@ class AdmissionController:
         known = {
             "enabled", "shed_threshold", "engine_inflight_target",
             "engine_queue_target", "delay_target_s", "asleep_retry_s",
-            "default", "tenants",
+            "fleet_target_load", "default", "tenants",
         }
         unknown = set(raw) - known
         if unknown:
@@ -454,6 +496,7 @@ class AdmissionController:
             ("engine_queue_target", int, 1),
             ("delay_target_s", float, 0.0),
             ("asleep_retry_s", float, 0.0),
+            ("fleet_target_load", float, 0.0),
         ):
             if key in raw:
                 value = cast(raw[key])
@@ -502,12 +545,36 @@ class AdmissionController:
         return dropped
 
     def export_gauges(self) -> None:
-        """Refresh the admission gauges on /metrics render (mirrors
-        the health-board gauge push in stats/log_stats.py)."""
+        """Refresh the admission + fleet-autoscale gauges on /metrics
+        render (mirrors the health-board gauge push in
+        stats/log_stats.py). The ``tpu_router:fleet_*`` family is the
+        HPA/KEDA-consumable signal the operator layer scales engine
+        replicas on (observability/prom-adapter.yaml exports it)."""
         score = self.load_score()
-        admission_load_score.set(
-            score if score != float("inf") else -1.0
-        )
+        finite = score if score != float("inf") else -1.0
+        admission_load_score.set(finite)
+        fleet_load_score.set(finite)
+        fleet_awake_engines.set(self._load.awake_backends)
+        fleet_desired_replicas_hint.set(self.desired_replicas_hint())
+
+    def desired_replicas_hint(self, sig: LoadSignals | None = None) -> int:
+        """Engine replicas that would bring the load score back to
+        ``fleet_target_load``: ``ceil(awake * score / target)``,
+        floored at 1 while ANY endpoint is discovered (a fully-asleep
+        fleet still needs one replica to wake; an empty discovery
+        hints 0 — nothing is known to scale)."""
+        if sig is None:
+            sig = self._load
+        known = sig.awake_backends + sig.sleeping_backends
+        if known == 0:
+            return 0
+        if sig.score == float("inf") or sig.awake_backends == 0:
+            return 1
+        if self.fleet_target_load <= 0:
+            return max(1, sig.awake_backends)
+        return max(1, math.ceil(
+            sig.awake_backends * sig.score / self.fleet_target_load
+        ))
 
     def snapshot(self, detail: bool = True) -> dict:
         """The /debug/admission payload."""
@@ -525,6 +592,7 @@ class AdmissionController:
                 "engine_queue_target": self.engine_queue_target,
                 "delay_target_s": self.delay_target_s,
                 "asleep_retry_s": self.asleep_retry_s,
+                "fleet_target_load": self.fleet_target_load,
                 "default": {
                     "rate": self.default_limits.rate,
                     "burst": self.default_limits.burst,
@@ -535,6 +603,15 @@ class AdmissionController:
             "admitted_total": self.admitted_total,
             "shed_total": self.shed_total,
             "refunded_total": self.refunded_total,
+            # the exported autoscale signal family, as /metrics sees it
+            "fleet": {
+                "awake_engines": load.awake_backends,
+                "load_score": (
+                    round(load.score, 4)
+                    if load.score != float("inf") else -1.0
+                ),
+                "desired_replicas_hint": self.desired_replicas_hint(load),
+            },
             "tenants": {
                 name: state.to_dict(now)
                 for name, state in sorted(self._states.items())

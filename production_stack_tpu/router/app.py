@@ -59,6 +59,10 @@ from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
     initialize_request_stats_monitor,
 )
+from production_stack_tpu.router.stats.slo import (
+    get_slo_tracker,
+    initialize_slo_tracker,
+)
 from production_stack_tpu.utils import init_logger
 from production_stack_tpu.utils.tasks import spawn_watched
 
@@ -168,7 +172,14 @@ class RouterApp:
             asleep_retry_s=getattr(
                 args, "admission_asleep_retry_s", 10.0
             ),
+            fleet_target_load=getattr(
+                args, "fleet_target_load", 0.75
+            ),
         )
+        # SLO tracking: objectives are file-only (dynamic config
+        # `slo:` section, applied by the watcher at startup) — the
+        # tracker boots inert and costs nothing until configured
+        initialize_slo_tracker()
 
         tokenizer = None
         if args.tokenizer:
@@ -263,6 +274,7 @@ class RouterApp:
         r.add_get("/engines", self.handle_engines)
         r.add_get("/debug/engines", self.handle_debug_engines)
         r.add_get("/debug/admission", self.handle_debug_admission)
+        r.add_get("/debug/slo", self.handle_debug_slo)
         r.add_get("/debug/requests", self.handle_debug_requests)
         r.add_post("/sleep", self._sleep_wake_handler)
         r.add_post("/wake_up", self._sleep_wake_handler)
@@ -475,6 +487,16 @@ class RouterApp:
         return web.json_response(
             get_admission_controller().snapshot(detail=True)
         )
+
+    async def handle_debug_slo(
+        self, request: web.Request
+    ) -> web.Response:
+        """Per-tenant SLO introspection: the configured objectives,
+        every tracked (tenant, model) row's fast/slow-window violation
+        fractions and burn rates, and lifetime violation totals — the
+        operator-side view behind the tpu_router:slo_* metrics and the
+        burn-rate alert rules (observability/tpu-stack-alerts.yaml)."""
+        return web.json_response(get_slo_tracker().snapshot())
 
     async def handle_debug_requests(
         self, request: web.Request

@@ -43,10 +43,14 @@ class DynamicRouterConfig:
     prefix_chunk_size: int | None = None
     callbacks: str | None = None
     # admission control: per-tenant budgets + overload thresholds
-    # (shape: AdmissionController.apply_config). The only section also
-    # applied at STARTUP — CLI flags cannot express per-tenant maps,
-    # so the file is their sole source.
+    # (shape: AdmissionController.apply_config). Applied at STARTUP
+    # too — CLI flags cannot express per-tenant maps, so the file is
+    # their sole source.
     admission: dict | None = None
+    # per-tenant SLO objectives + burn-rate windows (shape:
+    # SLOTracker.apply_config). Same startup-and-live-reload contract
+    # as `admission:` — the file is the sole source of objectives.
+    slo: dict | None = None
 
     @staticmethod
     def from_file(path: str) -> "DynamicRouterConfig":
@@ -95,6 +99,13 @@ class DynamicConfigWatcher:
                     "initial admission config invalid; keeping flag "
                     "defaults"
                 )
+        if self._current is not None and self._current.slo is not None:
+            try:
+                self._apply_slo(self._current.slo)
+            except Exception:
+                logger.exception(
+                    "initial slo config invalid; starting untracked"
+                )
         self._task = spawn_watched(self._watch_loop(), "dynamic-config-watch")
 
     @staticmethod
@@ -104,6 +115,12 @@ class DynamicConfigWatcher:
         )
 
         get_admission_controller().apply_config(raw)
+
+    @staticmethod
+    def _apply_slo(raw: dict) -> None:
+        from production_stack_tpu.router.stats.slo import get_slo_tracker
+
+        get_slo_tracker().apply_config(raw)
 
     async def close(self) -> None:
         if self._task:
@@ -142,6 +159,13 @@ class DynamicConfigWatcher:
         # advances on full success.
         if cfg.admission is not None:
             self._apply_admission(cfg.admission)
+
+        # slo objectives: same validate-before-swap contract as the
+        # admission section (a malformed payload raises here and the
+        # watcher keeps last-good); applied before discovery for the
+        # same churn-avoidance reason as above
+        if cfg.slo is not None:
+            self._apply_slo(cfg.slo)
 
         # discovery (reference: dynamic_config.py:157)
         if cfg.service_discovery == "static" and cfg.static_backends:

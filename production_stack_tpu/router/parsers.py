@@ -183,6 +183,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Retry-After advertised on fleet_asleep "
                           "sheds (every pool member asleep/draining)")
 
+    slo = p.add_argument_group("SLO tracking / fleet autoscale signals")
+    slo.add_argument("--fleet-target-load", type=float, default=0.75,
+                     help="load score the exported autoscale hint "
+                          "steers toward: tpu_router:fleet_desired_"
+                          "replicas_hint = ceil(awake * score / this)"
+                          " — the HPA/KEDA-consumable replica signal. "
+                          "Per-tenant SLO objectives are file-only "
+                          "(dynamic config `slo:` section, "
+                          "live-reloadable)")
+
     ext = p.add_argument_group("extensions")
     ext.add_argument("--callbacks", type=str, default=None,
                      help="module path of custom callback handler "

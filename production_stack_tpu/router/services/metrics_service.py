@@ -164,7 +164,8 @@ shared_cache_routed = Counter(
 admission_sheds = Counter(
     "tpu_router:admission_sheds",
     "Requests shed by admission control, by tenant and reason "
-    "(tenant_limit | tenant_concurrency | overload | fleet_asleep)",
+    "(tenant_limit | tenant_concurrency | overload | fleet_asleep | "
+    "slo_burn)",
     ["tenant", "reason"], registry=ROUTER_REGISTRY,
 )
 admission_admitted = Counter(
@@ -227,6 +228,73 @@ def observe_admission_admitted(
         admission_bucket_occupancy.labels(
             tenant=tenant_label
         ).observe(occupancy)
+
+
+# -- per-tenant SLO tracking (stats/slo.py) ----------------------------------
+# tenant labels are ONLY configured tenant names or "(other)"
+# (default-matched fallback identities fold into one label, same
+# hygiene as the admission counters above); `objective` is one of
+# ttft | itl | e2e | error_rate | availability
+slo_compliance_ratio = Gauge(
+    "tpu_router:slo_compliance_ratio",
+    "Fraction of requests meeting the objective over the FAST window "
+    "(1.0 = fully compliant; a tenant's worst model row)",
+    ["tenant", "objective"], registry=ROUTER_REGISTRY,
+)
+slo_budget_remaining = Gauge(
+    "tpu_router:slo_budget_remaining",
+    "Error budget left over the SLOW window (1.0 = untouched, 0 = "
+    "exhausted; a tenant's worst model row)",
+    ["tenant", "objective"], registry=ROUTER_REGISTRY,
+)
+slo_burn_rate = Gauge(
+    "tpu_router:slo_burn_rate",
+    "Error-budget burn rate (violation fraction / budget fraction; "
+    "1.0 = budget exactly exhausted over the window) per multi-window "
+    "pair (window = fast | slow)",
+    ["tenant", "objective", "window"], registry=ROUTER_REGISTRY,
+)
+# renders as tpu_router:slo_violations_total
+slo_violations = Counter(
+    "tpu_router:slo_violations",
+    "Requests that violated a tenant SLO objective",
+    ["tenant", "objective"], registry=ROUTER_REGISTRY,
+)
+
+
+def observe_slo_violations(
+    tenant_label: str, objectives,
+) -> None:
+    """Fold one request's violated objectives into the counter (called
+    via SLOTracker.observe_request on the proxy hot path)."""
+    for name in objectives:
+        slo_violations.labels(
+            tenant=tenant_label, objective=name
+        ).inc()
+
+
+# -- fleet autoscale signal family (HPA/KEDA-consumable) ---------------------
+# refreshed by AdmissionController.export_gauges on /metrics render;
+# observability/prom-adapter.yaml exports these so the operator layer
+# can scale engine replicas on the router's own load view
+fleet_load_score = Gauge(
+    "tpu_router:fleet_load_score",
+    "Cluster load score normalized per awake engine (same signal the "
+    "admission ladder sheds on; -1 = fleet fully asleep)",
+    registry=ROUTER_REGISTRY,
+)
+fleet_awake_engines = Gauge(
+    "tpu_router:fleet_awake_engines",
+    "Discovered backends currently awake (sleeping/draining excluded)",
+    registry=ROUTER_REGISTRY,
+)
+fleet_desired_replicas_hint = Gauge(
+    "tpu_router:fleet_desired_replicas_hint",
+    "Engine replica count that would bring the load score to the "
+    "configured target (ceil(awake * score / target), min 1 while "
+    "any endpoint is discovered) — feed HPA/KEDA directly",
+    registry=ROUTER_REGISTRY,
+)
 
 
 # engine health scoreboard gauges (mirror of GET /debug/engines; pushed

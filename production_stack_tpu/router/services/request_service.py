@@ -62,6 +62,7 @@ from production_stack_tpu.router.stats.health import (
 from production_stack_tpu.router.stats.request_stats import (
     get_request_stats_monitor,
 )
+from production_stack_tpu.router.stats.slo import get_slo_tracker
 from production_stack_tpu.tracing import (
     REQUEST_ID_HEADER,
     TRACEPARENT_HEADER,
@@ -119,11 +120,13 @@ def _mark_open_phase(
 
 def _shed_error_body(shed: ShedDecision) -> dict:
     """The ONE 429 body for an admission shed (general, PD, and batch
-    paths must classify identically): tenant-budget sheds are
-    ``rate_limit_exceeded``, cluster-state sheds are ``overloaded``."""
+    paths must classify identically): tenant-budget sheds (including
+    the tenant's own SLO error budget) are ``rate_limit_exceeded``,
+    cluster-state sheds are ``overloaded``."""
     kind = (
         "rate_limit_exceeded"
-        if shed.reason in ("tenant_limit", "tenant_concurrency")
+        if shed.reason in ("tenant_limit", "tenant_concurrency",
+                           "slo_burn")
         else "overloaded"
     )
     return {"error": {
@@ -321,6 +324,61 @@ class RequestService:
         admission.refund(ticket)
         return shed
 
+    # -- per-tenant SLO evaluation (stats/slo.py) --------------------------
+    @staticmethod
+    # stackcheck: hot-path — read per finished streamed request
+    def _ttft_from_clock(clock: PhaseClock) -> float:
+        """Tenant-perceived TTFT: request arrival -> first upstream
+        byte, read off the tiled phase marks (a retried request's
+        dead-backend window counts — the tenant waited through it)."""
+        phases = clock.phases
+        return (
+            phases.get("receive", 0.0)
+            + phases.get("route_decision", 0.0)
+            + phases.get("upstream_connect", 0.0)
+            + phases.get("upstream_ttft", 0.0)
+        )
+
+    @staticmethod
+    # stackcheck: hot-path — one call per finished proxied request
+    def _note_slo(
+        tenant: str | None,
+        body: dict,
+        ok: bool,
+        e2e_s: float,
+        ttft_s: float | None = None,
+        tokens: int = 0,
+        span: Span | None = None,
+    ) -> tuple[str, ...]:
+        """Evaluate one finished request against the tenant's SLO
+        objectives (no-op when none are configured). Latencies are the
+        TENANT's view (see ``_ttft_from_clock``); ITL is the streaming
+        average over ``tokens`` units — SSE events for event-stream
+        upstreams (TCP chunk framing must not move a latency SLO),
+        relay chunks otherwise. Violations export as an
+        ``slo_violation`` span event so shed triage can join
+        /debug/requests with the burn dashboards."""
+        tracker = get_slo_tracker()
+        if not tracker.active:
+            return ()
+        itl_s = None
+        if ttft_s is not None and tokens > 1:
+            itl_s = (e2e_s - ttft_s) / (tokens - 1)
+        violated = tracker.observe_request(
+            tenant, body.get("model"), ok,
+            e2e_s=e2e_s, ttft_s=ttft_s, itl_s=itl_s,
+        )
+        if violated and span is not None:
+            span.add_event("slo_violation", {
+                "objectives": ",".join(violated),
+                "tenant": tenant or "(anonymous)",
+                "e2e_s": round(e2e_s, 6),
+                "ttft_s": (
+                    round(ttft_s, 6) if ttft_s is not None else None
+                ),
+            })
+        return violated
+
     # -- main entry (reference: request.py:141) ----------------------------
     # stackcheck: hot-path — per-request proxy entry; no blocking calls
     async def route_general_request(
@@ -350,6 +408,14 @@ class RequestService:
         )
         if shed is not None:
             return self._shed_response(clock, shed, request_id)
+        # SLO attribution needs the tenant even when admission is OFF
+        # (kill switch / feature gate): the identity ladder is pure —
+        # resolve it iff objectives are configured, so the no-SLO
+        # no-admission hot path stays zero-work
+        tenant = ticket.name if ticket is not None else (
+            admission.resolve_tenant(request.headers, request.remote)
+            if get_slo_tracker().active else None
+        )
         try:
             # PD branch (reference: request.py:159-163). PDRouter
             # requests may still serve single-phase (prefix-affine
@@ -359,7 +425,7 @@ class RequestService:
             if isinstance(router, (DisaggregatedPrefillRouter, PDRouter)):
                 return await self.route_disaggregated_prefill_request(
                     request, endpoint_path, body, request_id,
-                    ticket=ticket,
+                    ticket=ticket, tenant=tenant,
                 )
 
             # pre-request callback (reference: request.py:175-181)
@@ -439,7 +505,7 @@ class RequestService:
             ][:MAX_CONNECT_RETRIES]
             return await self.process_request(
                 request, body, url, endpoint_path, request_id,
-                clock=clock, alternates=alternates,
+                clock=clock, alternates=alternates, tenant=tenant,
             )
         finally:
             admission.release(ticket)
@@ -496,6 +562,7 @@ class RequestService:
         stats_url: str | None = None,
         clock: PhaseClock | None = None,
         alternates: list[str] | tuple[str, ...] = (),
+        tenant: str | None = None,
     ) -> web.StreamResponse:
         monitor = get_request_stats_monitor()
         board = get_engine_health_board()
@@ -587,6 +654,13 @@ class RequestService:
                 completed = False  # monitor.on_request_complete ran
                 observed = False   # record_proxy_observation ran
                 tokens_relayed = 0
+                # SSE event count for the SLO ITL denominator: TCP
+                # buffering coalesces/splits iter_any() chunks, so
+                # chunk count would judge transport framing, not
+                # model latency (tokens_relayed keeps the historical
+                # chunk semantics the relay metrics are gated on)
+                sse_units = 0
+                prev_nl = False
                 ttft_s: float | None = None
                 captured: list[bytes] = []
                 try:
@@ -607,6 +681,9 @@ class RequestService:
                         await _to_client(resp.prepare(request))
                         prepared = True
                         committed = resp
+                        is_sse = upstream.headers.get(
+                            "Content-Type", ""
+                        ).startswith("text/event-stream")
                         async for chunk in upstream.content.iter_any():
                             if not first_chunk_seen:
                                 first_chunk_seen = True
@@ -620,6 +697,15 @@ class RequestService:
                             else:
                                 monitor.on_token(surl, request_id)
                             tokens_relayed += 1
+                            if is_sse and chunk:
+                                sse_units += chunk.count(b"\n\n")
+                                if prev_nl and chunk[:1] == b"\n":
+                                    # "\n\n" split across chunks
+                                    sse_units += 1
+                                prev_nl = (
+                                    chunk.endswith(b"\n")
+                                    and not chunk.endswith(b"\n\n")
+                                )
                             if cache_body and upstream.status == 200:
                                 captured.append(chunk)
                             await _to_client(resp.write(chunk))
@@ -661,6 +747,19 @@ class RequestService:
                             since=ckpt,
                         )
                         observed = True
+                        self._note_slo(
+                            tenant, body,
+                            ok=upstream.status < 500,
+                            e2e_s=clock.elapsed_s,
+                            ttft_s=(
+                                self._ttft_from_clock(clock)
+                                if first_chunk_seen else None
+                            ),
+                            tokens=(
+                                sse_units if is_sse else tokens_relayed
+                            ),
+                            span=span,
+                        )
                         if span is not None:
                             self._emit_phase_spans(
                                 span, clock, request_id, attempt_windows
@@ -744,6 +843,13 @@ class RequestService:
                             engine_fault=False, since=ckpt,
                         )
                     raise
+            # terminal upstream failure (every candidate burned, or a
+            # committed stream died): ONE per-request SLO observation —
+            # client disconnects/cancellations never reach here, so
+            # only engine-fault outcomes count against error budgets
+            self._note_slo(
+                tenant, body, ok=False, e2e_s=clock.elapsed_s, span=span,
+            )
             if committed is not None:
                 # the client stream is already committed to a failed
                 # backend: a fresh 502 body cannot go out on this
@@ -863,6 +969,11 @@ class RequestService:
                     url, clock, ok=ok, error_kind=kind,
                     record_sample=False
                 )
+                # whole-body reads have no streaming TTFT/ITL: only
+                # the e2e/error/availability objectives evaluate
+                self._note_slo(
+                    "batch-api", body, ok=ok, e2e_s=clock.elapsed_s,
+                )
                 self.in_flight -= 1
         finally:
             admission.release(ticket)
@@ -875,6 +986,7 @@ class RequestService:
         body: dict,
         request_id: str,
         ticket=None,
+        tenant: str | None = None,
     ) -> web.StreamResponse:
         router = get_routing_logic()
         assert isinstance(router, (DisaggregatedPrefillRouter, PDRouter))
@@ -923,7 +1035,7 @@ class RequestService:
                     # whole chain — no handoff, one phase
                     return await self.process_request(
                         request, body, decode_url, endpoint_path,
-                        request_id,
+                        request_id, tenant=tenant,
                     )
             else:
                 prefill_url, decode_url = (
@@ -980,6 +1092,10 @@ class RequestService:
                         error_kind=f"http_{pr.status}",
                         record_sample=False,
                     )
+                    self._note_slo(
+                        tenant, body, ok=pr.status < 500,
+                        e2e_s=time.monotonic() - t0,
+                    )
                     return web.json_response(
                         {"error": {"message":
                                    f"prefiller error: {detail[:500]}",
@@ -995,6 +1111,9 @@ class RequestService:
             board.observe(
                 prefill_url, {}, time.monotonic() - t0,
                 ok=False, error_kind="connect", record_sample=False,
+            )
+            self._note_slo(
+                tenant, body, ok=False, e2e_s=time.monotonic() - t0,
             )
             return web.json_response(
                 {"error": {"message": f"prefiller unreachable: {e}",
@@ -1024,7 +1143,7 @@ class RequestService:
         )
         return await self.process_request(
             request, decode_body, decode_url, endpoint_path, request_id,
-            stats_url=decode_url,
+            stats_url=decode_url, tenant=tenant,
         )
 
     # -- sleep/wake passthrough (reference: request.py:444-520) ------------

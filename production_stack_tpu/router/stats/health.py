@@ -322,16 +322,23 @@ def record_shed_observation(
     board's ring (so the loadgen closure gate covers shed requests —
     ``shed: True`` keeps them out of per-engine error accounting; no
     backend was ever touched, so no scoreboard row moves) plus the
-    ``tpu_router:shed_seconds`` histogram."""
+    ``tpu_router:shed_seconds`` histogram. Sheds also fold into the
+    tenant's SLO ``availability`` window (stats/slo.py): from the
+    tenant's view a shed request was not served — but NEVER into the
+    latency/error objectives that feed admission's shed signal back."""
+    # read the independent e2e FIRST: a shed request is microseconds
+    # long, so every instruction between the caller's final mark and
+    # this read — even a cached import statement — is relative
+    # closure error (everything below, the SLO fold included, must
+    # stay AFTER this read)
+    e2e_s = clock.elapsed_s
     from production_stack_tpu.router.services.metrics_service import (
         admission_shed_seconds,
     )
+    from production_stack_tpu.router.stats.slo import get_slo_tracker
 
     phases = clock.phases
-    # read the independent e2e IMMEDIATELY: a shed request is
-    # microseconds long, so every instruction between the final mark
-    # and this read is relative closure error
-    e2e_s = clock.elapsed_s
+    get_slo_tracker().observe_shed(tenant)
     admission_shed_seconds.observe(phases.get("shed", 0.0))
     get_engine_health_board().samples.append({
         "url": None,

@@ -45,6 +45,15 @@ def update_prometheus_and_render() -> str:
     admission.export_gauges()
     admission.prune()
 
+    # per-tenant SLO tracking: burn-rate/compliance/budget gauges
+    # refresh on render (violations count on the hot path); idle
+    # default-matched rows pruned with the same hygiene as above
+    from production_stack_tpu.router.stats.slo import get_slo_tracker
+
+    slo = get_slo_tracker()
+    slo.export_gauges()
+    slo.prune()
+
     # health scoreboard gauges (mirror of /debug/engines; histograms
     # observe on the hot path, gauges refresh here on render/scrape)
     board = get_engine_health_board()

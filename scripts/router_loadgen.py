@@ -94,6 +94,11 @@ ERROR_RATE_GATE = 0.01
 # scheduling noise at millisecond scales)
 ISOLATION_P99_FACTOR = 3.0
 ISOLATION_P99_SLACK_MS = 150.0
+# SLO attribution gates: compliant tenants must end the run fully
+# within their (generous) objectives while the noisy tenant's
+# availability burn rate is visibly moving — per-tenant SLO
+# attribution catching exactly what a fleet-average view hides
+SLO_COMPLIANCE_GATE = 0.99
 
 
 @dataclass
@@ -635,11 +640,13 @@ async def run_overload(cfg: RunConfig) -> dict:
         get_admission_controller,
     )
     from production_stack_tpu.router.app import build_app
+    from production_stack_tpu.router.stats.slo import _reset_slo_tracker
 
     _reset_routing_logic()
     _reset_service_discovery()
     _reset_engine_health_board()
     _reset_admission_controller()
+    _reset_slo_tracker()
 
     engines = [
         FakeEngine(
@@ -670,10 +677,27 @@ async def run_overload(cfg: RunConfig) -> dict:
             "rate": cfg.ol_compliant_rps * 3,
             "priority": "interactive",
         }
+    # SLO objectives beside the budgets (slo: section, same watcher
+    # path): compliant tenants get deliberately generous targets — a
+    # well-behaved tenant must end the run fully compliant — while the
+    # noisy tenant's availability objective makes its sheds VISIBLE as
+    # error-budget burn (per-tenant attribution a fleet view hides)
+    slo_objectives: dict = {
+        "noisy": {"availability": 0.99},
+    }
+    for i in range(cfg.ol_compliant_tenants):
+        slo_objectives[f"compliant-{i}"] = {
+            "ttft_p99_s": 2.0,
+            "error_rate": 0.01,
+            "availability": 0.999,
+        }
     dyn_cfg = tempfile.NamedTemporaryFile(
         "w", suffix=".json", delete=False
     )
-    json.dump({"admission": {"tenants": tenants}}, dyn_cfg)
+    json.dump({
+        "admission": {"tenants": tenants},
+        "slo": {"objectives": slo_objectives},
+    }, dyn_cfg)
     dyn_cfg.close()
 
     argv = [
@@ -712,6 +736,13 @@ async def run_overload(cfg: RunConfig) -> dict:
         assert get_admission_controller().tenant_limits, (
             "admission budgets from the dynamic config were not applied"
         )
+        from production_stack_tpu.router.stats.slo import (
+            get_slo_tracker,
+        )
+
+        assert get_slo_tracker().active, (
+            "slo objectives from the dynamic config were not applied"
+        )
         # phase A — baseline: compliant tenants alone
         base_recs = {t: _tenant_rec() for t in compliant_names}
         await asyncio.gather(*(
@@ -740,6 +771,8 @@ async def run_overload(cfg: RunConfig) -> dict:
             metrics_text = await r.text()
         async with client.get(f"{base}/debug/admission") as r:
             admission_debug = await r.json()
+        async with client.get(f"{base}/debug/slo") as r:
+            slo_debug = await r.json()
         async with client.get(f"{base}/debug/engines") as r:
             scoreboard = (await r.json())["engines"]
 
@@ -752,6 +785,7 @@ async def run_overload(cfg: RunConfig) -> dict:
     _reset_routing_logic()
     _reset_service_discovery()
     _reset_admission_controller()
+    _reset_slo_tracker()
 
     # phase closure across SERVED and SHED requests alike: the shed
     # path's single tiled `shed` mark must keep sum(phases) == e2e
@@ -806,6 +840,7 @@ async def run_overload(cfg: RunConfig) -> dict:
             "tpu_router:admission_sheds" in metrics_text
             and "tpu_router:shed_seconds" in metrics_text
         ),
+        "slo": _slo_summary(slo_debug, metrics_text),
         "admission_debug": {
             "load": admission_debug.get("load"),
             "admitted_total": admission_debug.get("admitted_total"),
@@ -814,6 +849,56 @@ async def run_overload(cfg: RunConfig) -> dict:
         "per_engine": scoreboard,
     }
     return result
+
+
+def _slo_summary(slo_debug: dict, metrics_text: str) -> dict:
+    """Fold the /debug/slo payload into the per-tenant attribution
+    summary the SLO gates read: each compliant tenant's WORST
+    fast-window compliance + total violations, and the noisy tenant's
+    availability burn rate (its sheds made visible as budget burn)."""
+    compliant: dict[str, dict] = {}
+    noisy_burn = -1.0
+    noisy_violations = 0
+    for row in slo_debug.get("tenants", []):
+        tenant = row["tenant"]
+        fast = row.get("fast", {})
+        if tenant == "noisy":
+            avail = fast.get("availability", {})
+            noisy_burn = max(noisy_burn, avail.get("burn_rate", -1.0))
+            noisy_violations += sum(row["violations_total"].values())
+        elif tenant.startswith("compliant"):
+            rec = compliant.setdefault(tenant, {
+                "compliance_ratio": 1.0, "violations_total": 0,
+                "requests": 0,
+            })
+            for view in fast.values():
+                rec["compliance_ratio"] = min(
+                    rec["compliance_ratio"],
+                    1.0 - view["violation_fraction"],
+                )
+                rec["requests"] = max(rec["requests"], view["requests"])
+            rec["violations_total"] += sum(
+                row["violations_total"].values()
+            )
+    return {
+        "active": slo_debug.get("active", False),
+        "compliant": compliant,
+        "noisy_availability_burn_rate": noisy_burn,
+        "noisy_violations_total": noisy_violations,
+        "metrics_exported": (
+            "tpu_router:slo_compliance_ratio" in metrics_text
+            and "tpu_router:slo_burn_rate" in metrics_text
+        ),
+        # the ISSUE 15 acceptance scrape: the autoscale family must be
+        # present on a LIVE /metrics render
+        "fleet_metrics_exported": all(
+            name in metrics_text for name in (
+                "tpu_router:fleet_load_score",
+                "tpu_router:fleet_awake_engines",
+                "tpu_router:fleet_desired_replicas_hint",
+            )
+        ),
+    }
 
 
 def overload_gates(r: dict) -> list[str]:
@@ -867,6 +952,36 @@ def overload_gates(r: dict) -> list[str]:
                    "never covered the shed path)")
     if not r["admission_metrics_exported"]:
         bad.append("tpu_router:admission_* metrics missing from /metrics")
+    # SLO attribution: compliant tenants hold their objectives while
+    # the noisy tenant's budget burn is observed moving
+    slo = r.get("slo", {})
+    if not slo.get("active"):
+        bad.append("slo objectives were not applied (tracker inactive)")
+    else:
+        if not slo["compliant"]:
+            bad.append("no compliant-tenant SLO rows tracked")
+        for tenant, rec in slo["compliant"].items():
+            if rec["violations_total"]:
+                bad.append(
+                    f"slo: compliant {tenant} has "
+                    f"{rec['violations_total']} violations"
+                )
+            if rec["compliance_ratio"] < SLO_COMPLIANCE_GATE:
+                bad.append(
+                    f"slo: compliant {tenant} compliance "
+                    f"{rec['compliance_ratio']} < {SLO_COMPLIANCE_GATE}"
+                )
+        if slo["noisy_availability_burn_rate"] <= 0:
+            bad.append(
+                "slo: noisy tenant's availability burn rate never "
+                "moved (sheds are not reaching the tracker)"
+            )
+        if not slo["metrics_exported"]:
+            bad.append("tpu_router:slo_* metrics missing from /metrics")
+        if not slo["fleet_metrics_exported"]:
+            bad.append(
+                "tpu_router:fleet_* metrics missing from /metrics"
+            )
     # the noisy tenant must not be able to push more than its budget
     # through: burst capacity + rate x phase + scheduling slack
     scn = r["scenario"]
@@ -1060,6 +1175,8 @@ def main(argv: list[str] | None = None) -> int:
             f"{burst['compliant']['ttft']['p99_ms']}ms "
             f"noisy_served={burst['noisy']['served']} "
             f"noisy_sheds={burst['noisy']['sheds']} "
+            f"noisy_slo_burn="
+            f"{result['slo']['noisy_availability_burn_rate']} "
             f"upstream_errors={result['upstream_errors_total']} "
             f"closure_max={result['phase_closure']['max_rel_err']}",
             flush=True,

@@ -167,6 +167,53 @@ class TestWatcher:
             await w.close()
         asyncio.run(run())
 
+    def test_slo_section_applies_and_keeps_last_good(
+        self, tmp_path, reset_admission
+    ):
+        """The `slo:` section rides the same watcher contract as
+        `admission:`: applied at startup, retuned live on change, and
+        validate-before-swap keeps the last-good objectives when an
+        edit is malformed."""
+        from production_stack_tpu.router.stats.slo import (
+            _reset_slo_tracker,
+            get_slo_tracker,
+        )
+
+        async def run():
+            f = tmp_path / "dyn.json"
+            f.write_text(json.dumps({"slo": {"objectives": {
+                "a": {"ttft_p99_s": 0.5},
+            }}}))
+            w = DynamicConfigWatcher(str(f), poll_interval_s=POLL_S)
+            await w.start()
+            tracker = get_slo_tracker()
+            assert tracker.active
+            assert tracker._objectives["a"].ttft_p99_s == 0.5
+            # live retune
+            f.write_text(json.dumps({"slo": {
+                "objectives": {"a": {"ttft_p99_s": 2.0}},
+                "shed_burn_threshold": 5.0,
+            }}))
+            await _poll_until(
+                lambda: tracker._objectives["a"].ttft_p99_s == 2.0,
+                what="retuned slo objective",
+            )
+            assert tracker.shed_burn_threshold == 5.0
+            # invalid section: validate-before-swap keeps last-good
+            good = w.get_current_config()
+            f.write_text(json.dumps({"slo": {"objectives": {
+                "a": {"ttft_p99": 1.0},  # typo'd key
+            }}}))
+            await asyncio.sleep(POLL_S * 6)
+            assert tracker._objectives["a"].ttft_p99_s == 2.0
+            assert w.get_current_config() == good
+            await w.close()
+        _reset_slo_tracker()
+        try:
+            asyncio.run(run())
+        finally:
+            _reset_slo_tracker()
+
     def test_missing_initial_file_starts_degraded(
         self, tmp_path, reset_admission
     ):

@@ -15,7 +15,13 @@ contracts:
   served and SHED requests — the shed path's single tiled `shed` mark
   is part of the closure contract;
 - the budgets reach the router through the dynamic config file (the
-  live-reload wiring is part of the scenario).
+  live-reload wiring is part of the scenario);
+- per-tenant SLO attribution (ISSUE 15): compliant tenants end the
+  run fully compliant with ZERO slo violations while the noisy
+  tenant's ``availability`` burn rate is observed moving (its sheds
+  made visible as error-budget burn), with the ``tpu_router:slo_*``
+  and ``tpu_router:fleet_*`` families present in a live /metrics
+  scrape.
 
 Mirrors the PD-smoke pattern: when ROUTER_BENCH_OVERLOAD_PATH points
 at a bench file the CI job just wrote, that run is gated instead of
@@ -68,11 +74,15 @@ def reset_singletons():
     from production_stack_tpu.router.stats.health import (
         _reset_engine_health_board,
     )
+    from production_stack_tpu.router.stats.slo import (
+        _reset_slo_tracker,
+    )
 
     _reset_routing_logic()
     _reset_service_discovery()
     _reset_engine_health_board()
     _reset_admission_controller()
+    _reset_slo_tracker()
 
 
 def test_overload_smoke(reset_singletons, quiet_router_logs):
@@ -121,6 +131,21 @@ def test_overload_smoke(reset_singletons, quiet_router_logs):
     ra = noisy["retry_after"]
     assert ra["count"] >= 1
     assert math.isfinite(ra["p99_ms"]) and ra["p99_ms"] > 0
+    # per-tenant SLO attribution: every compliant tenant fully within
+    # its objectives (zero violations, compliance at the gate), the
+    # noisy tenant's availability budget visibly burning — and the
+    # slo/fleet metric families on the live scrape
+    slo = r["slo"]
+    assert slo["active"]
+    assert len(slo["compliant"]) >= 1
+    for tenant, rec in slo["compliant"].items():
+        assert rec["violations_total"] == 0, (tenant, rec)
+        assert rec["compliance_ratio"] >= loadgen.SLO_COMPLIANCE_GATE
+        assert rec["requests"] > 0
+    assert slo["noisy_availability_burn_rate"] > 0
+    assert slo["noisy_violations_total"] >= noisy["sheds"]
+    assert slo["metrics_exported"]
+    assert slo["fleet_metrics_exported"]
 
 
 def test_multiprocess_workers_merge(reset_singletons, quiet_router_logs):

@@ -1,0 +1,737 @@
+"""Per-tenant SLO tracking (router/stats/slo.py) — ISSUE 15 tentpole.
+
+Unit tier: bucket-ring window math under monotonic-clock discipline
+(every method takes an explicit ``now`` — pinned like
+test_admission.py pins the admission clocks), burn-rate / compliance /
+budget arithmetic at exact stamps, objective matching precedence
+(tenant/model > tenant > default), the shed->availability-only fold
+and the death-spiral guard (availability never feeds ``shed_burn``),
+config validation (validate-before-swap keeps last-good), the
+zero-configured-tenants zero-overhead contract (poisoned clock), row
+pruning, gauge export aggregation, and the admission ``slo_burn`` shed
+integration + fleet autoscale hint.
+
+E2E tier: the real router app + fake engines over HTTP — objectives
+arriving through the dynamic config file, /debug/slo, the
+``tpu_router:slo_*`` + ``tpu_router:fleet_*`` series on a live
+/metrics render, and the ``slo_violation`` span event.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from pathlib import Path
+
+import pytest
+
+from production_stack_tpu.router import parsers
+from production_stack_tpu.router.admission import (
+    AdmissionController,
+    _reset_admission_controller,
+)
+from production_stack_tpu.router.admission.load import LoadSignals
+from production_stack_tpu.router.feature_gates import (
+    _reset_feature_gates,
+)
+from production_stack_tpu.router.routing_logic import _reset_routing_logic
+from production_stack_tpu.router.service_discovery import (
+    _reset_service_discovery,
+)
+from production_stack_tpu.router.stats.health import (
+    _reset_engine_health_board,
+)
+from production_stack_tpu.router.stats.slo import (
+    OBJECTIVES,
+    SLOObjective,
+    SLOTracker,
+    _reset_slo_tracker,
+    get_slo_tracker,
+    initialize_slo_tracker,
+)
+
+from tests.fake_engine import FakeEngine
+
+T0 = 5000.0  # pinned monotonic origin
+
+
+@pytest.fixture()
+def reset_singletons():
+    yield
+    _reset_routing_logic()
+    _reset_service_discovery()
+    _reset_engine_health_board()
+    _reset_admission_controller()
+    _reset_slo_tracker()
+    _reset_feature_gates()
+
+
+def _tracker(**overrides) -> SLOTracker:
+    cfg = {
+        "objectives": {
+            "team-a": {"ttft_p99_s": 0.5, "e2e_p99_s": 5.0,
+                       "error_rate": 0.01, "availability": 0.999},
+        },
+    }
+    cfg.update(overrides)
+    t = SLOTracker()
+    t.apply_config(cfg)
+    return t
+
+
+# -- clock discipline --------------------------------------------------------
+def test_no_wall_clock_in_slo_source():
+    """Same pin as test_admission.py: burn/refill math must never
+    ride wall-clock steps — time.time() is banned from the module."""
+    src = (
+        Path(__file__).resolve().parent.parent
+        / "production_stack_tpu" / "router" / "stats" / "slo.py"
+    ).read_text()
+    assert "time.time(" not in src
+    assert "datetime" not in src
+
+
+def test_zero_configured_tenants_zero_overhead(monkeypatch):
+    """The satellite contract: with no objectives configured the
+    per-request feed does NOTHING — not even a clock read. Pinned by
+    poisoning the clock: any monotonic() call raises."""
+    t = SLOTracker()
+
+    def boom():
+        raise AssertionError("hot path touched the clock while idle")
+
+    monkeypatch.setattr("time.monotonic", boom)
+    assert t.observe_request("a", "m", True, e2e_s=1.0) == ()
+    assert t.observe_shed("a") is None
+    assert t.shed_burn("a") is None
+    assert t._rows == {}
+    # disabled-but-configured short-circuits identically
+    t2 = _tracker()
+    t2.enabled = False
+    monkeypatch.setattr("time.monotonic", boom)
+    assert t2.observe_request("team-a", "m", True, e2e_s=1.0) == ()
+
+
+# -- objective spec validation ----------------------------------------------
+class TestObjectiveSpec:
+    def test_unknown_keys_raise(self):
+        with pytest.raises(ValueError, match="unknown slo objective"):
+            SLOObjective.from_dict({"ttft_p99_ms": 500})
+
+    def test_out_of_range_raise(self):
+        with pytest.raises(ValueError):
+            SLOObjective.from_dict({"ttft_p99_s": -1})
+        with pytest.raises(ValueError):
+            SLOObjective.from_dict({"error_rate": 1.5})
+        with pytest.raises(ValueError):
+            SLOObjective.from_dict({"availability": 1.0})
+        with pytest.raises(ValueError):
+            SLOObjective.from_dict(
+                {"ttft_p99_s": 1.0, "target": 0.0}
+            )
+
+    def test_tracks_nothing_raises(self):
+        with pytest.raises(ValueError, match="tracks nothing"):
+            SLOObjective.from_dict({"target": 0.99})
+
+    def test_budget_fractions(self):
+        spec = SLOObjective.from_dict({
+            "ttft_p99_s": 0.5, "error_rate": 0.02,
+            "availability": 0.995, "target": 0.9,
+        })
+        assert spec.budget_fraction("ttft") == pytest.approx(0.1)
+        assert spec.budget_fraction("error_rate") == 0.02
+        assert spec.budget_fraction("availability") == (
+            pytest.approx(0.005)
+        )
+        assert set(spec.tracked()) == {
+            "ttft", "error_rate", "availability"
+        }
+        assert all(name in OBJECTIVES for name in spec.tracked())
+
+
+# -- config swap -------------------------------------------------------------
+class TestApplyConfig:
+    def test_unknown_keys_keep_last_good(self):
+        t = _tracker()
+        before = dict(t._objectives)
+        with pytest.raises(ValueError):
+            t.apply_config({"objectivs": {}})  # typo'd key
+        with pytest.raises(ValueError):
+            t.apply_config({"objectives": {"x": {"ttft_p99": 1}}})
+        assert t._objectives == before
+
+    def test_window_validation(self):
+        t = _tracker()
+        with pytest.raises(ValueError):
+            t.apply_config({"fast_window_s": 0})
+        with pytest.raises(ValueError):
+            t.apply_config(
+                {"fast_window_s": 600, "slow_window_s": 300}
+            )
+
+    def test_window_retune_restarts_measurement(self):
+        t = _tracker()
+        t.observe_request("team-a", "m", True, e2e_s=9.0, now=T0)
+        assert t._rows
+        t.apply_config({"fast_window_s": 60.0})
+        assert t._rows == {}
+
+    def test_dropped_spec_removes_rows(self):
+        t = _tracker()
+        t.observe_request("team-a", "m", True, e2e_s=1.0, now=T0)
+        assert t._rows
+        t.apply_config({"objectives": {
+            "team-b": {"ttft_p99_s": 1.0},
+        }})
+        assert t._rows == {}
+        assert t.observe_request(
+            "team-a", "m", True, e2e_s=9.0, now=T0
+        ) == ()
+
+    def test_changed_spec_drops_row_and_its_burn(self):
+        """An operator RETUNING an objective declares a fresh budget:
+        the old row (and the burn measured against the old spec) must
+        go immediately — a tenant whose batch traffic is being shed on
+        that burn sends no served requests to rebuild the row lazily,
+        so a lazy rebuild would hold the shed for the whole fast
+        window. Unchanged specs keep their history."""
+        t = _tracker(
+            shed_burn_threshold=2.0,
+            objectives={"hot": {"ttft_p99_s": 1e-9},
+                        "steady": {"ttft_p99_s": 0.5}},
+        )
+        for i in range(10):
+            t.observe_request("hot", "m", True, ttft_s=1.0,
+                              now=T0 + i * 0.01)
+        t.observe_request("steady", "m", True, ttft_s=1.0, now=T0)
+        assert t.shed_burn("hot", now=T0 + 1) == pytest.approx(100.0)
+        t.apply_config({"objectives": {
+            "hot": {"ttft_p99_s": 30.0},     # relaxed
+            "steady": {"ttft_p99_s": 0.5},   # unchanged
+        }})
+        assert ("hot", "m") not in t._rows
+        assert t.shed_burn("hot", now=T0 + 2) is None or (
+            t.shed_burn("hot", now=T0 + 2) == 0.0
+        )
+        # the unchanged tenant's history survived the re-apply
+        assert t._rows[("steady", "m")].violations_total == {"ttft": 1}
+
+    def test_model_scoped_availability_rejected(self):
+        """availability is tenant-scoped by design (sheds land before
+        routing resolves a model): a `tenant/model` key declaring it
+        would validate but never be evaluated — apply_config must
+        reject it loudly and keep last-good."""
+        t = _tracker()
+        before = dict(t._objectives)
+        with pytest.raises(ValueError, match="model-scoped"):
+            t.apply_config({"objectives": {
+                "team-a/big": {"availability": 0.999},
+            }})
+        assert t._objectives == before
+
+    def test_matching_precedence_and_label_fold(self):
+        t = SLOTracker()
+        t.apply_config({"objectives": {
+            "team-a": {"ttft_p99_s": 0.5},
+            "team-a/big": {"ttft_p99_s": 2.0},
+            "default": {"availability": 0.99},
+        }})
+        # model override: 1s TTFT violates the tenant-wide 0.5s spec
+        # but NOT the per-model 2s override
+        assert t.observe_request(
+            "team-a", "big", True, ttft_s=1.0, now=T0
+        ) == ()
+        assert t.observe_request(
+            "team-a", "small", True, ttft_s=1.0, now=T0
+        ) == ("ttft",)
+        # unconfigured tenant matches default and folds to (other)
+        t.observe_shed("ip:10.0.0.9", now=T0)
+        row = t._rows[("ip:10.0.0.9", "")]
+        assert row.label == "(other)" and not row.configured
+        assert t._rows[("team-a", "small")].label == "team-a"
+
+
+# -- window / burn math ------------------------------------------------------
+class TestWindowMath:
+    def test_exact_burn_rates(self):
+        t = _tracker()
+        # 100 requests, 5 TTFT violations: frac 0.05, budget 0.01
+        # (target 0.99) -> burn 5.0 on both windows
+        for i in range(100):
+            t.observe_request(
+                "team-a", "m", True,
+                e2e_s=0.1, ttft_s=(0.9 if i < 5 else 0.1),
+                now=T0 + i * 0.1,
+            )
+        row = t._rows[("team-a", "m")]
+        fast = row.window_view(T0 + 10, t.fast_window_s)
+        assert fast["ttft"]["requests"] == 100
+        assert fast["ttft"]["violations"] == 5
+        assert fast["ttft"]["burn_rate"] == pytest.approx(5.0)
+        assert fast["error_rate"]["burn_rate"] == 0.0
+        slow = row.window_view(T0 + 10, t.slow_window_s)
+        assert slow["ttft"]["burn_rate"] == pytest.approx(5.0)
+
+    def test_fast_window_expires_slow_retains(self):
+        t = _tracker()
+        t.observe_request(
+            "team-a", "m", True, ttft_s=9.0, e2e_s=9.0, now=T0
+        )
+        row = t._rows[("team-a", "m")]
+        # past the fast window (+ a granule for bucket quantization):
+        # fast empty, slow still holds the violation
+        later = T0 + t.fast_window_s + row.ring.granule_s + 1
+        fast = row.window_view(later, t.fast_window_s)
+        slow = row.window_view(later, t.slow_window_s)
+        assert fast["ttft"]["requests"] == 0
+        assert slow["ttft"]["violations"] == 1
+        # past the slow window the ring has recycled the bucket
+        way_later = T0 + t.slow_window_s + row.ring.granule_s + 1
+        slow2 = row.window_view(way_later, t.slow_window_s)
+        assert slow2["ttft"]["requests"] == 0
+
+    def test_latency_objectives_served_requests_only(self):
+        """An errored request burns error_rate/availability — not the
+        latency windows (fast-fail timings would poison them)."""
+        t = _tracker()
+        violated = t.observe_request(
+            "team-a", "m", False, e2e_s=0.001, ttft_s=0.001, now=T0
+        )
+        assert set(violated) == {"error_rate", "availability"}
+        row = t._rows[("team-a", "m")]
+        fast = row.window_view(T0 + 1, t.fast_window_s)
+        assert fast["ttft"]["requests"] == 0
+        assert fast["e2e"]["requests"] == 0
+        assert fast["error_rate"]["violations"] == 1
+        # availability is tenant-scoped: it lands on the model-less
+        # row, where sheds also land (one shared window)
+        assert fast["availability"]["requests"] == 0
+        arow = t._rows[("team-a", "")]
+        afast = arow.window_view(T0 + 1, t.fast_window_s)
+        assert afast["availability"]["violations"] == 1
+
+    def test_missing_latencies_not_counted(self):
+        """A request with no measured TTFT (non-streaming) must not
+        count toward the TTFT objective's denominator."""
+        t = _tracker()
+        t.observe_request("team-a", "m", True, e2e_s=0.1, now=T0)
+        fast = t._rows[("team-a", "m")].window_view(
+            T0 + 1, t.fast_window_s
+        )
+        assert fast["ttft"]["requests"] == 0
+        assert fast["e2e"]["requests"] == 1
+
+    def test_shed_counts_availability_only(self):
+        t = _tracker()
+        t.observe_shed("team-a", now=T0)
+        row = t._rows[("team-a", "")]
+        fast = row.window_view(T0 + 1, t.fast_window_s)
+        assert fast["availability"]["violations"] == 1
+        assert fast["error_rate"]["requests"] == 0
+        assert fast["ttft"]["requests"] == 0
+        assert row.violations_total == {"availability": 1}
+
+
+# -- the admission shed signal ----------------------------------------------
+class TestShedBurn:
+    def test_off_without_threshold(self):
+        t = _tracker()  # shed_burn_threshold defaults 0
+        t.observe_request(
+            "team-a", "m", True, ttft_s=9.0, e2e_s=9.0, now=T0
+        )
+        assert t.shed_burn("team-a", now=T0 + 1) is None
+
+    def test_reads_latency_burn(self):
+        t = _tracker(shed_burn_threshold=2.0)
+        for i in range(10):
+            t.observe_request(
+                "team-a", "m", True, ttft_s=9.0, e2e_s=0.1,
+                now=T0 + i * 0.01,
+            )
+        # all 10 violate ttft: frac 1.0 / budget 0.01 = burn 100
+        assert t.shed_burn("team-a", now=T0 + 2) == (
+            pytest.approx(100.0)
+        )
+        assert t.shed_burn("nobody", now=T0 + 2) is None
+
+    def test_availability_never_feeds_shed_burn(self):
+        """The death-spiral guard: sheds raise availability burn, and
+        availability burn must NOT raise the shed signal — otherwise
+        one shed locks the tenant out of its own budget forever."""
+        t = _tracker(shed_burn_threshold=2.0)
+        for i in range(50):
+            t.observe_shed("team-a", now=T0 + i * 0.01)
+        burn = t.shed_burn("team-a", now=T0 + 2)
+        assert burn == pytest.approx(0.0)
+
+    def test_burn_cache_ages_out(self):
+        t = _tracker(shed_burn_threshold=2.0)
+        t.observe_request(
+            "team-a", "m", True, ttft_s=9.0, e2e_s=0.1, now=T0
+        )
+        assert t.shed_burn("team-a", now=T0 + 0.1) > 0
+        # compliant traffic dilutes the fraction; the cached value
+        # holds inside the 1s age, refreshes past it
+        for i in range(99):
+            t.observe_request(
+                "team-a", "m", True, ttft_s=0.1, e2e_s=0.1,
+                now=T0 + 0.2,
+            )
+        stale = t.shed_burn("team-a", now=T0 + 0.5)
+        fresh = t.shed_burn("team-a", now=T0 + 2.0)
+        assert stale == pytest.approx(100.0)
+        assert fresh == pytest.approx(1.0)
+
+    def test_admission_sheds_batch_not_interactive(
+        self, reset_singletons
+    ):
+        """The PR 13 follow-on (d) integration: a burning tenant's
+        batch/normal traffic sheds with reason slo_burn while its
+        interactive traffic passes; an unconfigured tenant is
+        untouched."""
+        tracker = initialize_slo_tracker()
+        tracker.apply_config({
+            "shed_burn_threshold": 2.0,
+            "objectives": {"hot": {"ttft_p99_s": 0.1},
+                           "cold": {"ttft_p99_s": 0.1}},
+        })
+        for i in range(20):
+            tracker.observe_request(
+                "hot", "m", True, ttft_s=5.0, e2e_s=5.0,
+                now=T0 + i * 0.01,
+            )
+        from production_stack_tpu.router.admission import TenantLimits
+
+        ctrl = AdmissionController(tenants={
+            "hot": TenantLimits(priority="interactive"),
+            "cold": TenantLimits(priority="interactive"),
+        })
+        now = T0 + 2
+        ticket, shed = ctrl.admit(
+            {"x-priority": "batch"}, tenant="hot", now=now
+        )
+        assert ticket is None and shed is not None
+        assert shed.reason == "slo_burn"
+        assert math.isfinite(shed.retry_after_s)
+        assert shed.retry_after_s > 0
+        # interactive traffic from the SAME burning tenant passes
+        ticket, shed = ctrl.admit({}, tenant="hot", now=now)
+        assert shed is None and ticket is not None
+        ctrl.release(ticket)
+        # a non-burning tenant's batch traffic passes
+        ticket, shed = ctrl.admit(
+            {"x-priority": "batch"}, tenant="cold", now=now
+        )
+        assert shed is None and ticket is not None
+        ctrl.release(ticket)
+        # the 429 body classifies slo_burn as the tenant's own budget
+        from production_stack_tpu.router.services.request_service import (  # noqa: E501
+            _shed_error_body,
+        )
+
+        _, shed = ctrl.admit(
+            {"x-priority": "batch"}, tenant="hot", now=now
+        )
+        assert _shed_error_body(shed)["error"]["type"] == (
+            "rate_limit_exceeded"
+        )
+
+
+# -- housekeeping / export ----------------------------------------------
+class TestHousekeeping:
+    def test_prune_drops_idle_unconfigured_only(self):
+        t = _tracker(objectives={
+            "team-a": {"ttft_p99_s": 0.5},
+            "default": {"availability": 0.99},
+        })
+        t.observe_request("team-a", "m", True, ttft_s=0.1, now=T0)
+        t.observe_request("ip:1.2.3.4", "m", True, ttft_s=0.1, now=T0)
+        dropped = t.prune(now=T0 + 10_000)
+        # the default-matched tenant tracks only availability, so its
+        # single (tenant-wide) row is the one pruned
+        assert dropped == [("ip:1.2.3.4", "")]
+        assert ("team-a", "m") in t._rows
+
+    def test_prune_bounds_burn_cache(self):
+        """The shed_burn memo is keyed by tenant IDENTITY (ip:/key:
+        fallbacks included): prune must drop stale entries or a
+        scanning client cycling source IPs grows the dict forever."""
+        t = _tracker(
+            shed_burn_threshold=2.0,
+            objectives={"default": {"ttft_p99_s": 0.5}},
+        )
+        for i in range(50):
+            t.shed_burn(f"ip:10.0.0.{i}", now=T0)
+        assert len(t._burn_cache) == 50
+        t.prune(now=T0 + 10.0)
+        assert t._burn_cache == {}
+        # a FRESH entry survives the prune (still inside the cache age)
+        t.shed_burn("ip:10.0.0.1", now=T0 + 20.0)
+        t.prune(now=T0 + 20.5)
+        assert list(t._burn_cache) == ["ip:10.0.0.1"]
+
+    def test_export_gauges_worst_row_aggregation(self):
+        from production_stack_tpu.router.services.metrics_service import (  # noqa: E501
+            slo_burn_rate,
+            slo_compliance_ratio,
+        )
+
+        t = _tracker(objectives={"team-a": {"ttft_p99_s": 0.5}})
+        # model m1 compliant, m2 fully violating: the exported tenant
+        # series must read the WORST row
+        t.observe_request("team-a", "m1", True, ttft_s=0.1, now=T0)
+        t.observe_request("team-a", "m2", True, ttft_s=9.0, now=T0)
+        t.export_gauges(now=T0 + 1)
+        assert slo_compliance_ratio.labels(
+            tenant="team-a", objective="ttft"
+        )._value.get() == 0.0
+        assert slo_burn_rate.labels(
+            tenant="team-a", objective="ttft", window="fast"
+        )._value.get() == pytest.approx(100.0)
+
+    def test_snapshot_shape(self):
+        t = _tracker()
+        t.observe_request(
+            "team-a", "m", True, ttft_s=0.9, e2e_s=0.9, now=T0
+        )
+        snap = t.snapshot(now=T0 + 1)
+        assert snap["active"] is True
+        assert snap["objectives"]["team-a"]["ttft_p99_s"] == 0.5
+        # two rows: the per-model latency/error row + the tenant-wide
+        # availability row
+        (row,) = [r for r in snap["tenants"] if r["model"] == "m"]
+        assert row["tenant"] == "team-a"
+        assert row["violations_total"] == {"ttft": 1}
+        assert row["fast"]["ttft"]["burn_rate"] > 0
+        import json
+
+        json.dumps(snap)  # strictly JSON-serializable
+
+
+def test_desired_replicas_hint():
+    """The exported autoscale hint: ceil(awake * score / target),
+    floored at 1 while anything is discovered, 0 on empty discovery,
+    1 when the whole fleet sleeps (wake one first)."""
+    ctrl = AdmissionController(fleet_target_load=0.75)
+    ctrl._load = LoadSignals()  # empty discovery
+    assert ctrl.desired_replicas_hint() == 0
+    sig = LoadSignals(score=1.5, awake_backends=4)
+    assert ctrl.desired_replicas_hint(sig) == 8
+    assert ctrl.desired_replicas_hint(
+        LoadSignals(score=0.0, awake_backends=4)
+    ) == 1
+    assert ctrl.desired_replicas_hint(
+        LoadSignals(score=float("inf"), sleeping_backends=3)
+    ) == 1
+
+
+# -- e2e: real router + fake engines + dynamic config ------------------------
+async def _start_stack(n_engines=2, extra_args=()):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.router.app import build_app
+
+    engines = [FakeEngine(model="fake-model") for _ in range(n_engines)]
+    for e in engines:
+        await e.start()
+    argv = [
+        "--service-discovery", "static",
+        "--static-backends", ",".join(e.url for e in engines),
+        "--static-models", ",".join("fake-model" for _ in engines),
+        "--routing-logic", "roundrobin",
+        "--engine-stats-interval", "0.2",
+        *extra_args,
+    ]
+    args = parsers.parse_args(argv)
+    ra = build_app(args)
+    client = TestClient(TestServer(ra.app))
+    await client.start_server()
+    return client, engines
+
+
+async def _stop_stack(client, engines):
+    await client.close()
+    for e in engines:
+        await e.stop()
+
+
+class TestSLOE2E:
+    def test_objectives_via_dynamic_config_file(
+        self, reset_singletons, tmp_path
+    ):
+        """The operator path end to end: objectives declared in the
+        dynamic config file apply at startup, requests under a tenant
+        header are judged, violations surface on /debug/slo AND the
+        slo_*/fleet_* metric families on a live /metrics render."""
+        import json
+
+        cfg_path = tmp_path / "dyn.json"
+        cfg_path.write_text(json.dumps({
+            "slo": {
+                "objectives": {
+                    # impossible TTFT target: every streamed request
+                    # violates -> deterministic burn
+                    "strict": {"ttft_p99_s": 1e-9,
+                               "availability": 0.999},
+                    "lenient": {"ttft_p99_s": 30.0},
+                },
+            },
+        }))
+
+        async def run():
+            client, engines = await _start_stack(
+                extra_args=("--dynamic-config-json", str(cfg_path)),
+            )
+            body = {"model": "fake-model", "prompt": "hello",
+                    "max_tokens": 4, "stream": True}
+            for tenant in ("strict", "strict", "lenient"):
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={"x-tenant-id": tenant},
+                )
+                assert r.status == 200
+                await r.read()
+
+            r = await client.get("/debug/slo")
+            snap = await r.json()
+            assert snap["active"] is True
+            rows = {row["tenant"]: row for row in snap["tenants"]}
+            strict = rows["strict"]
+            assert strict["violations_total"]["ttft"] == 2
+            assert strict["fast"]["ttft"]["burn_rate"] > 0
+            assert strict["fast"]["availability"]["violations"] == 0
+            lenient = rows["lenient"]
+            assert lenient["violations_total"] == {}
+            assert lenient["fast"]["ttft"]["violation_fraction"] == 0
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert 'tpu_router:slo_violations_total{objective="ttft",tenant="strict"} 2.0' in text  # noqa: E501
+            assert 'tpu_router:slo_compliance_ratio{objective="ttft",tenant="lenient"} 1.0' in text  # noqa: E501
+            assert 'tpu_router:slo_burn_rate{objective="ttft",tenant="strict",window="fast"}' in text  # noqa: E501
+            assert 'tpu_router:slo_budget_remaining{objective="ttft",tenant="strict"} 0.0' in text  # noqa: E501
+            # the fleet autoscale family on the live scrape (ISSUE 15
+            # acceptance): two awake engines, low score, hint >= 1
+            assert "tpu_router:fleet_load_score" in text
+            assert "tpu_router:fleet_awake_engines 2.0" in text
+            assert "tpu_router:fleet_desired_replicas_hint 1.0" in text
+            await _stop_stack(client, engines)
+
+        asyncio.run(run())
+
+    def test_slo_violation_span_event(self, reset_singletons):
+        """Tracing on: a violating request exports an slo_violation
+        event on its proxy_request span, joining burn dashboards to
+        per-request traces."""
+        async def run():
+            client, engines = await _start_stack(
+                extra_args=("--tracing-exporter", "memory"),
+            )
+            get_slo_tracker().apply_config({
+                "objectives": {"strict": {"ttft_p99_s": 1e-9}},
+            })
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "x",
+                      "max_tokens": 2, "stream": True},
+                headers={"x-tenant-id": "strict"},
+            )
+            assert r.status == 200
+            await r.read()
+            r = await client.get("/debug/requests")
+            payload = await r.json()
+            events = [
+                e
+                for span in payload["requests"]
+                for e in span.get("events", [])
+                if e["name"] == "slo_violation"
+            ]
+            assert events, payload
+            attrs = events[0]["attributes"]
+            assert "ttft" in attrs["objectives"]
+            assert attrs["tenant"] == "strict"
+            await _stop_stack(client, engines)
+
+        asyncio.run(run())
+
+    def test_tenant_attribution_survives_admission_off(
+        self, reset_singletons
+    ):
+        """SLO attribution must not depend on admission being ON: with
+        the kill switch thrown, admit() hands back no ticket, but the
+        identity ladder still resolves the x-tenant-id header — rows
+        must land on the tenant, not collapse into (anonymous)."""
+        async def run():
+            client, engines = await _start_stack(
+                extra_args=("--no-admission-control",),
+            )
+            get_slo_tracker().apply_config({
+                "objectives": {"team-a": {"ttft_p99_s": 30.0}},
+            })
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "fake-model", "prompt": "x",
+                      "max_tokens": 2, "stream": True},
+                headers={"x-tenant-id": "team-a"},
+            )
+            assert r.status == 200
+            await r.read()
+            snap = get_slo_tracker().snapshot()
+            rows = {row["tenant"]: row for row in snap["tenants"]}
+            assert "team-a" in rows, snap
+            assert rows["team-a"]["requests_total"] == 1
+            await _stop_stack(client, engines)
+
+        asyncio.run(run())
+
+    def test_sheds_reach_availability_window(self, reset_singletons):
+        """A rate-limited tenant's sheds surface as availability burn
+        on /debug/slo — the per-tenant attribution the overload bench
+        gates on."""
+        from production_stack_tpu.router.admission import (
+            get_admission_controller,
+        )
+
+        async def run():
+            client, engines = await _start_stack()
+            get_admission_controller().apply_config({
+                "tenants": {"noisy": {"rate": 0.5, "burst": 1.0}},
+            })
+            get_slo_tracker().apply_config({
+                "objectives": {"noisy": {"availability": 0.99}},
+            })
+            body = {"model": "fake-model", "prompt": "x",
+                    "max_tokens": 1}
+            seen = []
+            for _ in range(3):
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={"x-tenant-id": "noisy"},
+                )
+                seen.append(r.status)
+                await r.read()
+            assert seen.count(429) == 2, seen
+            snap = get_slo_tracker().snapshot()
+            # availability is tenant-scoped: the served request AND
+            # both sheds share ONE window on the model-less row, so
+            # the violation fraction mixes honestly (2 of 3) instead
+            # of a pure-shed row reading 100% from one shed
+            (row,) = [
+                r for r in snap["tenants"] if r["tenant"] == "noisy"
+            ]
+            avail = row["fast"]["availability"]
+            assert avail["requests"] == 3
+            assert avail["violations"] == 2
+            assert avail["burn_rate"] == pytest.approx(
+                (2 / 3) / 0.01, rel=1e-3
+            )
+            await _stop_stack(client, engines)
+
+        asyncio.run(run())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -733,6 +733,45 @@ def test_router_proxy_hot_marks_present():
     assert not missing, f"request_service.py: unmarked hot paths {missing}"
 
 
+def test_slo_stays_off_hot_paths():
+    """SLO tracking (ISSUE 15) runs on the proxy hot path for every
+    finished request AND inside the admission decision (shed_burn):
+    one blocking call, swallowed exception, or device sync there taxes
+    every request the tracker is judging — router/stats/slo.py stays
+    at zero unsuppressed findings across the sweeps."""
+    report = analyze_paths(
+        [str(PACKAGE / "router" / "stats" / "slo.py")],
+        select=["blocking-async", "silent-except", "device-sync-hot"],
+    )
+    assert report.files_scanned == 1
+    assert report.unsuppressed == [], "\n".join(
+        f.format() for f in report.unsuppressed
+    )
+
+
+def test_slo_hot_marks_present():
+    """The sweep above only bites while the SLO feed path carries the
+    hot-path mark — a dropped mark would pass silently."""
+    from production_stack_tpu.analysis.core import (
+        ModuleContext,
+        iter_functions,
+    )
+
+    expected = {
+        ("router", "stats", "slo.py"): {
+            "observe_request", "observe_shed", "shed_burn", "_match",
+            "bucket",
+        },
+        ("router", "services", "request_service.py"): {"_note_slo"},
+    }
+    for parts, needed in expected.items():
+        path = PACKAGE.joinpath(*parts)
+        ctx = ModuleContext(str(path), path.read_text())
+        hot = {f.name for f in iter_functions(ctx.tree) if ctx.is_hot(f)}
+        missing = needed - hot
+        assert not missing, f"{path.name}: unmarked hot paths {missing}"
+
+
 def test_timeline_recording_stays_off_hot_paths():
     """Request-timeline recording (tracing/ + its engine call sites)
     must not introduce device syncs or event-loop stalls on the marked
