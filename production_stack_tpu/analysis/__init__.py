@@ -15,6 +15,7 @@ from production_stack_tpu.analysis.core import (
     analyze_source,
     render_human,
     render_json,
+    render_sarif,
 )
 
 __all__ = [
@@ -25,4 +26,5 @@ __all__ = [
     "analyze_source",
     "render_human",
     "render_json",
+    "render_sarif",
 ]

@@ -40,6 +40,14 @@ DISABLE_RE = re.compile(
     r"(?:\s*(?:[-–—]+)\s*(?P<why>.*))?"
 )
 HOT_RE = re.compile(r"#\s*stackcheck:\s*hot-path\b")
+# v2 markers (interprocedural context; see analysis/README.md):
+# not-hot declares a function a sanctioned hot-path BOUNDARY — transitive
+# hot propagation stops there (the def's comment should say why);
+# monotonic-only bans wall-clock reachability from a module or class;
+# slo-finish marks a request-finish function for exactly-once-note.
+NOT_HOT_RE = re.compile(r"#\s*stackcheck:\s*not-hot\b")
+MONOTONIC_RE = re.compile(r"#\s*stackcheck:\s*monotonic-only\b")
+SLO_FINISH_RE = re.compile(r"#\s*stackcheck:\s*slo-finish\b")
 GUARDED_RE = re.compile(r"#\s*guarded by:\s*(?P<lock>[A-Za-z0-9_.()\[\]]+)")
 
 
@@ -90,6 +98,10 @@ class ModuleContext:
         self.suppressions: dict[int, Suppression] = {}
         # lines bearing a hot-path mark
         self.hot_lines: set[int] = set()
+        # lines bearing the v2 context markers
+        self.not_hot_lines: set[int] = set()
+        self.monotonic_lines: set[int] = set()
+        self.slo_finish_lines: set[int] = set()
         # line -> lock expression string from "# guarded by: <lock>"
         self.guarded_lines: dict[int, str] = {}
         # pure-comment lines (a directive there applies to the next line)
@@ -107,6 +119,12 @@ class ModuleContext:
                 self.suppressions[i] = Suppression(rules, why)
             if HOT_RE.search(raw):
                 self.hot_lines.add(i)
+            if NOT_HOT_RE.search(raw):
+                self.not_hot_lines.add(i)
+            if MONOTONIC_RE.search(raw):
+                self.monotonic_lines.add(i)
+            if SLO_FINISH_RE.search(raw):
+                self.slo_finish_lines.add(i)
             g = GUARDED_RE.search(raw)
             if g:
                 self.guarded_lines[i] = g.group("lock").strip()
@@ -145,17 +163,29 @@ class ModuleContext:
             prev -= 1
         return None
 
+    def marker_attaches(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef | ast.ClassDef,
+        lines: set[int],
+    ) -> bool:
+        """True when a marker line set covers ``node``: the marker sits
+        on the def/class line itself or anywhere in the contiguous block
+        of comment-only lines directly above it (the marker's rationale
+        usually wraps)."""
+        if node.lineno in lines:
+            return True
+        prev = node.lineno - 1
+        while prev in self._comment_only:
+            if prev in lines:
+                return True
+            prev -= 1
+        return False
+
     def is_hot(self, func: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
         """True if marked ``# stackcheck: hot-path`` on the def line or
         anywhere in the contiguous comment block directly above it (the
         mark's rationale usually wraps), or decorated ``@hot_path``."""
-        if func.lineno in self.hot_lines:
+        if self.marker_attaches(func, self.hot_lines):
             return True
-        prev = func.lineno - 1
-        while prev in self._comment_only:
-            if prev in self.hot_lines:
-                return True
-            prev -= 1
         for dec in func.decorator_list:
             if attr_tail(dec) == "hot_path":
                 return True
@@ -163,6 +193,22 @@ class ModuleContext:
                     "hot_path":
                 return True
         return False
+
+    def is_not_hot(
+        self, func: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> bool:
+        """True if marked ``# stackcheck: not-hot`` — the function is a
+        declared hot-path boundary (worker submission point / sanctioned
+        fetch seam) and transitive hot propagation stops at it."""
+        return self.marker_attaches(func, self.not_hot_lines)
+
+    def is_slo_finish(
+        self, func: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> bool:
+        """True if marked ``# stackcheck: slo-finish`` — every finish
+        path of the function must note SLO exactly once
+        (exactly-once-note)."""
+        return self.marker_attaches(func, self.slo_finish_lines)
 
 
 # -- shared AST helpers -----------------------------------------------------
@@ -255,6 +301,20 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """Interprocedural rule: sees the whole scanned set as one
+    ``ProjectContext`` (analysis/callgraph.py) instead of one module at
+    a time, so it can follow calls across helpers, classes, and modules.
+    Subclasses implement ``check_project(project)``; ``check`` is never
+    called for these."""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -305,13 +365,9 @@ class Report:
         }
 
 
-def analyze_source(
-    source: str,
-    path: str = "<string>",
-    select: Iterable[str] | None = None,
-) -> list[Finding]:
-    """Run rules over one source string; returns all findings with
-    suppression already applied (suppressed ones carry suppressed=True)."""
+def _select_rules(
+    select: Iterable[str] | None,
+) -> dict[str, Rule]:
     rules = all_rules()
     if select is not None:
         wanted = set(select)
@@ -319,6 +375,63 @@ def analyze_source(
         if unknown:
             raise ValueError(f"unknown rule(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k in wanted}
+    return rules
+
+
+def _run_rules(
+    contexts: list[ModuleContext],
+    rules: dict[str, Rule],
+) -> list[Finding]:
+    """Module rules per context, then interprocedural rules over the
+    whole set as one project; suppression applied per finding against
+    its own module's directives. Findings are deduped on
+    (rule, path, line, col) — two hot entry points reaching the same
+    hazard site must not double-report it."""
+    findings: list[Finding] = []
+    module_rules = [
+        r for r in rules.values() if not isinstance(r, ProjectRule)
+    ]
+    project_rules = [
+        r for r in rules.values() if isinstance(r, ProjectRule)
+    ]
+    for ctx in contexts:
+        for rule in module_rules:
+            findings.extend(rule.check(ctx))
+    if project_rules and contexts:
+        from production_stack_tpu.analysis.callgraph import ProjectContext
+
+        project = ProjectContext(contexts)
+        for rule in project_rules:
+            findings.extend(rule.check_project(project))
+    by_path = {ctx.path: ctx for ctx in contexts}
+    seen: set[tuple[str, str, int, int]] = set()
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col)
+        if key in seen:
+            continue
+        seen.add(key)
+        ctx = by_path.get(f.path)
+        if ctx is not None:
+            sup = ctx.suppression_for(f.line, f.rule)
+            if sup is not None:
+                f.suppressed = True
+                f.justification = sup.justification
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    select: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Run rules over one source string; returns all findings with
+    suppression already applied (suppressed ones carry suppressed=True).
+    Interprocedural rules see the single module as a one-file project,
+    so same-module indirection (hot entry -> helper) is still caught."""
+    rules = _select_rules(select)
     try:
         ctx = ModuleContext(path, source)
     except SyntaxError as e:
@@ -326,16 +439,7 @@ def analyze_source(
             rule="syntax-error", path=path, line=e.lineno or 0,
             col=e.offset or 0, message=f"cannot parse: {e.msg}",
         )]
-    findings: list[Finding] = []
-    for rule in rules.values():
-        for f in rule.check(ctx):
-            sup = ctx.suppression_for(f.line, f.rule)
-            if sup is not None:
-                f.suppressed = True
-                f.justification = sup.justification
-            findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    return _run_rules([ctx], rules)
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[Path]:
@@ -360,13 +464,37 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[Path]:
 def analyze_paths(
     paths: Iterable[str],
     select: Iterable[str] | None = None,
+    report_only: Iterable[str] | None = None,
 ) -> Report:
-    findings: list[Finding] = []
+    """Scan every .py under ``paths`` as ONE project: module rules per
+    file plus interprocedural rules over the whole call graph.
+
+    ``report_only`` (the --changed-only mode) restricts which files may
+    REPORT findings while the call graph is still built over the full
+    scan scope — an interprocedural finding in a changed file must not
+    disappear just because the helper it calls through didn't change."""
+    contexts: list[ModuleContext] = []
+    parse_failures: list[Finding] = []
     n = 0
     for f in iter_python_files(paths):
         n += 1
         source = f.read_text(encoding="utf-8")
-        findings.extend(analyze_source(source, str(f), select=select))
+        try:
+            contexts.append(ModuleContext(str(f), source))
+        except SyntaxError as e:
+            parse_failures.append(Finding(
+                rule="syntax-error", path=str(f), line=e.lineno or 0,
+                col=e.offset or 0, message=f"cannot parse: {e.msg}",
+            ))
+    rules = _select_rules(select)
+    findings = parse_failures + _run_rules(contexts, rules)
+    if report_only is not None:
+        wanted = {str(Path(p).resolve()) for p in report_only}
+        findings = [
+            f for f in findings
+            if str(Path(f.path).resolve()) in wanted
+        ]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return Report(findings=findings, files_scanned=n)
 
 
@@ -391,3 +519,67 @@ def render_human(report: Report, show_suppressed: bool = False) -> str:
 
 def render_json(report: Report) -> str:
     return json.dumps(report.to_dict(), indent=2)
+
+
+def render_sarif(report: Report) -> str:
+    """SARIF 2.1.0 for github/codeql-action/upload-sarif: unsuppressed
+    findings annotate PR diffs as errors; suppressed ones ride along as
+    notes with their in-source justification, so the suppression
+    inventory is visible in the code-scanning UI too. ``--json`` stays
+    byte-compatible — this is a separate renderer, not a reshape."""
+    rule_meta = all_rules()
+    driver_rules = [
+        {
+            "id": name,
+            "shortDescription": {"text": rule.summary or name},
+        }
+        for name, rule in sorted(rule_meta.items())
+    ]
+    driver_rules.append({
+        "id": "syntax-error",
+        "shortDescription": {"text": "file could not be parsed"},
+    })
+    results = []
+    for f in report.findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "note" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.suppressed:
+            sup: dict = {"kind": "inSource"}
+            if f.justification:
+                sup["justification"] = f.justification
+            result["suppressions"] = [sup]
+        results.append(result)
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "stackcheck",
+                    "informationUri": (
+                        "production_stack_tpu/analysis/README.md"
+                    ),
+                    "rules": driver_rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

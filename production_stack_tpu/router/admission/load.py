@@ -21,6 +21,8 @@ The score is normalized so 1.0 ≈ "the awake fleet is at its configured
 target"; the controller's priority ladder sheds batch traffic first as
 the score approaches the threshold and interactive traffic last.
 """
+# stackcheck: monotonic-only — load-score smoothing is interval math;
+# wall clock jumps would spike the backpressure signal
 
 from __future__ import annotations
 

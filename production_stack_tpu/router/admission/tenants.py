@@ -17,6 +17,8 @@ Priorities form the shed ladder: under cluster backpressure the lowest
 priority sheds first and ``interactive`` sheds last (FlowKV-style
 load-aware admission; see controller.py for the thresholds).
 """
+# stackcheck: monotonic-only — token-bucket refill is interval math;
+# a wall-clock step would refill or drain whole budgets at once
 
 from __future__ import annotations
 

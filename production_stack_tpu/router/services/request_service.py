@@ -381,6 +381,7 @@ class RequestService:
 
     # -- main entry (reference: request.py:141) ----------------------------
     # stackcheck: hot-path — per-request proxy entry; no blocking calls
+    # stackcheck: slo-finish — every finish path notes SLO exactly once
     async def route_general_request(
         self, request: web.Request, endpoint_path: str
     ) -> web.StreamResponse:
@@ -388,6 +389,9 @@ class RequestService:
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            # stackcheck: disable=exactly-once-note — malformed JSON is
+            # rejected before tenant resolution; nothing entered the
+            # pipeline, so there is no request to judge against an SLO
             return web.json_response(
                 {"error": {"message": "invalid JSON", "type":
                            "invalid_request_error"}},
@@ -462,6 +466,10 @@ class RequestService:
                         self._shed_fleet_asleep(admission, ticket),
                         request_id,
                     )
+                # stackcheck: disable=exactly-once-note — local
+                # pre-dispatch reject (no backend serves the model);
+                # SLO objectives judge served requests, and the admit
+                # above was released by the finally
                 return web.json_response(
                     {"error": {
                         "message": f"no endpoint serving model {model!r}",
@@ -475,6 +483,9 @@ class RequestService:
                 candidates, body
             )
             if too_long is not None:
+                # stackcheck: disable=exactly-once-note — 413 before
+                # dispatch: the prompt fits no backend's context
+                # window; nothing entered the pipeline to judge
                 return too_long
 
             engine_stats = get_engine_stats_scraper().get_engine_stats()
@@ -489,6 +500,9 @@ class RequestService:
                     candidates, engine_stats, request_stats, rr
                 )
             except RuntimeError as e:
+                # stackcheck: disable=exactly-once-note — routing found
+                # no viable backend before dispatch; nothing entered
+                # the pipeline to judge against an SLO
                 return web.json_response(
                     {"error": {"message": str(e), "type":
                                "service_unavailable"}},
@@ -552,6 +566,7 @@ class RequestService:
 
     # -- proxy + streaming (reference: request.py:55-138) ------------------
     # stackcheck: hot-path — per-chunk relay loop; no blocking calls
+    # stackcheck: slo-finish — every finish path notes SLO exactly once
     async def process_request(
         self,
         request: web.Request,
@@ -788,6 +803,10 @@ class RequestService:
                         "client for request %s went away mid-proxy "
                         "(backend %s): %s", request_id, url, e,
                     )
+                    # stackcheck: disable=exactly-once-note — the
+                    # client went away mid-stream: there is no
+                    # tenant-observed completion to judge; the proxy
+                    # observation above records the disconnect
                     return resp
                 except (aiohttp.ClientError, ConnectionResetError,
                         asyncio.TimeoutError) as e:
@@ -871,6 +890,7 @@ class RequestService:
             self.in_flight -= 1
 
     # -- headless execution (batch API worker path) ------------------------
+    # stackcheck: slo-finish — every finish path notes SLO exactly once
     async def execute_internal(
         self, body: dict, endpoint_path: str, request_id: str | None = None
     ) -> tuple[int, dict]:
@@ -913,6 +933,9 @@ class RequestService:
                         clock, fleet_shed.tenant, fleet_shed.reason
                     )
                     return 429, _shed_error_body(fleet_shed)
+                # stackcheck: disable=exactly-once-note — local
+                # pre-dispatch reject (no backend serves the model);
+                # nothing entered the pipeline to judge
                 return 503, {"error": {
                     "message": (
                         f"no endpoint serving model "
@@ -931,6 +954,9 @@ class RequestService:
                     ),
                 )
             except RuntimeError as e:
+                # stackcheck: disable=exactly-once-note — routing found
+                # no viable backend before dispatch; nothing entered
+                # the pipeline to judge
                 return 503, {"error": {"message": str(e),
                                        "type": "service_unavailable"}}
             clock.mark("route_decision")
@@ -979,6 +1005,7 @@ class RequestService:
             admission.release(ticket)
 
     # -- disaggregated prefill (reference: request.py:349-441) -------------
+    # stackcheck: slo-finish — every finish path notes SLO exactly once
     async def route_disaggregated_prefill_request(
         self,
         request: web.Request,
@@ -1021,6 +1048,9 @@ class RequestService:
             endpoints, body
         )
         if too_long is not None:
+            # stackcheck: disable=exactly-once-note — 413 before
+            # dispatch: the prompt fits neither PD phase's context
+            # window; nothing entered the pipeline to judge
             return too_long
         try:
             if isinstance(router, PDRouter):
@@ -1042,6 +1072,9 @@ class RequestService:
                     await router.route_prefill_decode(endpoints)
                 )
         except RuntimeError as e:
+            # stackcheck: disable=exactly-once-note — PD planning found
+            # no viable pair before dispatch; nothing entered the
+            # pipeline to judge against an SLO
             return web.json_response(
                 {"error": {"message": str(e),
                            "type": "service_unavailable"}},

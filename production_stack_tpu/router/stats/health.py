@@ -28,6 +28,8 @@ Threading: all mutation happens on the router's single event loop
 (proxy callbacks + scraper task), mirroring ``RequestStatsMonitor`` —
 no locks on the hot path.
 """
+# stackcheck: monotonic-only — health scoring and phase accounting are
+# interval math; wall clock jumps would flap engine health
 
 from __future__ import annotations
 

@@ -14,6 +14,8 @@ set) must never expire a whole window or mint a negative TTFT. Callers
 either omit the timestamp (monotonic now) or pass stamps from ONE
 consistent clock; nothing here exports epoch time.
 """
+# stackcheck: monotonic-only — QPS/TTFT/prefill-TPS interval math must
+# never ride wall-clock steps (NTP slew corrupts the windows)
 
 from __future__ import annotations
 

@@ -47,6 +47,8 @@ Threading: all mutation happens on the router's single event loop
 (proxy callbacks + log_stats render), mirroring ``EngineHealthBoard``
 — no locks on the hot path.
 """
+# stackcheck: monotonic-only — burn-rate and error-budget refill math
+# must never ride wall-clock steps (NTP slew corrupts the budgets)
 
 from __future__ import annotations
 

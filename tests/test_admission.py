@@ -72,16 +72,26 @@ def reset_singletons():
 # -- clock discipline --------------------------------------------------------
 def test_no_wall_clock_in_admission_sources():
     """Same pin as test_request_stats.py: budget refill/starvation must
-    never ride wall-clock steps — time.time() is banned from the
-    package."""
+    never ride wall-clock steps. Enforced through stackcheck's
+    wall-clock-banned contract rule — every real module in the package
+    declares monotonic-only (the __init__.py is re-exports only) and the
+    package must scan clean with zero findings, suppressed included."""
+    from production_stack_tpu.analysis import analyze_paths
+
     pkg = (
         Path(__file__).resolve().parent.parent
         / "production_stack_tpu" / "router" / "admission"
     )
     for src in sorted(pkg.glob("*.py")):
-        assert "time.time()" not in src.read_text(), (
-            f"{src.name} uses wall-clock time"
+        if src.name == "__init__.py":
+            continue
+        assert "stackcheck: monotonic-only" in src.read_text(), (
+            f"{src.name} dropped its monotonic-only marker"
         )
+    report = analyze_paths([str(pkg)], select=["wall-clock-banned"])
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
 
 
 # -- token bucket ------------------------------------------------------------

@@ -127,13 +127,22 @@ class TestRequestStatsMonitor:
     def test_prefill_tps_doc_and_default(self):
         import inspect
 
+        from production_stack_tpu.analysis import analyze_paths
         from production_stack_tpu.router.stats import request_stats
 
-        # the "prefises" typo stays fixed, and nothing in the monitor
-        # measures intervals on wall-clock time anymore
+        # the "prefises" typo stays fixed; the wall-clock ban is now
+        # enforced through stackcheck's wall-clock-banned contract rule:
+        # the module declares monotonic-only and must scan clean (no
+        # findings at all — a suppression here would be a smell)
         src = inspect.getsource(request_stats)
         assert "prefises" not in src
-        assert "time.time()" not in src
+        assert "stackcheck: monotonic-only" in src
+        report = analyze_paths(
+            [request_stats.__file__], select=["wall-clock-banned"]
+        )
+        assert report.findings == [], "\n".join(
+            f.format() for f in report.findings
+        )
         # the dataclass default contract: -1 means no data
         assert RequestStats().prefill_tps == -1.0
 

@@ -81,14 +81,22 @@ def _tracker(**overrides) -> SLOTracker:
 
 # -- clock discipline --------------------------------------------------------
 def test_no_wall_clock_in_slo_source():
-    """Same pin as test_admission.py: burn/refill math must never
-    ride wall-clock steps — time.time() is banned from the module."""
-    src = (
+    """Same pin as test_admission.py: burn/refill math must never ride
+    wall-clock steps. Enforced through stackcheck's wall-clock-banned
+    contract rule — the module declares monotonic-only, which bans both
+    time.time()-family calls and datetime imports (the rule's
+    module-scope import ban keeps the old "no datetime" strictness)."""
+    from production_stack_tpu.analysis import analyze_paths
+
+    path = (
         Path(__file__).resolve().parent.parent
         / "production_stack_tpu" / "router" / "stats" / "slo.py"
-    ).read_text()
-    assert "time.time(" not in src
-    assert "datetime" not in src
+    )
+    assert "stackcheck: monotonic-only" in path.read_text()
+    report = analyze_paths([str(path)], select=["wall-clock-banned"])
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
 
 
 def test_zero_configured_tenants_zero_overhead(monkeypatch):

@@ -9,6 +9,7 @@ keep them small and obvious.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import textwrap
@@ -263,6 +264,188 @@ FIXTURES = {
             async def handler(key):
                 # stackcheck: disable=mutable-shared-state — single loop
                 SEEN.add(key)
+        """,
+    ),
+    # -- v2 interprocedural rules (call-graph propagation) ------------------
+    "device-sync-transitive": dict(
+        positive="""
+            import jax
+
+            # stackcheck: hot-path
+            def step(x):
+                return stage(x)
+
+            def stage(x):
+                return x.item()
+        """,
+        negative="""
+            import jax
+
+            # stackcheck: hot-path
+            def step(x):
+                return stage(x)
+
+            # stackcheck: not-hot — sanctioned fetch seam
+            def stage(x):
+                return x.item()
+        """,
+        suppressed="""
+            # stackcheck: hot-path
+            def step(x):
+                return stage(x)
+
+            def stage(x):
+                # stackcheck: disable=device-sync-transitive — intended
+                # fetch point for this round's sampled tokens
+                return x.item()
+        """,
+    ),
+    "blocking-hot": dict(
+        positive="""
+            import time
+
+            # stackcheck: hot-path
+            def step(batch):
+                flush(batch)
+
+            def flush(batch):
+                time.sleep(0.1)
+        """,
+        negative="""
+            import time
+
+            # stackcheck: hot-path
+            def step(batch):
+                flush(batch)
+
+            # stackcheck: not-hot — offload worker submission seam
+            def flush(batch):
+                time.sleep(0.1)
+        """,
+        suppressed="""
+            import time
+
+            # stackcheck: hot-path
+            def step(batch):
+                flush(batch)
+
+            def flush(batch):
+                # stackcheck: disable=blocking-hot — deliberate yield
+                time.sleep(0.001)
+        """,
+    ),
+    "blocking-async-transitive": dict(
+        positive="""
+            import time
+
+            async def handler(req):
+                return prepare(req)
+
+            def prepare(req):
+                time.sleep(0.1)
+                return req
+        """,
+        negative="""
+            import time
+
+            async def handler(req):
+                return prepare(req)
+
+            def cli_main(req):
+                return prepare(req)
+
+            def prepare(req):
+                time.sleep(0.1)
+                return req
+        """,
+        suppressed="""
+            import time
+
+            async def handler(req):
+                return prepare(req)
+
+            def prepare(req):
+                # stackcheck: disable=blocking-async-transitive — 100ms
+                # calibrated settle before the fleet probe
+                time.sleep(0.1)
+                return req
+        """,
+    ),
+    # -- v2 contract rules --------------------------------------------------
+    "wall-clock-banned": dict(
+        positive="""
+            # stackcheck: monotonic-only — interval math module
+            import time
+
+            def refill(last):
+                return time.time() - last
+        """,
+        negative="""
+            # stackcheck: monotonic-only — interval math module
+            import time
+
+            def refill(last):
+                return time.monotonic() - last
+        """,
+        suppressed="""
+            # stackcheck: monotonic-only — interval math module
+            import time
+
+            def export_stamp():
+                # stackcheck: disable=wall-clock-banned — the export
+                # edge needs a calendar timestamp, not an interval
+                return time.time()
+        """,
+    ),
+    "paired-release": dict(
+        positive="""
+            def handle(req):
+                admission = get_admission_controller()
+                ticket, shed = admission.admit(req)
+                do_work(req)
+                return ticket
+        """,
+        negative="""
+            def handle(req):
+                admission = get_admission_controller()
+                ticket, shed = admission.admit(req)
+                try:
+                    do_work(req)
+                finally:
+                    admission.release(ticket)
+        """,
+        suppressed="""
+            def handle(req):
+                admission = get_admission_controller()
+                # stackcheck: disable=paired-release — probe path:
+                # the ticket is released by the caller's finally
+                ticket, shed = admission.admit(req)
+                return ticket
+        """,
+    ),
+    "exactly-once-note": dict(
+        positive="""
+            # stackcheck: slo-finish
+            def finish(self, ok):
+                if ok:
+                    self._note_slo(ok)
+                return ok
+        """,
+        negative="""
+            # stackcheck: slo-finish
+            def finish(self, ok):
+                self._note_slo(ok)
+                return ok
+        """,
+        suppressed="""
+            # stackcheck: slo-finish
+            def finish(self, ok):
+                if not ok:
+                    # stackcheck: disable=exactly-once-note — rejected
+                    # before the pipeline; nothing to judge
+                    return None
+                self._note_slo(ok)
+                return ok
         """,
     ),
 }
@@ -847,3 +1030,291 @@ def test_long_prefill_hot_marks_present():
                 "_materialize is the worker body (blocking by design) "
                 "and must stay unmarked"
             )
+
+
+# -- call-graph unit tests (satellite: alias / method / cycle) --------------
+
+
+def _write_pkg(tmp_path, files: dict[str, str]) -> Path:
+    """Materialize a tiny importable package for call-graph tests."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return pkg
+
+
+def test_callgraph_resolves_aliased_cross_module_import(tmp_path):
+    """``from pkg.helpers import force as materialize`` must link the
+    hot caller to the helper in the OTHER module, and the finding must
+    land at the forcer with the cross-module chain in its message."""
+    pkg = _write_pkg(tmp_path, {
+        "helpers.py": """
+            def force(x):
+                return x.item()
+        """,
+        "engine.py": """
+            from pkg.helpers import force as materialize
+
+            # stackcheck: hot-path
+            def step(x):
+                return materialize(x)
+        """,
+    })
+    report = analyze_paths([str(pkg)], select=["device-sync-transitive"])
+    live = report.unsuppressed
+    assert [f.rule for f in live] == ["device-sync-transitive"]
+    assert live[0].path.endswith("helpers.py")
+    assert "pkg.engine.step" in live[0].message
+    assert "pkg.helpers.force" in live[0].message
+
+
+def test_callgraph_binds_self_method_through_base_class():
+    """``self.flush()`` on a derived class resolves through the base
+    chain to the inherited method body."""
+    src = """
+        import time
+
+        class Base:
+            def flush(self):
+                time.sleep(0.5)
+
+        class Worker(Base):
+            # stackcheck: hot-path
+            def step(self):
+                self.flush()
+    """
+    live = findings_for(src, "blocking-hot")
+    assert len(live) == 1
+    assert "Base.flush" in live[0].message
+
+
+def test_callgraph_tolerates_call_cycles():
+    """Mutually recursive functions must not hang the BFS, and the
+    blocking call inside the cycle is still reported exactly once."""
+    src = """
+        import time
+
+        # stackcheck: hot-path
+        def a(x):
+            return b(x)
+
+        def b(x):
+            if x:
+                return a(x - 1)
+            time.sleep(0.2)
+    """
+    live = findings_for(src, "blocking-hot")
+    assert len(live) == 1
+
+
+def test_callgraph_transitive_callees_shortest_chain(tmp_path):
+    """Direct API check: BFS yields shortest chains, stop() prunes the
+    subtree, callers_of inverts the edges."""
+    from production_stack_tpu.analysis.callgraph import ProjectContext
+    from production_stack_tpu.analysis.core import ModuleContext
+
+    pkg = _write_pkg(tmp_path, {
+        "a.py": """
+            from pkg.b import mid, leaf
+
+            def entry(x):
+                mid(x)
+                return leaf(x)
+        """,
+        "b.py": """
+            def mid(x):
+                return leaf(x)
+
+            def leaf(x):
+                return x
+        """,
+    })
+    ctxs = [
+        ModuleContext(str(p), p.read_text())
+        for p in (pkg / "a.py", pkg / "b.py")
+    ]
+    project = ProjectContext(ctxs)
+    entry = next(f for f in project.functions if f.name == "entry")
+    reach = project.transitive_callees(entry)
+    by_name = {fn.name: chain for fn, chain in reach.items()}
+    assert set(by_name) == {"mid", "leaf"}
+    # leaf is reachable both directly and via mid; BFS keeps the
+    # 2-hop chain, not the 3-hop one
+    assert len(by_name["leaf"]) == 2
+    # stop() prunes: stopping mid leaves only the direct leaf edge
+    pruned = project.transitive_callees(
+        entry, stop=lambda fn: fn.name == "mid"
+    )
+    assert {fn.name for fn in pruned} == {"leaf"}
+    # callers_of inverts: leaf is called by both entry and mid
+    leaf = next(f for f in project.functions if f.name == "leaf")
+    callers = project.callers_of()[id(leaf)]
+    assert {c.name for c in callers} == {"entry", "mid"}
+
+
+# -- regression: v1 (intraprocedural) miss, v2 (call-graph) catch -----------
+
+INDIRECTION_FIXTURE = """
+    import numpy as np
+
+    # stackcheck: hot-path
+    def decode_step(logits_dev):
+        return _pick(logits_dev)
+
+    def _pick(logits_dev):
+        # one hop of indirection: v1's device-sync-hot only looks
+        # inside marked functions, so this materialization is invisible
+        # to it -- the v2 call graph walks the edge and reports it here
+        return np.asarray(logits_dev)
+"""
+
+
+def test_v1_misses_one_hop_indirection_v2_catches(tmp_path):
+    # v1 behaviour, still selectable: the marked function contains no
+    # forcer, so the intraprocedural rule stays silent
+    assert findings_for(INDIRECTION_FIXTURE, "device-sync-hot") == []
+    v1 = analyze_source(
+        textwrap.dedent(INDIRECTION_FIXTURE), select=["device-sync-hot"]
+    )
+    assert v1 == []
+    # v2 default run reports the forcer through the call edge
+    live = findings_for(INDIRECTION_FIXTURE, "device-sync-transitive")
+    assert len(live) == 1
+    assert "decode_step" in live[0].message and "_pick" in live[0].message
+    # same contract through the CLI
+    target = tmp_path / "indirect.py"
+    target.write_text(textwrap.dedent(INDIRECTION_FIXTURE))
+    old = run_cli(str(target), "--select", "device-sync-hot")
+    assert old.returncode == 0, old.stdout
+    new = run_cli(str(target))
+    assert new.returncode == 1, new.stdout
+    assert "device-sync-transitive" in new.stdout
+
+
+# -- SARIF output -----------------------------------------------------------
+
+
+def test_cli_sarif_output(tmp_path):
+    target = tmp_path / "mixed.py"
+    target.write_text(textwrap.dedent("""
+        import time
+
+        async def handler(req):
+            time.sleep(1)
+
+        async def other(req):
+            # stackcheck: disable=blocking-async — calibrated settle
+            time.sleep(0.1)
+    """))
+    proc = run_cli(str(target), "--sarif")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "stackcheck"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(all_rules()) <= rule_ids
+    results = run["results"]
+    assert len(results) == 2
+    by_level = {r["level"]: r for r in results}
+    live = by_level["error"]
+    assert live["ruleId"] == "blocking-async"
+    loc = live["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("mixed.py")
+    assert loc["region"]["startLine"] >= 1
+    muted = by_level["note"]
+    assert muted["suppressions"][0]["kind"] == "inSource"
+    assert "settle" in muted["suppressions"][0]["justification"]
+
+
+def test_cli_sarif_clean_file_exits_zero(tmp_path):
+    target = tmp_path / "clean.py"
+    target.write_text("def ok():\n    return 1\n")
+    proc = run_cli(str(target), "--sarif")
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_json_and_sarif_are_exclusive(tmp_path):
+    target = tmp_path / "clean.py"
+    target.write_text("def ok():\n    return 1\n")
+    proc = run_cli(str(target), "--json", "--sarif")
+    assert proc.returncode == 2
+
+
+# -- --changed-only ---------------------------------------------------------
+
+
+def _git(cwd: Path, *args: str) -> None:
+    subprocess.run(
+        ["git", *args], cwd=cwd, check=True, capture_output=True,
+        env={**os.environ,
+             "GIT_CONFIG_GLOBAL": "/dev/null",
+             "GIT_CONFIG_SYSTEM": "/dev/null"},
+    )
+
+
+def _run_cli_in(cwd: Path, *args: str):
+    """CLI run with an explicit cwd (git discovery) while keeping the
+    analyzer importable from the repo."""
+    return subprocess.run(
+        [sys.executable, "-m", "production_stack_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO_ROOT)},
+    )
+
+
+def _seed_git_repo(tmp_path: Path) -> Path:
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "t@example.com")
+    _git(repo, "config", "user.name", "t")
+    (repo / "old.py").write_text(textwrap.dedent("""
+        import time
+
+        async def legacy(req):
+            time.sleep(1)
+    """))
+    (repo / "fresh.py").write_text("def ok():\n    return 1\n")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-q", "-m", "seed")
+    return repo
+
+
+def test_changed_only_reports_only_changed_files(tmp_path):
+    repo = _seed_git_repo(tmp_path)
+    # introduce a NEW violation in fresh.py; old.py keeps its committed
+    # violation but is unchanged, so it must not be reported
+    (repo / "fresh.py").write_text(textwrap.dedent("""
+        import time
+
+        async def handler(req):
+            time.sleep(2)
+    """))
+    proc = _run_cli_in(repo, ".", "--changed-only", "HEAD")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "fresh.py" in proc.stdout
+    assert "old.py" not in proc.stdout
+    # a full run over the same tree still sees both
+    full = _run_cli_in(repo, ".")
+    assert full.returncode == 1
+    assert "old.py" in full.stdout
+
+
+def test_changed_only_clean_tree_exits_zero(tmp_path):
+    repo = _seed_git_repo(tmp_path)
+    # the tree HAS a committed violation, but nothing changed vs HEAD
+    proc = _run_cli_in(repo, ".", "--changed-only", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 changed python file(s)" in proc.stdout
+
+
+def test_changed_only_bad_ref_exits_two(tmp_path):
+    repo = _seed_git_repo(tmp_path)
+    proc = _run_cli_in(repo, ".", "--changed-only", "no-such-ref")
+    assert proc.returncode == 2
+    assert "error" in proc.stderr
